@@ -1,0 +1,42 @@
+"""mxtpu — a TPU-native deep-learning framework with the capability surface
+of Apache MXNet (reference: /root/reference, mdespriee/incubator-mxnet 1.5).
+
+Architecture (see SURVEY.md for the full blueprint):
+  * compute substrate: JAX/XLA (per-op jitted executables imperatively;
+    whole-graph StableHLO lowering for Symbol/CachedOp), Pallas kernels
+    for hot custom ops;
+  * parallelism: jax.sharding Mesh + pjit/shard_map with XLA collectives
+    over ICI/DCN (replacing NCCL/ps-lite);
+  * user surface: mx.nd / mx.sym / mx.autograd / mx.gluon / mx.mod /
+    mx.kv / mx.io / mx.optimizer / mx.metric — the reference's Python API.
+
+Typical use, identical to the reference apart from the context:
+
+    import mxtpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError, MXTPUError
+from .context import (Context, cpu, gpu, tpu, cpu_pinned, cpu_shared,
+                      current_context, num_tpus, num_gpus)
+from . import base
+from . import context
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from .random import seed  # noqa: F401  (mx.random.seed also via mx.seed? keep parity minimal)
+
+from .ndarray import NDArray
+
+# Higher layers (symbol, gluon, module, kvstore, io...) are imported lazily
+# at the bottom as they land — import order matters: everything above is the
+# core substrate.
+
+
+def tpu_count():
+    return num_tpus()

@@ -1,0 +1,353 @@
+"""Autograd: imperative differentiation with a host-side tape.
+
+TPU-native re-design of the reference's `src/imperative/imperative.cc`
+(RecordOp/Backward) and `python/mxnet/autograd.py`.  The reference tapes
+NNVM nodes into `NDArray::entry_` and runs `pass::Gradient` to build a
+backward graph executed node-by-node through the engine
+(`imperative.cc:191,278`).  Here each recorded op captures a `jax.vjp`
+closure (XLA computes the op-level gradient — the analog of per-op
+FGradient), and `backward()` walks the tape in reverse topological order
+accumulating cotangents.  The user-facing API (`record/pause/train_mode/
+predict_mode`, `mark_variables`, `backward`, `grad`) matches the
+reference's `python/mxnet/autograd.py:122-365`.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "get_symbol",
+]
+
+
+class _AGState(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _AGState()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev, _STATE.recording = _STATE.recording, bool(flag)
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev, _STATE.training = _STATE.training, bool(flag)
+    return prev
+
+
+class _RecordingScope(object):
+    """Scope manager flipping recording/training flags
+    (reference: `python/mxnet/autograd.py:40-120`)."""
+
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+        self._prev_rec = None
+        self._prev_train = None
+
+    def __enter__(self):
+        if self._rec is not None:
+            self._prev_rec = set_recording(self._rec)
+        if self._train is not None:
+            self._prev_train = set_training(self._train)
+        return self
+
+    def __exit__(self, *args):
+        if self._rec is not None:
+            set_recording(self._prev_rec)
+        if self._train is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode: bool = True):
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class TapeNode(object):
+    """One recorded op: vjp closure + graph wiring.
+
+    ``input_entries[i]`` is ``("node", producer, out_idx)`` when input i was
+    produced by an earlier recorded op, ``("leaf", ndarray)`` when it is a
+    marked variable, or ``None`` for constants.
+    """
+
+    __slots__ = (
+        "op_name",
+        "vjp_fn",
+        "input_entries",
+        "out_avals",
+        "n_outputs",
+        "saved",
+    )
+
+    def __init__(self, op_name, vjp_fn, input_entries, out_avals):
+        self.op_name = op_name
+        self.vjp_fn = vjp_fn
+        self.input_entries = input_entries
+        self.out_avals = out_avals  # list of (shape, dtype)
+        self.n_outputs = len(out_avals)
+        self.saved = None
+
+
+def _record_op(opdef, nd_inputs, jax_inputs, attrs: Dict[str, Any], rng_key=None):
+    """Run op under jax.vjp and tape it. Returns (jax outputs tuple, node)."""
+    import jax
+
+    fn = opdef.fn
+
+    if opdef.needs_rng:
+        def closed(*xs):
+            return fn(rng_key, *xs, **attrs)
+    else:
+        def closed(*xs):
+            return fn(*xs, **attrs)
+
+    def tupled(*xs):
+        out = closed(*xs)
+        return out if isinstance(out, tuple) else (out,)
+
+    outs, vjp_fn = jax.vjp(tupled, *jax_inputs)
+
+    entries = []
+    tracked = False
+    for x in nd_inputs:
+        ent = getattr(x, "_entry", None)
+        if ent is not None:
+            entries.append(("node", ent[0], ent[1]))
+            tracked = True
+        elif getattr(x, "_marked", False):
+            entries.append(("leaf", x))
+            tracked = True
+        else:
+            entries.append(None)
+
+    if not tracked:
+        # nothing upstream requires grad — don't tape
+        return outs, None
+
+    out_avals = [(tuple(o.shape), o.dtype) for o in outs]
+    node = TapeNode(opdef.name, vjp_fn, entries, out_avals)
+    return outs, node
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to variables
+    (reference: `python/mxnet/autograd.py:197`)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, gradbuf, req in zip(variables, gradients, grad_reqs):
+        var._marked = req != "null"
+        var._grad = gradbuf
+        var._grad_req = req
+        var._entry = None
+
+
+# ---------------------------------------------------------------------------
+# Backward walk
+# ---------------------------------------------------------------------------
+
+def _toposort(head_nodes: Sequence[TapeNode]) -> List[TapeNode]:
+    order: List[TapeNode] = []
+    state: Dict[int, int] = {}  # id -> 0 visiting, 1 done
+    stack: List[Tuple[TapeNode, bool]] = [(n, False) for n in head_nodes if n is not None]
+    while stack:
+        node, processed = stack.pop()
+        nid = id(node)
+        if processed:
+            state[nid] = 1
+            order.append(node)
+            continue
+        if nid in state:
+            continue
+        state[nid] = 0
+        stack.append((node, True))
+        for ent in node.input_entries:
+            if ent is not None and ent[0] == "node" and id(ent[1]) not in state:
+                stack.append((ent[1], False))
+    return order  # topological (inputs before consumers)
+
+
+def _is_float_dtype(dt) -> bool:
+    return np.issubdtype(np.dtype(dt), np.floating) or "bfloat16" in str(dt)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of ``heads`` w.r.t. marked variables, accumulating
+    into their ``.grad`` buffers (reference: `python/mxnet/autograd.py:243`,
+    `Imperative::Backward` `src/imperative/imperative.cc:278`)."""
+    grads = _run_backward(heads, head_grads, retain_graph)
+    for var, g in grads.items():
+        req = getattr(var, "_grad_req", "write")
+        if var._grad is None:
+            continue
+        if req == "add":
+            var._grad._set_jax(var._grad._data + g)
+        else:
+            var._grad._set_jax(g.astype(var._grad.dtype) if g.dtype != var._grad.dtype else g)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Return gradients of heads w.r.t. variables without touching ``.grad``
+    (reference: `python/mxnet/autograd.py:270`).  ``create_graph`` (higher
+    order) is not yet supported on the tape path."""
+    from .ndarray import NDArray
+
+    if create_graph:
+        raise MXNetError("create_graph=True is not supported yet")
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    for v in variables:
+        if not getattr(v, "_marked", False) and getattr(v, "_entry", None) is None:
+            raise MXNetError(
+                "one of the variables was not used in the graph or not marked "
+                "with attach_grad/mark_variables"
+            )
+    gmap = _run_backward(heads, head_grads,
+                         retain_graph=bool(retain_graph),
+                         extra_vars=variables)
+    out = []
+    for v in gmap["__vars__"]:
+        out.append(v)
+    return out
+
+
+def _run_backward(heads, head_grads=None, retain_graph=False, extra_vars=None):
+    import jax.numpy as jnp
+
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+    if len(heads) != len(head_grads):
+        raise MXNetError("heads and head_grads length mismatch")
+
+    head_nodes = []
+    for h in heads:
+        ent = getattr(h, "_entry", None)
+        if ent is None and not getattr(h, "_marked", False):
+            raise MXNetError(
+                "cannot differentiate a head that was not computed under "
+                "autograd.record()"
+            )
+        if ent is not None:
+            head_nodes.append(ent[0])
+
+    order = _toposort(head_nodes)
+    # cotangent store: id(node) -> [per-output cotangent or None]
+    cots: Dict[int, List[Optional[Any]]] = {id(n): [None] * n.n_outputs for n in order}
+    leaf_grads: Dict[Any, Any] = {}
+
+    def add_leaf(var, g):
+        if var in leaf_grads:
+            leaf_grads[var] = leaf_grads[var] + g
+        else:
+            leaf_grads[var] = g
+
+    # seed heads
+    for h, hg in zip(heads, head_grads):
+        ent = getattr(h, "_entry", None)
+        seed = hg._data if hg is not None else jnp.ones(h.shape, dtype=h.dtype)
+        if ent is None:
+            add_leaf(h, seed)  # head IS a marked leaf
+            continue
+        node, idx = ent
+        slot = cots[id(node)]
+        slot[idx] = seed if slot[idx] is None else slot[idx] + seed
+
+    # reverse sweep
+    for node in reversed(order):
+        slot = cots[id(node)]
+        if all(c is None for c in slot):
+            continue
+        if node.vjp_fn is None:
+            raise MXNetError(
+                "the backward graph has already been freed; call backward("
+                "retain_graph=True) to backprop through it a second time")
+        full = []
+        for c, (shape, dtype) in zip(slot, node.out_avals):
+            full.append(c if c is not None else jnp.zeros(shape, dtype=dtype))
+        in_cots = node.vjp_fn(tuple(full))
+        for ent, g in zip(node.input_entries, in_cots):
+            if ent is None or g is None:
+                continue
+            # drop symbolic-zero / int cotangents (non-diff inputs)
+            if hasattr(g, "dtype") and not _is_float_dtype(g.dtype):
+                continue
+            if ent[0] == "node":
+                pslot = cots[id(ent[1])]
+                pslot[ent[2]] = g if pslot[ent[2]] is None else pslot[ent[2]] + g
+            else:
+                add_leaf(ent[1], g)
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals
+
+    if extra_vars is not None:
+        from .ndarray import NDArray as _ND
+
+        res = []
+        for v in extra_vars:
+            g = leaf_grads.get(v)
+            if g is None:
+                # variable recorded mid-graph (non-leaf): collect from node
+                # slot; unreachable-from-heads variables get zeros
+                ent = getattr(v, "_entry", None)
+                if ent is not None and id(ent[0]) in cots:
+                    g = cots[id(ent[0])][ent[1]]
+            if g is None:
+                g = jnp.zeros(v.shape, dtype=v.dtype)
+            res.append(_ND(g, ctx=v.ctx))
+        return {"__vars__": res}
+    return leaf_grads
+
+
+def get_symbol(x):  # pragma: no cover - parity stub
+    raise MXNetError("autograd.get_symbol is not supported; use hybridize()")

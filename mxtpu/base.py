@@ -1,0 +1,170 @@
+"""Base utilities for mxtpu: errors, dtypes, env config, small helpers.
+
+TPU-native re-design of the roles played by the reference's
+`include/mxnet/base.h`, `python/mxnet/base.py` and dmlc-core's
+`logging.h`/`GetEnv` (reference: /root/reference). There is no ctypes
+boundary here: the "C API" of the reference collapses into Python calling
+straight into the JAX/XLA runtime, so `base` only carries the shared
+vocabulary (dtype codes, error type, env-var config in the MXNET_* style).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "MXNetError",
+    "MXTPUError",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "mx_real_t",
+    "mx_uint",
+    "_Null",
+    "dtype_np_to_mx",
+    "dtype_mx_to_np",
+    "np_dtype",
+    "getenv",
+    "getenv_int",
+    "getenv_bool",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (name kept for API parity with the
+    reference's ``mxnet.base.MXNetError``)."""
+
+
+# Alias under the new name; both are exported.
+MXTPUError = MXNetError
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+mx_real_t = np.float32
+mx_uint = int
+
+
+class _NullType(object):
+    """Placeholder for missing attribute values (reference: graph attr
+    codegen uses `_Null` to elide defaults)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "_Null"
+
+    def __bool__(self):
+        return False
+
+
+_Null = _NullType()
+
+# Type-code table mirrors the reference's mshadow dtype enum
+# (3rdparty/mshadow base.h; surfaced in python/mxnet/base.py `_DTYPE_NP_TO_MX`).
+# bfloat16 is first-class here (TPU native) where the reference had it only
+# as an MKL extension.
+_DTYPE_NP_TO_MX = {
+    None: -1,
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int8): 5,
+    np.dtype(np.int64): 6,
+    np.dtype(np.bool_): 7,
+    np.dtype(np.int16): 8,
+    np.dtype(np.uint16): 9,
+    np.dtype(np.uint32): 10,
+    np.dtype(np.uint64): 11,
+}
+_DTYPE_MX_TO_NP = {v: k for k, v in _DTYPE_NP_TO_MX.items()}
+
+try:  # ml_dtypes ships with jax
+    import ml_dtypes as _ml_dtypes
+
+    _BFLOAT16 = np.dtype(_ml_dtypes.bfloat16)
+    _DTYPE_NP_TO_MX[_BFLOAT16] = 12
+    _DTYPE_MX_TO_NP[12] = _BFLOAT16
+except ImportError:  # pragma: no cover
+    _BFLOAT16 = None
+
+
+def dtype_np_to_mx(dtype) -> int:
+    """numpy dtype -> integer type code."""
+    if dtype is None:
+        return -1
+    return _DTYPE_NP_TO_MX[np.dtype(dtype)]
+
+
+def dtype_mx_to_np(code: int):
+    """integer type code -> numpy dtype."""
+    return _DTYPE_MX_TO_NP[code]
+
+
+def np_dtype(dtype) -> np.dtype:
+    """Normalize a user-provided dtype (str/np.dtype/type/'bfloat16')."""
+    if dtype is None:
+        return np.dtype(mx_real_t)
+    if isinstance(dtype, str) and dtype == "bfloat16" and _BFLOAT16 is not None:
+        return _BFLOAT16
+    return np.dtype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Env-var config.  The reference reads ~53 MXNET_* env vars via dmlc::GetEnv
+# at use sites (docs/faq/env_var.md).  We keep the same convention and accept
+# both MXNET_* and MXTPU_* prefixes (MXTPU_ wins).
+# ---------------------------------------------------------------------------
+
+def getenv(name: str, default: Optional[str] = None) -> Optional[str]:
+    if name.startswith("MXNET_"):
+        alt = "MXTPU_" + name[len("MXNET_"):]
+        if alt in os.environ:
+            return os.environ[alt]
+    return os.environ.get(name, default)
+
+
+def getenv_int(name: str, default: int) -> int:
+    val = getenv(name)
+    if val is None or val == "":
+        return default
+    return int(val)
+
+
+def getenv_bool(name: str, default: bool) -> bool:
+    val = getenv(name)
+    if val is None or val == "":
+        return default
+    return val not in ("0", "false", "False", "FALSE", "")
+
+
+def check_call(ret: Any) -> Any:  # parity shim; no C boundary to check
+    return ret
+
+
+def c_str(s):  # parity shim
+    return s
+
+
+def _as_tuple(x) -> Tuple:
+    if x is None:
+        return ()
+    if isinstance(x, (tuple, list)):
+        return tuple(x)
+    return (x,)
+
+
+def shape2tuple(shape) -> Tuple[int, ...]:
+    if isinstance(shape, integer_types):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)

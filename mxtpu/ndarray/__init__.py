@@ -1,0 +1,70 @@
+"""`mxtpu.nd` — imperative NDArray API (reference: `python/mxnet/ndarray/`).
+
+All registered ops are attached to this module at import (codegen analog);
+plus the NDArray class and creation/serialization helpers.
+"""
+import sys as _sys
+import types as _types
+
+from .ndarray import (
+    NDArray,
+    imperative_invoke,
+    array,
+    zeros,
+    ones,
+    full,
+    empty,
+    arange,
+    eye,
+    concat,
+    stack,
+    split,
+    moveaxis,
+    waitall,
+    save,
+    load,
+    from_numpy,
+    from_jax,
+)
+from . import register as _register_mod
+
+_this = _sys.modules[__name__]
+_register_mod._init_op_module(_this)
+
+# creation helpers shadow same-named generated wrappers on purpose
+_this.array = array
+_this.zeros = zeros
+_this.ones = ones
+_this.full = full
+_this.empty = empty
+_this.arange = arange
+_this.eye = eye
+_this.concat = concat
+_this.stack = stack
+_this.split = split
+_this.save = save
+_this.load = load
+
+# `nd.random` sub-namespace (reference: mxnet.ndarray.random)
+from .. import random as random  # noqa: E402
+
+# `nd.contrib` sub-namespace: expose _contrib_* ops without the prefix
+contrib = _types.ModuleType(__name__ + ".contrib")
+for _name in dir(_this):
+    if _name.startswith("_contrib_"):
+        setattr(contrib, _name[len("_contrib_"):], getattr(_this, _name))
+_sys.modules[contrib.__name__] = contrib
+
+# `nd.linalg` sub-namespace
+linalg = _types.ModuleType(__name__ + ".linalg")
+for _name in dir(_this):
+    if _name.startswith("_linalg_"):
+        setattr(linalg, _name[len("_linalg_"):], getattr(_this, _name))
+_sys.modules[linalg.__name__] = linalg
+
+# `nd.image` sub-namespace
+image = _types.ModuleType(__name__ + ".image")
+for _name in dir(_this):
+    if _name.startswith("_image_"):
+        setattr(image, _name[len("_image_"):], getattr(_this, _name))
+_sys.modules[image.__name__] = image

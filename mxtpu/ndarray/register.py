@@ -1,0 +1,43 @@
+"""Attach every registered op as a function on the `mxtpu.nd` namespace.
+
+This is the analog of the reference's import-time op codegen:
+`_init_op_module` (`python/mxnet/base.py:578`) enumerates the C-side op
+registry and generates Python wrappers (`python/mxnet/ndarray/register.py:
+30-169`).  Here the registry is in-process, so "codegen" is closure
+creation — same API result: ``nd.elemwise_add(a, b)``, ``nd.FullyConnected
+(x, w, b, num_hidden=...)``, with ``out=`` support.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Any
+
+from ..ops import registry as _reg
+from .ndarray import NDArray, imperative_invoke
+
+
+def _make_ndarray_function(name: str, opdef):
+    def fn(*args, out=None, name=None, **kwargs):  # noqa: A002 - parity
+        nd_args = [a for a in args]
+        n_out = opdef.n_outputs(kwargs)
+        res = imperative_invoke(opdef.name, *nd_args, out=out, **kwargs)
+        if len(res) == 1:
+            return res[0]
+        return list(res)
+
+    fn.__name__ = name
+    fn.__doc__ = opdef.doc or ("%s (auto-generated TPU-native op wrapper)" % name)
+    fn.__module__ = "mxtpu.ndarray"
+    return fn
+
+
+def _init_op_module(target_module):
+    registry = _reg._OP_REGISTRY
+    seen = set()
+    for name, opdef in registry.items():
+        if name in seen:
+            continue
+        seen.add(name)
+        public_name = name
+        setattr(target_module, public_name, _make_ndarray_function(public_name,
+                                                                  opdef))

@@ -1,0 +1,23 @@
+"""Operator corpus: importing this package registers all ops.
+
+Layout mirrors the reference's `src/operator/` families (SURVEY.md §2.2):
+elemwise/reduce/matrix/indexing/init/nn/random/optimizer/linalg (+ rnn,
+contrib, image, control flow as they land).
+"""
+from . import registry
+from .registry import OpDef, register, get_op, has_op, list_ops, invoke_jax
+
+from . import elemwise  # noqa: F401
+from . import reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import indexing  # noqa: F401
+from . import init_ops  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import linalg  # noqa: F401
+from . import rnn_op  # noqa: F401
+from . import contrib  # noqa: F401
+from . import image  # noqa: F401
+from . import control_flow  # noqa: F401
+from . import quantization  # noqa: F401

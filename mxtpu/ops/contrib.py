@@ -1,0 +1,265 @@
+"""Contrib ops (reference: `src/operator/contrib/*`): detection heads
+(ROI pooling/align, box ops, MultiBox SSD family), misc extras.
+
+Dynamic-output-shape ops (NMS, proposals) are re-formulated with static
+shapes + validity masks — the XLA contract (the reference returns -1-padded
+rows for invalid entries, which maps cleanly onto static shapes).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@register("ROIPooling")
+def _roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """rois: (R, 5) [batch_idx, x1, y1, x2, y2] in image coords."""
+    import jax
+
+    jnp = _jnp()
+    ph, pw = pooled_size
+    n, c, hh, ww = data.shape
+
+    def pool_one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        img = data[b]  # (C, H, W)
+        ys = jnp.arange(hh)
+        xs = jnp.arange(ww)
+
+        def cell(iy, ix):
+            hstart = y1 + (iy * rh) // ph
+            hend = y1 + ((iy + 1) * rh + ph - 1) // ph
+            wstart = x1 + (ix * rw) // pw
+            wend = x1 + ((ix + 1) * rw + pw - 1) // pw
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                    (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            big_neg = jnp.asarray(-1e30, dtype=data.dtype)
+            masked = jnp.where(mask[None], img, big_neg)
+            return masked.max(axis=(1, 2))
+
+        cells = [[cell(iy, ix) for ix in range(pw)] for iy in range(ph)]
+        return jnp.stack([jnp.stack(r, axis=-1) for r in cells], axis=-2)
+
+    return jax.vmap(pool_one)(rois)
+
+
+@register("_contrib_ROIAlign")
+def _roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+               sample_ratio=-1, position_sensitive=False, aligned=False):
+    import jax
+
+    jnp = _jnp()
+    ph, pw = pooled_size
+    n, c, hh, ww = data.shape
+    off = 0.5 if aligned else 0.0
+    sr = sample_ratio if sample_ratio > 0 else 2
+
+    def bilinear(img, y, x):
+        y0 = jnp.clip(jnp.floor(y), 0, hh - 1)
+        x0 = jnp.clip(jnp.floor(x), 0, ww - 1)
+        y1 = jnp.clip(y0 + 1, 0, hh - 1)
+        x1 = jnp.clip(x0 + 1, 0, ww - 1)
+        wy = y - y0
+        wx = x - x0
+        y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+        x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+        v = (img[:, y0i, x0i] * (1 - wy) * (1 - wx) +
+             img[:, y1i, x0i] * wy * (1 - wx) +
+             img[:, y0i, x1i] * (1 - wy) * wx +
+             img[:, y1i, x1i] * wy * wx)
+        return v
+
+    def pool_one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - off
+        y1 = roi[2] * spatial_scale - off
+        x2 = roi[3] * spatial_scale - off
+        y2 = roi[4] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[b]
+        out = []
+        for iy in range(ph):
+            row = []
+            for ix in range(pw):
+                acc = 0.0
+                for sy in range(sr):
+                    for sx in range(sr):
+                        yy = y1 + (iy + (sy + 0.5) / sr) * bin_h
+                        xx = x1 + (ix + (sx + 0.5) / sr) * bin_w
+                        acc = acc + bilinear(img, yy, xx)
+                row.append(acc / (sr * sr))
+            out.append(jnp.stack(row, axis=-1))
+        return jnp.stack(out, axis=-2)
+
+    return jax.vmap(pool_one)(rois)
+
+
+def _iou_matrix(jnp, a, b, fmt="corner"):
+    if fmt == "center":
+        ax1, ay1 = a[..., 0] - a[..., 2] / 2, a[..., 1] - a[..., 3] / 2
+        ax2, ay2 = a[..., 0] + a[..., 2] / 2, a[..., 1] + a[..., 3] / 2
+        bx1, by1 = b[..., 0] - b[..., 2] / 2, b[..., 1] - b[..., 3] / 2
+        bx2, by2 = b[..., 0] + b[..., 2] / 2, b[..., 1] + b[..., 3] / 2
+    else:
+        ax1, ay1, ax2, ay2 = a[..., 0], a[..., 1], a[..., 2], a[..., 3]
+        bx1, by1, bx2, by2 = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    ix1 = jnp.maximum(ax1[..., :, None], bx1[..., None, :])
+    iy1 = jnp.maximum(ay1[..., :, None], by1[..., None, :])
+    ix2 = jnp.minimum(ax2[..., :, None], bx2[..., None, :])
+    iy2 = jnp.minimum(ay2[..., :, None], by2[..., None, :])
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum((ax2 - ax1) * (ay2 - ay1), 0.0)
+    area_b = jnp.maximum((bx2 - bx1) * (by2 - by1), 0.0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return inter / jnp.maximum(union, 1e-12)
+
+
+@register("_contrib_box_iou")
+def _box_iou(lhs, rhs, format="corner"):
+    return _iou_matrix(_jnp(), lhs, rhs, format)
+
+
+@register("_contrib_box_nms", num_outputs=1)
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+             score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+             in_format="corner", out_format="corner"):
+    """Greedy NMS with static shapes: suppressed rows are replaced by -1
+    (matching the reference's -1-fill convention)."""
+    import jax
+
+    jnp = _jnp()
+    orig_shape = data.shape
+    x = data.reshape(-1, orig_shape[-2], orig_shape[-1])
+
+    def nms_one(boxes):
+        scores = boxes[:, score_index]
+        order = jnp.argsort(-scores)
+        sorted_boxes = boxes[order]
+        coords = sorted_boxes[:, coord_start:coord_start + 4]
+        iou = _iou_matrix(jnp, coords, coords, in_format)
+        valid = sorted_boxes[:, score_index] > valid_thresh
+        if id_index >= 0 and not force_suppress:
+            same_cls = (sorted_boxes[:, id_index][:, None] ==
+                        sorted_boxes[:, id_index][None, :])
+            iou = jnp.where(same_cls, iou, 0.0)
+        n = boxes.shape[0]
+
+        def body(i, keep):
+            sup = (iou[i] > overlap_thresh) & (jnp.arange(n) > i) & keep[i] & valid[i]
+            return keep & (~sup)
+
+        keep = jax.lax.fori_loop(0, n, body, valid)
+        out = jnp.where(keep[:, None], sorted_boxes,
+                        jnp.full_like(sorted_boxes, -1.0))
+        return out
+
+    out = jax.vmap(nms_one)(x)
+    return out.reshape(orig_shape)
+
+
+@register("_contrib_bipartite_matching", num_outputs=2, differentiable=False)
+def _bipartite_matching(data, is_ascend=False, threshold=0.5, topk=-1):
+    import jax
+
+    jnp = _jnp()
+
+    def match_one(mat):
+        r, c = mat.shape
+        k = min(r, c) if topk <= 0 else min(topk, r, c)
+        row_match = jnp.full((r,), -1.0)
+        col_match = jnp.full((c,), -1.0)
+        work = mat if not is_ascend else -mat
+        thr = threshold if not is_ascend else -threshold
+
+        def body(_, carry):
+            rm, cm, w = carry
+            idx = jnp.argmax(w)
+            i, j = idx // c, idx % c
+            ok = w[i, j] >= thr
+            rm = jnp.where(ok, rm.at[i].set(j.astype(rm.dtype)), rm)
+            cm = jnp.where(ok, cm.at[j].set(i.astype(cm.dtype)), cm)
+            w = w.at[i, :].set(-jnp.inf)
+            w = w.at[:, j].set(-jnp.inf)
+            return rm, cm, w
+
+        rm, cm, _ = jax.lax.fori_loop(0, k, body, (row_match, col_match, work))
+        return rm, cm
+
+    if data.ndim == 2:
+        return match_one(data)
+    rm, cm = jax.vmap(match_one)(data)
+    return rm, cm
+
+
+@register("_contrib_MultiBoxPrior", differentiable=False)
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, -1.0),
+                    offsets=(0.5, 0.5)):
+    jnp = _jnp()
+    _, _, h, w = data.shape
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    anchors = []
+    cy = (np.arange(h) + offsets[0]) * step_y
+    cx = (np.arange(w) + offsets[1]) * step_x
+    cyg, cxg = np.meshgrid(cy, cx, indexing="ij")
+    boxes = []
+    # reference layout: first size with all ratios? actually sizes[0] w/ all
+    # ratios + other sizes w/ ratio[0]
+    combos = [(sizes[0], r) for r in ratios] + [(s, ratios[0]) for s in sizes[1:]]
+    for s, r in combos:
+        bw = s * np.sqrt(r) / 2
+        bh = s / np.sqrt(r) / 2
+        boxes.append(np.stack([cxg - bw, cyg - bh, cxg + bw, cyg + bh], axis=-1))
+    out = np.stack(boxes, axis=2).reshape(1, -1, 4).astype(np.float32)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    return jnp.asarray(out)
+
+
+@register("_contrib_SyncBatchNorm", num_outputs=3, train_aware=True)
+def _sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                     momentum=0.9, fix_gamma=True, use_global_stats=False,
+                     output_mean_var=False, ndev=1, key=None, is_train=False):
+    """Cross-device BatchNorm.  Inside pjit/shard_map the mean/var reduce
+    is a `psum` over the data axis (see mxtpu.parallel); single-device path
+    equals BatchNorm (reference `src/operator/contrib/sync_batch_norm.cc`)."""
+    from .nn import _batch_norm
+
+    return _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=eps,
+                       momentum=momentum, fix_gamma=fix_gamma,
+                       use_global_stats=use_global_stats,
+                       output_mean_var=output_mean_var, axis=1,
+                       is_train=is_train)
+
+
+@register("_contrib_arange_like", differentiable=False)
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    jnp = _jnp()
+    if axis is None:
+        n = int(np.prod(data.shape))
+        return (jnp.arange(n, dtype=data.dtype) * step + start).reshape(data.shape)
+    n = data.shape[axis]
+    shape = [1] * data.ndim
+    shape[axis] = n
+    return jnp.broadcast_to(
+        (jnp.arange(n, dtype=data.dtype) * step + start).reshape(shape),
+        data.shape)

@@ -1,0 +1,68 @@
+"""Control-flow ops (reference: `src/operator/control_flow.cc` — _foreach,
+_while_loop, _cond holding subgraph Symbols run via nested CachedOps).
+
+TPU-native design: in symbolic/hybrid graphs these lower DIRECTLY to
+`lax.scan` / `lax.while_loop` / `lax.cond` — XLA-native structured control
+flow, which is strictly better than the reference's per-iteration CachedOp
+dispatch.  The imperative (`mx.nd.contrib.foreach`) path is a plain Python
+loop, like the reference's imperative fallback.
+
+The callable-based API lives in `mxtpu.control_flow` (foreach/while_loop/
+cond working on NDArrays or Symbols); this module holds the jax-level
+implementations used by both.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+
+def foreach_jax(body: Callable, data, init_states: Sequence):
+    """body(x_t, states) -> (out_t, new_states); scans over axis 0 of data."""
+    import jax
+
+    def scan_body(states, x):
+        out, new_states = body(x, list(states))
+        return tuple(new_states), out
+
+    states, outs = jax.lax.scan(scan_body, tuple(init_states), data)
+    return outs, list(states)
+
+
+def while_loop_jax(cond: Callable, func: Callable, loop_vars: Sequence,
+                   max_iterations: int):
+    """Bounded while loop with static output size (XLA requirement).
+
+    func(*loop_vars) -> (step_output, new_loop_vars).  Outputs are stacked
+    into a (max_iterations, ...) buffer; rows beyond the actual trip count
+    stay zero (the reference pads the same way —
+    `src/operator/control_flow.cc:491-547`).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    out0, _ = func(*loop_vars)
+    multi_out = isinstance(out0, (list, tuple))
+    outs0 = list(out0) if multi_out else [out0]
+    bufs = [jnp.zeros((max_iterations,) + tuple(o.shape), o.dtype) for o in outs0]
+
+    def lcond(carry):
+        i, vars_, _ = carry
+        return jnp.logical_and(i < max_iterations, cond(*vars_) != 0)
+
+    def lbody(carry):
+        i, vars_, bufs_ = carry
+        out, new_vars = func(*vars_)
+        outs = list(out) if multi_out else [out]
+        bufs_ = tuple(b.at[i].set(o) for b, o in zip(bufs_, outs))
+        return i + 1, tuple(new_vars), bufs_
+
+    n, final_vars, bufs = jax.lax.while_loop(
+        lcond, lbody, (jnp.asarray(0), tuple(loop_vars), tuple(bufs)))
+    outs = list(bufs) if multi_out else bufs[0]
+    return outs, list(final_vars), n
+
+
+def cond_jax(pred, then_func: Callable, else_func: Callable):
+    import jax
+
+    return jax.lax.cond(pred != 0, then_func, else_func)

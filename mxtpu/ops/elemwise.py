@@ -1,0 +1,294 @@
+"""Elementwise unary/binary/scalar/broadcast operator families.
+
+Covers the reference's `src/operator/tensor/elemwise_unary_op_*.cc`,
+`elemwise_binary_op_*.cc`, `elemwise_binary_scalar_op_*.cc`,
+`elemwise_binary_broadcast_op_*.cc` and `elemwise_sum.cc` surfaces
+(names kept verbatim — see SURVEY.md Appendix A).  Each op is a pure JAX
+function; XLA fuses chains of these into single kernels, which replaces
+the reference's mshadow expression templates and hand-written CUDA.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import np_dtype
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# unary
+# ---------------------------------------------------------------------------
+
+def _unary(name, f, differentiable=True, aliases=()):
+    @register(name, differentiable=differentiable, aliases=aliases)
+    def _op(x, __f=f):
+        return __f(_jnp(), x)
+
+    _op.__name__ = name
+    return _op
+
+
+_unary("abs", lambda jnp, x: jnp.abs(x))
+_unary("cbrt", lambda jnp, x: jnp.cbrt(x))
+_unary("ceil", lambda jnp, x: jnp.ceil(x), differentiable=False)
+_unary("cos", lambda jnp, x: jnp.cos(x))
+_unary("cosh", lambda jnp, x: jnp.cosh(x))
+_unary("degrees", lambda jnp, x: jnp.degrees(x))
+_unary("erf", lambda jnp, x: __import__("jax").scipy.special.erf(x))
+_unary("erfinv", lambda jnp, x: __import__("jax").scipy.special.erfinv(x))
+_unary("exp", lambda jnp, x: jnp.exp(x))
+_unary("expm1", lambda jnp, x: jnp.expm1(x))
+_unary("fix", lambda jnp, x: jnp.trunc(x), differentiable=False)
+_unary("floor", lambda jnp, x: jnp.floor(x), differentiable=False)
+_unary("gamma", lambda jnp, x: jnp.exp(__import__("jax").scipy.special.gammaln(x)) *
+       jnp.sign(jnp.where(x > 0, 1.0, jnp.sin(jnp.pi * x))))
+_unary("gammaln", lambda jnp, x: __import__("jax").scipy.special.gammaln(x))
+_unary("log", lambda jnp, x: jnp.log(x))
+_unary("log10", lambda jnp, x: jnp.log10(x))
+_unary("log1p", lambda jnp, x: jnp.log1p(x))
+_unary("log2", lambda jnp, x: jnp.log2(x))
+_unary("logical_not", lambda jnp, x: (x == 0).astype(x.dtype), differentiable=False)
+_unary("negative", lambda jnp, x: -x, aliases=("_np_negative",))
+_unary("radians", lambda jnp, x: jnp.radians(x))
+_unary("rcbrt", lambda jnp, x: 1.0 / jnp.cbrt(x))
+_unary("reciprocal", lambda jnp, x: 1.0 / x)
+_unary("rint", lambda jnp, x: jnp.rint(x), differentiable=False)
+_unary("round", lambda jnp, x: jnp.round(x), differentiable=False)
+_unary("rsqrt", lambda jnp, x: __import__("jax").lax.rsqrt(x))
+_unary("sign", lambda jnp, x: jnp.sign(x), differentiable=False)
+_unary("sin", lambda jnp, x: jnp.sin(x))
+_unary("sinh", lambda jnp, x: jnp.sinh(x))
+_unary("sqrt", lambda jnp, x: jnp.sqrt(x))
+_unary("square", lambda jnp, x: jnp.square(x))
+_unary("tan", lambda jnp, x: jnp.tan(x))
+_unary("tanh", lambda jnp, x: jnp.tanh(x))
+_unary("trunc", lambda jnp, x: jnp.trunc(x), differentiable=False)
+_unary("arccos", lambda jnp, x: jnp.arccos(x))
+_unary("arccosh", lambda jnp, x: jnp.arccosh(x))
+_unary("arcsin", lambda jnp, x: jnp.arcsin(x))
+_unary("arcsinh", lambda jnp, x: jnp.arcsinh(x))
+_unary("arctan", lambda jnp, x: jnp.arctan(x))
+_unary("arctanh", lambda jnp, x: jnp.arctanh(x))
+
+
+@register("_copy", aliases=("identity",))
+def _copy(x):
+    return _jnp().asarray(x)
+
+
+@register("Cast", aliases=("cast",))
+def _cast(x, dtype="float32"):
+    return x.astype(np_dtype(dtype))
+
+
+@register("zeros_like")
+def _zeros_like(x):
+    return _jnp().zeros_like(x)
+
+
+@register("ones_like")
+def _ones_like(x):
+    return _jnp().ones_like(x)
+
+
+@register("shape_array", differentiable=False)
+def _shape_array(x):
+    return _jnp().array(x.shape, dtype=np.int64)
+
+
+@register("size_array", differentiable=False)
+def _size_array(x):
+    return _jnp().array([int(np.prod(x.shape)) if x.shape else 1], dtype=np.int64)
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def _block_grad(x):
+    import jax
+
+    return jax.lax.stop_gradient(x)
+
+
+@register("make_loss")
+def _make_loss_op(x):
+    return _jnp().asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise (same-shape)
+# ---------------------------------------------------------------------------
+
+def _binary(name, f, differentiable=True, aliases=()):
+    @register(name, differentiable=differentiable, aliases=aliases)
+    def _op(lhs, rhs, __f=f):
+        return __f(_jnp(), lhs, rhs)
+
+    _op.__name__ = name
+    return _op
+
+
+def _cmp(jnp, res, ref):
+    return res.astype(ref.dtype)
+
+
+_binary("elemwise_add", lambda jnp, a, b: a + b, aliases=("_plus", "_add"))
+_binary("elemwise_sub", lambda jnp, a, b: a - b, aliases=("_minus", "_sub"))
+_binary("elemwise_mul", lambda jnp, a, b: a * b, aliases=("_mul",))
+_binary("elemwise_div", lambda jnp, a, b: a / b, aliases=("_div",))
+_binary("_grad_add", lambda jnp, a, b: a + b)
+_binary("_hypot", lambda jnp, a, b: jnp.hypot(a, b))
+_binary("_power", lambda jnp, a, b: jnp.power(a, b))
+_binary("_maximum", lambda jnp, a, b: jnp.maximum(a, b))
+_binary("_minimum", lambda jnp, a, b: jnp.minimum(a, b))
+_binary("_mod", lambda jnp, a, b: jnp.mod(a, b))
+_binary("_equal", lambda jnp, a, b: _cmp(jnp, a == b, a), differentiable=False)
+_binary("_not_equal", lambda jnp, a, b: _cmp(jnp, a != b, a), differentiable=False)
+_binary("_greater", lambda jnp, a, b: _cmp(jnp, a > b, a), differentiable=False)
+_binary("_greater_equal", lambda jnp, a, b: _cmp(jnp, a >= b, a), differentiable=False)
+_binary("_lesser", lambda jnp, a, b: _cmp(jnp, a < b, a), differentiable=False)
+_binary("_lesser_equal", lambda jnp, a, b: _cmp(jnp, a <= b, a), differentiable=False)
+_binary("_logical_and", lambda jnp, a, b: _cmp(jnp, (a != 0) & (b != 0), a),
+        differentiable=False)
+_binary("_logical_or", lambda jnp, a, b: _cmp(jnp, (a != 0) | (b != 0), a),
+        differentiable=False)
+_binary("_logical_xor", lambda jnp, a, b: _cmp(jnp, (a != 0) ^ (b != 0), a),
+        differentiable=False)
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum_of"))
+def _add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scalar ops — attr name `scalar` matches the reference's param
+# ---------------------------------------------------------------------------
+
+def _scalar_op(name, f, differentiable=True):
+    @register(name, differentiable=differentiable)
+    def _op(x, scalar=0.0, __f=f):
+        return __f(_jnp(), x, scalar)
+
+    _op.__name__ = name
+    return _op
+
+
+def _sc(jnp, x, s):
+    # match input dtype (reference keeps operand dtype)
+    return jnp.asarray(s, dtype=x.dtype)
+
+
+_scalar_op("_plus_scalar", lambda jnp, x, s: x + _sc(jnp, x, s))
+_scalar_op("_minus_scalar", lambda jnp, x, s: x - _sc(jnp, x, s))
+_scalar_op("_rminus_scalar", lambda jnp, x, s: _sc(jnp, x, s) - x)
+_scalar_op("_mul_scalar", lambda jnp, x, s: x * _sc(jnp, x, s))
+_scalar_op("_div_scalar", lambda jnp, x, s: x / _sc(jnp, x, s))
+_scalar_op("_rdiv_scalar", lambda jnp, x, s: _sc(jnp, x, s) / x)
+_scalar_op("_mod_scalar", lambda jnp, x, s: jnp.mod(x, _sc(jnp, x, s)))
+_scalar_op("_rmod_scalar", lambda jnp, x, s: jnp.mod(_sc(jnp, x, s), x))
+_scalar_op("_power_scalar", lambda jnp, x, s: jnp.power(x, _sc(jnp, x, s)))
+_scalar_op("_rpower_scalar", lambda jnp, x, s: jnp.power(_sc(jnp, x, s), x))
+_scalar_op("_hypot_scalar", lambda jnp, x, s: jnp.hypot(x, _sc(jnp, x, s)))
+_scalar_op("_maximum_scalar", lambda jnp, x, s: jnp.maximum(x, _sc(jnp, x, s)))
+_scalar_op("_minimum_scalar", lambda jnp, x, s: jnp.minimum(x, _sc(jnp, x, s)))
+_scalar_op("_equal_scalar", lambda jnp, x, s: (x == s).astype(x.dtype),
+           differentiable=False)
+_scalar_op("_not_equal_scalar", lambda jnp, x, s: (x != s).astype(x.dtype),
+           differentiable=False)
+_scalar_op("_greater_scalar", lambda jnp, x, s: (x > s).astype(x.dtype),
+           differentiable=False)
+_scalar_op("_greater_equal_scalar", lambda jnp, x, s: (x >= s).astype(x.dtype),
+           differentiable=False)
+_scalar_op("_lesser_scalar", lambda jnp, x, s: (x < s).astype(x.dtype),
+           differentiable=False)
+_scalar_op("_lesser_equal_scalar", lambda jnp, x, s: (x <= s).astype(x.dtype),
+           differentiable=False)
+_scalar_op("_logical_and_scalar", lambda jnp, x, s: ((x != 0) & (s != 0)).astype(x.dtype),
+           differentiable=False)
+_scalar_op("_logical_or_scalar", lambda jnp, x, s: ((x != 0) | (s != 0)).astype(x.dtype),
+           differentiable=False)
+_scalar_op("_logical_xor_scalar", lambda jnp, x, s: ((x != 0) ^ (s != 0)).astype(x.dtype),
+           differentiable=False)
+_scalar_op("_scatter_plus_scalar", lambda jnp, x, s: x + _sc(jnp, x, s))
+_scalar_op("_scatter_minus_scalar", lambda jnp, x, s: x - _sc(jnp, x, s))
+
+
+@register("smooth_l1")
+def _smooth_l1(x, scalar=1.0):
+    jnp = _jnp()
+    sigma2 = scalar * scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / sigma2, 0.5 * sigma2 * x * x, absx - 0.5 / sigma2)
+
+
+# ---------------------------------------------------------------------------
+# broadcast binary
+# ---------------------------------------------------------------------------
+
+def _bcast(name, f, differentiable=True, aliases=()):
+    @register(name, differentiable=differentiable, aliases=aliases)
+    def _op(lhs, rhs, __f=f):
+        return __f(_jnp(), lhs, rhs)
+
+    _op.__name__ = name
+    return _op
+
+
+_bcast("broadcast_add", lambda jnp, a, b: a + b, aliases=("broadcast_plus",))
+_bcast("broadcast_sub", lambda jnp, a, b: a - b, aliases=("broadcast_minus",))
+_bcast("broadcast_mul", lambda jnp, a, b: a * b)
+_bcast("broadcast_div", lambda jnp, a, b: a / b)
+_bcast("broadcast_mod", lambda jnp, a, b: jnp.mod(a, b))
+_bcast("broadcast_power", lambda jnp, a, b: jnp.power(a, b))
+_bcast("broadcast_hypot", lambda jnp, a, b: jnp.hypot(a, b))
+_bcast("broadcast_maximum", lambda jnp, a, b: jnp.maximum(a, b))
+_bcast("broadcast_minimum", lambda jnp, a, b: jnp.minimum(a, b))
+_bcast("broadcast_equal", lambda jnp, a, b: _cmp(jnp, a == b, a), differentiable=False)
+_bcast("broadcast_not_equal", lambda jnp, a, b: _cmp(jnp, a != b, a),
+       differentiable=False)
+_bcast("broadcast_greater", lambda jnp, a, b: _cmp(jnp, a > b, a), differentiable=False)
+_bcast("broadcast_greater_equal", lambda jnp, a, b: _cmp(jnp, a >= b, a),
+       differentiable=False)
+_bcast("broadcast_lesser", lambda jnp, a, b: _cmp(jnp, a < b, a), differentiable=False)
+_bcast("broadcast_lesser_equal", lambda jnp, a, b: _cmp(jnp, a <= b, a),
+       differentiable=False)
+_bcast("broadcast_logical_and", lambda jnp, a, b: _cmp(jnp, (a != 0) & (b != 0), a),
+       differentiable=False)
+_bcast("broadcast_logical_or", lambda jnp, a, b: _cmp(jnp, (a != 0) | (b != 0), a),
+       differentiable=False)
+_bcast("broadcast_logical_xor", lambda jnp, a, b: _cmp(jnp, (a != 0) ^ (b != 0), a),
+       differentiable=False)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(x, axis=(), size=()):
+    jnp = _jnp()
+    if isinstance(axis, int):
+        axis = (axis,)
+    if isinstance(size, int):
+        size = (size,)
+    shape = list(x.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register("broadcast_to")
+def _broadcast_to(x, shape=()):
+    jnp = _jnp()
+    # reference semantics: 0 in target shape means "keep input dim"
+    tgt = tuple(int(i) if int(t) == 0 else int(t) for i, t in zip(x.shape, shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_like")
+def _broadcast_like(x, other):
+    return _jnp().broadcast_to(x, other.shape)

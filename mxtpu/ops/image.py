@@ -1,0 +1,87 @@
+"""Image ops (reference: `src/operator/image/*`, used by gluon data
+pipelines): to_tensor, normalize, flips, resize, crop."""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@register("_image_to_tensor", aliases=("to_tensor",))
+def _to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float [0,1] (batch-aware)."""
+    jnp = _jnp()
+    x = data.astype(np.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize", aliases=("image_normalize",))
+def _normalize(data, mean=(0.0,), std=(1.0,)):
+    jnp = _jnp()
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data.ndim == 3:
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, -1, 1, 1)
+    return (data - mean.reshape(shape)) / std.reshape(shape)
+
+
+@register("_image_flip_left_right", differentiable=False)
+def _flip_lr(data):
+    return _jnp().flip(data, axis=-1 if data.ndim == 3 else -1)
+
+
+@register("_image_flip_top_bottom", differentiable=False)
+def _flip_tb(data):
+    return _jnp().flip(data, axis=-2)
+
+
+@register("_image_random_flip_left_right", needs_rng=True, differentiable=False)
+def _random_flip_lr(key, data):
+    import jax
+
+    jnp = _jnp()
+    flip = jax.random.bernoulli(key)
+    return jnp.where(flip, jnp.flip(data, axis=-1), data)
+
+
+@register("_image_random_flip_top_bottom", needs_rng=True, differentiable=False)
+def _random_flip_tb(key, data):
+    import jax
+
+    jnp = _jnp()
+    flip = jax.random.bernoulli(key)
+    return jnp.where(flip, jnp.flip(data, axis=-2), data)
+
+
+@register("_image_resize", aliases=("image_resize",), differentiable=False)
+def _resize(data, size=(0, 0), keep_ratio=False, interp=1):
+    import jax
+
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = size
+    method = "nearest" if interp == 0 else "linear"
+    if data.ndim == 3:
+        hh, ww, c = data.shape
+        return jax.image.resize(data.astype(np.float32), (h, w, c),
+                                method=method).astype(data.dtype)
+    n, hh, ww, c = data.shape
+    return jax.image.resize(data.astype(np.float32), (n, h, w, c),
+                            method=method).astype(data.dtype)
+
+
+@register("_image_crop", differentiable=False)
+def _crop_img(data, x=0, y=0, width=0, height=0):
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width]
+    return data[:, y:y + height, x:x + width]

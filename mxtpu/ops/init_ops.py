@@ -1,0 +1,47 @@
+"""Initialization ops (reference: `src/operator/tensor/init_op.cc`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import np_dtype
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@register("_zeros", differentiable=False, aliases=("_zeros_without_dtype",))
+def _zeros(shape=(), dtype="float32"):
+    return _jnp().zeros(shape, dtype=np_dtype(dtype))
+
+
+@register("_ones", differentiable=False)
+def _ones(shape=(), dtype="float32"):
+    return _jnp().ones(shape, dtype=np_dtype(dtype))
+
+
+@register("_full", differentiable=False)
+def _full(shape=(), value=0.0, dtype="float32"):
+    return _jnp().full(shape, value, dtype=np_dtype(dtype))
+
+
+@register("_arange", differentiable=False)
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    jnp = _jnp()
+    arr = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    if repeat and repeat > 1:
+        arr = jnp.repeat(arr, repeat)
+    return arr
+
+
+@register("_eye", differentiable=False)
+def _eye(N=0, M=0, k=0, dtype="float32"):
+    return _jnp().eye(int(N), int(M) if M else None, k=int(k), dtype=np_dtype(dtype))
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_like_rhs(lhs, rhs):
+    return _jnp().asarray(lhs)

@@ -1,0 +1,151 @@
+"""LAPACK-style linalg ops (reference: `src/operator/tensor/la_op.cc`).
+
+These lower to XLA's native decompositions (cholesky/qr/eigh) — the analog
+of the reference binding LAPACK on CPU and cuSOLVER on GPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def _gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
+          axis=-3):
+    jnp = _jnp()
+    at = jnp.swapaxes(a, -1, -2) if transpose_a else a
+    bt = jnp.swapaxes(b, -1, -2) if transpose_b else b
+    return alpha * jnp.matmul(at, bt) + beta * c
+
+
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def _gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0, axis=-3):
+    jnp = _jnp()
+    at = jnp.swapaxes(a, -1, -2) if transpose_a else a
+    bt = jnp.swapaxes(b, -1, -2) if transpose_b else b
+    return alpha * jnp.matmul(at, bt)
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def _potrf(a):
+    return _jnp().linalg.cholesky(a)
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def _potri(a):
+    """Inverse from Cholesky factor: inv(L L^T) given L."""
+    jnp = _jnp()
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    import jax
+
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("_linalg_trmm", aliases=("linalg_trmm",))
+def _trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    jnp = _jnp()
+    at = jnp.swapaxes(a, -1, -2) if transpose else a
+    if rightside:
+        return alpha * jnp.matmul(b, at)
+    return alpha * jnp.matmul(at, b)
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def _trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    import jax
+
+    jnp = _jnp()
+    amat = jnp.swapaxes(a, -1, -2) if transpose else a
+    low = (not lower) if transpose else lower
+    if rightside:
+        # solve X A = alpha B  <=>  A^T X^T = alpha B^T
+        xt = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(amat, -1, -2), jnp.swapaxes(alpha * b, -1, -2),
+            lower=not low)
+        return jnp.swapaxes(xt, -1, -2)
+    return jax.scipy.linalg.solve_triangular(amat, alpha * b, lower=low)
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def _sumlogdiag(a):
+    jnp = _jnp()
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def _syrk(a, transpose=False, alpha=1.0):
+    jnp = _jnp()
+    if transpose:
+        return alpha * jnp.matmul(jnp.swapaxes(a, -1, -2), a)
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+@register("_linalg_gelqf", aliases=("linalg_gelqf",), num_outputs=2)
+def _gelqf(a):
+    jnp = _jnp()
+    # LQ via QR of the transpose
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", aliases=("linalg_syevd",), num_outputs=2)
+def _syevd(a):
+    jnp = _jnp()
+    w, v = jnp.linalg.eigh(a)
+    # reference returns (U, L) with rows = eigenvectors
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_makediag", aliases=("linalg_makediag",))
+def _makediag(a, offset=0):
+    jnp = _jnp()
+    return jnp.vectorize(lambda v: jnp.diag(v, k=offset),
+                         signature="(n)->(m,m)")(a)
+
+
+@register("_linalg_extractdiag", aliases=("linalg_extractdiag",))
+def _extractdiag(a, offset=0):
+    return _jnp().diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_inverse", aliases=("linalg_inverse",))
+def _inverse(a):
+    return _jnp().linalg.inv(a)
+
+
+@register("_linalg_det", aliases=("linalg_det",))
+def _det(a):
+    return _jnp().linalg.det(a)
+
+
+@register("_linalg_slogdet", aliases=("linalg_slogdet",), num_outputs=2)
+def _slogdet(a):
+    sign, logdet = _jnp().linalg.slogdet(a)
+    return sign, logdet
+
+
+@register("_contrib_fft")
+def _fft(data, compute_size=128):
+    jnp = _jnp()
+    out = jnp.fft.fft(data.astype(np.complex64), axis=-1)
+    # reference returns interleaved real/imag, last dim doubled
+    return jnp.stack([out.real, out.imag], axis=-1).reshape(
+        data.shape[:-1] + (data.shape[-1] * 2,)).astype(data.dtype)
+
+
+@register("_contrib_ifft")
+def _ifft(data, compute_size=128):
+    jnp = _jnp()
+    n = data.shape[-1] // 2
+    ri = data.reshape(data.shape[:-1] + (n, 2))
+    comp = ri[..., 0] + 1j * ri[..., 1]
+    out = jnp.fft.ifft(comp, axis=-1) * n
+    return out.real.astype(data.dtype)

@@ -1,0 +1,334 @@
+"""Matrix/shape-manipulation ops.
+
+Covers the reference's `src/operator/tensor/matrix_op.cc` (reshape with
+special codes, transpose, slice family, clip, repeat, tile, reverse, stack,
+squeeze, depth/space, diag, where), `dot.cc` (dense dot/batch_dot) and the
+Concat/SliceChannel/Flatten/Pad/SwapAxis layer-ish ops from
+`src/operator/*.cc`.  All shape logic runs at trace time (static shapes —
+the XLA contract), so these lower to pure HLO reshapes/transposes that XLA
+folds into surrounding fusions.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# reshape with the reference's special codes (matrix_op.cc Reshape):
+# 0 copy, -1 infer, -2 copy-rest, -3 merge-two, -4 split
+# ---------------------------------------------------------------------------
+
+def _mx_reshape_target(in_shape: Tuple[int, ...], spec, reverse: bool = False):
+    spec = tuple(int(s) for s in spec)
+    if reverse:
+        in_shape = tuple(reversed(in_shape))
+        spec = tuple(reversed(spec))
+        # note: reverse semantics only supported for simple codes
+    out = []
+    src = 0
+    i = 0
+    known_prod = 1
+    infer_at = None
+    while i < len(spec):
+        s = spec[i]
+        if s > 0:
+            out.append(s)
+            src += 1
+        elif s == 0:
+            out.append(in_shape[src])
+            src += 1
+        elif s == -1:
+            if infer_at is not None:
+                raise MXNetError("reshape can infer at most one dimension")
+            infer_at = len(out)
+            out.append(-1)
+            src += 1
+        elif s == -2:
+            out.extend(in_shape[src:])
+            src = len(in_shape)
+        elif s == -3:
+            out.append(in_shape[src] * in_shape[src + 1])
+            src += 2
+        elif s == -4:
+            d1, d2 = spec[i + 1], spec[i + 2]
+            cur = in_shape[src]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2])
+            src += 1
+            i += 2
+        else:
+            raise MXNetError("invalid reshape code %d" % s)
+        i += 1
+    total = int(np.prod(in_shape)) if in_shape else 1
+    if infer_at is not None:
+        rest = int(np.prod([d for d in out if d != -1])) or 1
+        out[infer_at] = total // rest
+    if reverse:
+        out = list(reversed(out))
+    return tuple(out)
+
+
+@register("Reshape", aliases=("reshape",))
+def _reshape(x, shape=(), reverse=False):
+    tgt = _mx_reshape_target(tuple(x.shape), shape, reverse)
+    return _jnp().reshape(x, tgt)
+
+
+@register("reshape_like")
+def _reshape_like(x, other):
+    return _jnp().reshape(x, other.shape)
+
+
+@register("Flatten", aliases=("flatten",))
+def _flatten(x):
+    return _jnp().reshape(x, (x.shape[0], -1))
+
+
+@register("transpose")
+def _transpose(x, axes=None):
+    jnp = _jnp()
+    if axes is None or axes == ():
+        return jnp.transpose(x)
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims")
+def _expand_dims(x, axis=0):
+    return _jnp().expand_dims(x, axis)
+
+
+@register("squeeze")
+def _squeeze(x, axis=None):
+    jnp = _jnp()
+    if axis is None:
+        return jnp.squeeze(x)
+    return jnp.squeeze(x, axis if isinstance(axis, tuple) else (axis,))
+
+
+@register("SwapAxis", aliases=("swapaxes", "SwapAxes"))
+def _swapaxes(x, dim1=0, dim2=0):
+    return _jnp().swapaxes(x, dim1, dim2)
+
+
+@register("moveaxis")
+def _moveaxis(x, source=0, destination=0):
+    return _jnp().moveaxis(x, source, destination)
+
+
+@register("slice")
+def _slice(x, begin=(), end=(), step=None):
+    sl = []
+    nd = x.ndim
+    step = step or (None,) * nd
+    for i in range(nd):
+        b = begin[i] if i < len(begin) else None
+        e = end[i] if i < len(end) else None
+        s = step[i] if i < len(step) else None
+        sl.append(slice(b, e, s))
+    return x[tuple(sl)]
+
+
+@register("slice_axis")
+def _slice_axis(x, axis=0, begin=0, end=None):
+    ax = axis % x.ndim
+    sl = [slice(None)] * x.ndim
+    sl[ax] = slice(begin, end)
+    return x[tuple(sl)]
+
+
+@register("slice_like")
+def _slice_like(x, like, axes=()):
+    sl = [slice(None)] * x.ndim
+    axes = axes if axes else tuple(range(min(x.ndim, like.ndim)))
+    for a in axes:
+        sl[a % x.ndim] = slice(0, like.shape[a % like.ndim])
+    return x[tuple(sl)]
+
+
+@register("_slice_assign")
+def _slice_assign(x, value, begin=(), end=(), step=None):
+    sl = []
+    step = step or (None,) * x.ndim
+    for i in range(x.ndim):
+        sl.append(slice(begin[i] if i < len(begin) else None,
+                        end[i] if i < len(end) else None,
+                        step[i] if i < len(step) else None))
+    return x.at[tuple(sl)].set(value)
+
+
+@register("_slice_assign_scalar")
+def _slice_assign_scalar(x, scalar=0.0, begin=(), end=(), step=None):
+    sl = []
+    step = step or (None,) * x.ndim
+    for i in range(x.ndim):
+        sl.append(slice(begin[i] if i < len(begin) else None,
+                        end[i] if i < len(end) else None,
+                        step[i] if i < len(step) else None))
+    return x.at[tuple(sl)].set(scalar)
+
+
+@register("clip")
+def _clip(x, a_min=0.0, a_max=0.0):
+    return _jnp().clip(x, a_min, a_max)
+
+
+@register("repeat")
+def _repeat(x, repeats=1, axis=None):
+    return _jnp().repeat(x, repeats, axis=axis)
+
+
+@register("tile")
+def _tile(x, reps=()):
+    return _jnp().tile(x, reps)
+
+
+@register("reverse", aliases=("flip",))
+def _reverse(x, axis=()):
+    jnp = _jnp()
+    ax = axis if isinstance(axis, tuple) else (axis,)
+    return jnp.flip(x, axis=ax)
+
+
+@register("stack")
+def _stack(*args, axis=0):
+    return _jnp().stack(args, axis=axis)
+
+
+@register("Concat", aliases=("concat",))
+def _concat(*args, dim=1, num_args=None):
+    return _jnp().concatenate(args, axis=dim)
+
+
+@register("_rnn_param_concat")
+def _rnn_param_concat(*args, dim=0, num_args=None):
+    return _jnp().concatenate([a.reshape(-1) for a in args], axis=0)
+
+
+def _n_split(attrs):
+    return attrs.get("num_outputs", 1)
+
+
+@register("SliceChannel", num_outputs=_n_split, aliases=("split",))
+def _slice_channel(x, num_outputs=1, axis=1, squeeze_axis=False):
+    jnp = _jnp()
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("depth_to_space")
+def _depth_to_space(x, block_size=1):
+    jnp = _jnp()
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, b, b, c // (b * b), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return y.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def _space_to_depth(x, block_size=1):
+    jnp = _jnp()
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("diag")
+def _diag(x, k=0, axis1=0, axis2=1):
+    jnp = _jnp()
+    if x.ndim == 1:
+        return jnp.diag(x, k=k)
+    return jnp.diagonal(x, offset=k, axis1=axis1, axis2=axis2)
+
+
+@register("where")
+def _where(cond, x, y):
+    return _jnp().where(cond != 0, x, y)
+
+
+@register("one_hot", differentiable=False)
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    import jax
+
+    jnp = _jnp()
+    oh = jax.nn.one_hot(indices.astype(np.int32), depth, dtype=np_dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("Pad", aliases=("pad",))
+def _pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    jnp = _jnp()
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(x, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(x, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pw, mode="reflect")
+    raise MXNetError("unsupported pad mode %r" % mode)
+
+
+@register("Crop", aliases=("crop",))
+def _crop(x, *like, offset=(0, 0), h_w=(0, 0), num_args=1, center_crop=False):
+    h, w = (h_w if not like else like[0].shape[2:4])
+    if center_crop:
+        oh = (x.shape[2] - h) // 2
+        ow = (x.shape[3] - w) // 2
+    else:
+        oh, ow = offset
+    return x[:, :, oh:oh + h, ow:ow + w]
+
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot — the MXU path.  These map straight onto lax.dot_general,
+# which XLA tiles onto the systolic array.
+# ---------------------------------------------------------------------------
+
+@register("dot")
+def _dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    jnp = _jnp()
+    a = lhs.T if transpose_a and lhs.ndim == 2 else (
+        jnp.transpose(lhs) if transpose_a else lhs)
+    b = rhs.T if transpose_b and rhs.ndim == 2 else (
+        jnp.transpose(rhs) if transpose_b else rhs)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # reference semantics: contract last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    jnp = _jnp()
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def _khatri_rao(*args):
+    jnp = _jnp()
+    out = args[0]
+    for m in args[1:]:
+        k1, r = out.shape
+        k2, _ = m.shape
+        out = (out[:, None, :] * m[None, :, :]).reshape(k1 * k2, r)
+    return out

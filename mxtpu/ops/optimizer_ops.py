@@ -1,0 +1,200 @@
+"""Fused optimizer update ops (reference: `src/operator/optimizer_op.cc`).
+
+In the reference, optimizers ARE ops (`sgd_update`, `adam_update`...) so
+the whole update runs fused on-device.  Same design here: each update is a
+single jitted XLA computation (weight/state in, new weight/state out); the
+python `Optimizer` classes call these and write results back into the
+weight/state NDArrays — matching the reference's in-place semantics at the
+NDArray level while staying functional at the XLA level.
+
+All ops return the *new* weight first, then new states.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _rescale_clip(grad, rescale_grad, clip_gradient):
+    jnp = _jnp()
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register("sgd_update", differentiable=False)
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@register("sgd_mom_update", differentiable=False, num_outputs=2)
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", differentiable=False, num_outputs=2)
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    g = _rescale_clip(grad.astype(weight32.dtype), rescale_grad, clip_gradient)
+    new_w32 = weight32 - lr * (g + wd * weight32)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", differentiable=False, num_outputs=3)
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       lazy_update=True):
+    g = _rescale_clip(grad.astype(weight32.dtype), rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight32)
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("nag_mom_update", differentiable=False, num_outputs=2)
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    g = g + wd * weight
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("adam_update", differentiable=False, num_outputs=3)
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True):
+    jnp = _jnp()
+    g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * jnp.square(g)
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w, new_mean, new_var
+
+
+@register("ftml_update", differentiable=False, num_outputs=4)
+def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                 clip_grad=-1.0, clip_gradient=-1.0):
+    jnp = _jnp()
+    cg = clip_gradient if clip_gradient is not None and clip_gradient >= 0 else clip_grad
+    g = _rescale_clip(grad, rescale_grad, cg) + wd * weight
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma_t = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma_t * weight
+    new_w = -new_z / d_t
+    return new_w, d_t, new_v, new_z
+
+
+@register("ftrl_update", differentiable=False, num_outputs=3)
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    jnp = _jnp()
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) > lamda1,
+        -(new_z - jnp.sign(new_z) * lamda1) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd),
+        jnp.zeros_like(weight),
+    )
+    return new_w, new_z, new_n
+
+
+@register("rmsprop_update", differentiable=False, num_outputs=2)
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0):
+    jnp = _jnp()
+    g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", differentiable=False, num_outputs=4)
+def _rmspropalex_update(weight, grad, n, g_acc, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0):
+    jnp = _jnp()
+    g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_g = gamma1 * g_acc + (1 - gamma1) * g
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(
+        new_n - jnp.square(new_g) + epsilon)
+    new_w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+@register("signsgd_update", differentiable=False)
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    jnp = _jnp()
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", differentiable=False, num_outputs=2)
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    jnp = _jnp()
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
+    new_w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_w, new_mom
+
+
+@register("_sparse_adagrad_update", differentiable=False, num_outputs=2,
+          aliases=("adagrad_update",))
+def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    jnp = _jnp()
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_hist = history + jnp.square(g)
+    new_w = weight - lr * (g / (jnp.sqrt(new_hist) + epsilon) + wd * weight)
+    return new_w, new_hist
+
+
+@register("_contrib_group_adagrad_update", differentiable=False, num_outputs=2)
+def _group_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-5,
+                          rescale_grad=1.0, clip_gradient=-1.0):
+    jnp = _jnp()
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    gsq = jnp.mean(jnp.square(g), axis=tuple(range(1, g.ndim))) if g.ndim > 1 \
+        else jnp.square(g)
+    new_hist = history + gsq
+    scale = jnp.sqrt(new_hist) + epsilon
+    bshape = (-1,) + (1,) * (g.ndim - 1)
+    new_w = weight - lr * g / scale.reshape(bshape)
+    return new_w, new_hist
+
+
+@register("adadelta_update", differentiable=False, num_outputs=3)
+def _adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
+                     wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lr=1.0):
+    jnp = _jnp()
+    g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    return weight - delta, new_acc_g, new_acc_delta

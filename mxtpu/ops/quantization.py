@@ -1,0 +1,152 @@
+"""INT8 quantization ops (reference: `src/operator/quantization/*`).
+
+TPU v5e has native int8 matmul throughput; quantized conv/FC here compute
+in int8 with int32 accumulation via `lax.dot_general`/conv with
+preferred_element_type — the analog of the reference's cuDNN/MKLDNN int8
+kernels.  Calibration (entropy/naive) lives in `mxtpu.contrib.quantization`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _quant_range(out_type="int8"):
+    if out_type == "uint8":
+        return 0.0, 255.0
+    return -127.0, 127.0
+
+
+@register("_contrib_quantize", num_outputs=3, differentiable=False)
+def _quantize(data, min_range, max_range, out_type="int8"):
+    jnp = _jnp()
+    qmin, qmax = _quant_range(out_type)
+    scale = (qmax - qmin) / jnp.maximum(max_range - min_range, 1e-12)
+    q = jnp.clip(jnp.round((data - min_range) * scale + qmin), qmin, qmax)
+    return q.astype(np.int8 if out_type == "int8" else np.uint8), \
+        min_range, max_range
+
+
+@register("_contrib_quantize_v2", num_outputs=3, differentiable=False)
+def _quantize_v2(data, out_type="int8", min_calib_range=None,
+                 max_calib_range=None):
+    jnp = _jnp()
+    lo = min_calib_range if min_calib_range is not None else float(0.0)
+    if min_calib_range is None:
+        lo = data.min()
+        hi = data.max()
+    else:
+        lo = jnp.asarray(min_calib_range, data.dtype)
+        hi = jnp.asarray(max_calib_range, data.dtype)
+    qmin, qmax = _quant_range(out_type)
+    scale = (qmax - qmin) / jnp.maximum(hi - lo, 1e-12)
+    q = jnp.clip(jnp.round((data - lo) * scale + qmin), qmin, qmax)
+    return q.astype(np.int8 if out_type == "int8" else np.uint8), lo, hi
+
+
+@register("_contrib_dequantize", differentiable=False)
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    jnp = _jnp()
+    if data.dtype == np.uint8:
+        qmin, qmax = 0.0, 255.0
+    else:
+        qmin, qmax = -127.0, 127.0
+    scale = (max_range - min_range) / (qmax - qmin)
+    return (data.astype(np.float32) - qmin) * scale + min_range
+
+
+@register("_contrib_requantize", num_outputs=3, differentiable=False)
+def _requantize(data, min_range, max_range, out_type="int8",
+                min_calib_range=None, max_calib_range=None):
+    jnp = _jnp()
+    # int32 -> int8 with new range
+    real = data.astype(np.float32) * (
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / (2 ** 31 - 1))
+    if min_calib_range is not None:
+        lo, hi = min_calib_range, max_calib_range
+    else:
+        lo, hi = real.min(), real.max()
+    scale = 127.0 / jnp.maximum(jnp.maximum(jnp.abs(lo), jnp.abs(hi)), 1e-12)
+    q = jnp.clip(jnp.round(real * scale), -127, 127).astype(np.int8)
+    return q, jnp.asarray(lo, np.float32), jnp.asarray(hi, np.float32)
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3,
+          differentiable=False)
+def _quantized_fc(data, weight, bias, min_data, max_data, min_weight,
+                  max_weight, min_bias=None, max_bias=None, num_hidden=0,
+                  no_bias=False, flatten=True):
+    import jax
+
+    jnp = _jnp()
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    # int8 x int8 -> int32 accumulate (MXU int8 path)
+    acc = jax.lax.dot_general(
+        x.astype(np.int8), weight.astype(np.int8).T,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=np.int32)
+    if not no_bias and bias is not None:
+        acc = acc + bias.astype(np.int32)
+    out_min = -(2.0 ** 31)
+    out_max = 2.0 ** 31
+    return acc, jnp.asarray(out_min, np.float32), jnp.asarray(out_max, np.float32)
+
+
+@register("_contrib_quantized_conv", num_outputs=3, differentiable=False)
+def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                    max_weight, min_bias=None, max_bias=None, kernel=(),
+                    stride=(), dilate=(), pad=(), num_filter=0, num_group=1,
+                    no_bias=False, workspace=1024, layout=None,
+                    cudnn_tune=None, cudnn_off=False):
+    import jax
+
+    jnp = _jnp()
+    from .nn import _conv_dnums, _norm_tuple
+
+    lax = jax.lax
+    ns = len(kernel)
+    stride = _norm_tuple(stride, ns, 1)
+    dilate = _norm_tuple(dilate, ns, 1)
+    pad = _norm_tuple(pad, ns, 0)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dnums(ns))
+    acc = lax.conv_general_dilated(
+        data.astype(np.int8), weight.astype(np.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        lhs_dilation=(1,) * ns, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=np.int32)
+    if not no_bias and bias is not None:
+        acc = acc + bias.astype(np.int32).reshape((1, -1) + (1,) * ns)
+    return acc, jnp.asarray(-(2.0 ** 31), np.float32), \
+        jnp.asarray(2.0 ** 31, np.float32)
+
+
+@register("_contrib_quantized_pooling", num_outputs=3, differentiable=False)
+def _quantized_pooling(data, min_data, max_data, **attrs):
+    from .nn import _pooling
+
+    out = _pooling(data.astype(np.float32), **attrs)
+    return out.astype(data.dtype), min_data, max_data
+
+
+@register("_contrib_quantized_flatten", num_outputs=3, differentiable=False)
+def _quantized_flatten(data, min_data, max_data):
+    return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register("_contrib_quantized_concat", num_outputs=3, differentiable=False)
+def _quantized_concat(*args, dim=1, num_args=None):
+    jnp = _jnp()
+    n = len(args) // 3
+    datas = args[:n]
+    mins = args[n:2 * n]
+    maxs = args[2 * n:]
+    out = jnp.concatenate(datas, axis=dim)
+    return out, jnp.min(jnp.stack(mins)), jnp.max(jnp.stack(maxs))

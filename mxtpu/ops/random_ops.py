@@ -1,0 +1,229 @@
+"""Random sampling ops (reference: `src/operator/random/*`).
+
+The reference keeps per-device RNG states
+(`include/mxnet/random_generator.h`); here every sampler is a *stateless*
+XLA PRNG (threefry) call — the framework-level key chain lives in
+`mxtpu.random` and a fresh subkey is threaded into each op call by the
+imperative layer (`needs_rng=True`), keeping `mx.random.seed()` semantics.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import np_dtype
+from .registry import register
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _shape_dtype(shape, dtype):
+    shape = tuple(shape) if shape else ()
+    return shape, np_dtype(dtype or "float32")
+
+
+@register("_random_uniform", needs_rng=True, differentiable=False,
+          aliases=("uniform", "random_uniform"))
+def _random_uniform(key, low=0.0, high=1.0, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    return jax.random.uniform(key, shape, dtype=dt, minval=low, maxval=high)
+
+
+@register("_random_normal", needs_rng=True, differentiable=False,
+          aliases=("normal", "random_normal"))
+def _random_normal(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    return jax.random.normal(key, shape, dtype=dt) * scale + loc
+
+
+@register("_random_gamma", needs_rng=True, differentiable=False,
+          aliases=("random_gamma",))
+def _random_gamma(key, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    return jax.random.gamma(key, alpha, shape, dtype=dt) * beta
+
+
+@register("_random_exponential", needs_rng=True, differentiable=False,
+          aliases=("random_exponential",))
+def _random_exponential(key, lam=1.0, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    return jax.random.exponential(key, shape, dtype=dt) / lam
+
+
+@register("_random_poisson", needs_rng=True, differentiable=False,
+          aliases=("random_poisson",))
+def _random_poisson(key, lam=1.0, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    return jax.random.poisson(key, lam, shape).astype(dt)
+
+
+@register("_random_negative_binomial", needs_rng=True, differentiable=False,
+          aliases=("random_negative_binomial",))
+def _random_negative_binomial(key, k=1, p=1.0, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(dt)
+
+
+@register("_random_generalized_negative_binomial", needs_rng=True,
+          differentiable=False,
+          aliases=("random_generalized_negative_binomial",))
+def _random_gen_neg_binomial(key, mu=1.0, alpha=1.0, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(dt)
+
+
+@register("_random_randint", needs_rng=True, differentiable=False,
+          aliases=("random_randint",))
+def _random_randint(key, low=0, high=1, shape=(), dtype="int32"):
+    jax = _jax()
+    shape, _ = _shape_dtype(shape, None)
+    return jax.random.randint(key, shape, int(low), int(high)).astype(
+        np_dtype(dtype or "int32"))
+
+
+# *_like family
+def _like(name, base):
+    @register(name, needs_rng=True, differentiable=False)
+    def _op(key, data, **attrs):
+        attrs.pop("shape", None)
+        from .registry import get_op
+
+        return get_op(base).fn(key, shape=data.shape,
+                               dtype=np.dtype(data.dtype).name, **attrs)
+
+    return _op
+
+
+_like("_random_uniform_like", "_random_uniform")
+_like("_random_normal_like", "_random_normal")
+_like("_random_gamma_like", "_random_gamma")
+_like("_random_exponential_like", "_random_exponential")
+_like("_random_poisson_like", "_random_poisson")
+_like("_random_negative_binomial_like", "_random_negative_binomial")
+_like("_random_generalized_negative_binomial_like",
+      "_random_generalized_negative_binomial")
+
+
+# parameterized multisample family (reference `multisample_op.cc`): per-row
+# distribution parameters
+@register("_sample_uniform", needs_rng=True, differentiable=False)
+def _sample_uniform(key, low, high, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    u = jax.random.uniform(key, low.shape + shape, dtype=dt)
+    return low.reshape(low.shape + (1,) * len(shape)) + u * (
+        high - low).reshape(low.shape + (1,) * len(shape))
+
+
+@register("_sample_normal", needs_rng=True, differentiable=False)
+def _sample_normal(key, mu, sigma, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    z = jax.random.normal(key, mu.shape + shape, dtype=dt)
+    exp = mu.shape + (1,) * len(shape)
+    return mu.reshape(exp) + z * sigma.reshape(exp)
+
+
+@register("_sample_gamma", needs_rng=True, differentiable=False)
+def _sample_gamma(key, alpha, beta, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    exp = alpha.shape + (1,) * len(shape)
+    g = jax.random.gamma(key, alpha.reshape(exp), alpha.shape + shape, dtype=dt)
+    return g * beta.reshape(exp)
+
+
+@register("_sample_exponential", needs_rng=True, differentiable=False)
+def _sample_exponential(key, lam, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    e = jax.random.exponential(key, lam.shape + shape, dtype=dt)
+    return e / lam.reshape(lam.shape + (1,) * len(shape))
+
+
+@register("_sample_poisson", needs_rng=True, differentiable=False)
+def _sample_poisson(key, lam, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    p = jax.random.poisson(key, lam.reshape(lam.shape + (1,) * len(shape)),
+                           lam.shape + shape)
+    return p.astype(dt)
+
+
+@register("_sample_negative_binomial", needs_rng=True, differentiable=False)
+def _sample_negative_binomial(key, k, p, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    exp = k.shape + (1,) * len(shape)
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k.reshape(exp), k.shape + shape) * (
+        (1 - p) / p).reshape(exp)
+    return jax.random.poisson(k2, lam, k.shape + shape).astype(dt)
+
+
+@register("_sample_generalized_negative_binomial", needs_rng=True,
+          differentiable=False)
+def _sample_gen_neg_binomial(key, mu, alpha, shape=(), dtype="float32"):
+    jax = _jax()
+    shape, dt = _shape_dtype(shape, dtype)
+    exp = mu.shape + (1,) * len(shape)
+    r = 1.0 / alpha.reshape(exp)
+    p = r / (r + mu.reshape(exp))
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, r, mu.shape + shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, mu.shape + shape).astype(dt)
+
+
+@register("_sample_multinomial", needs_rng=True, differentiable=False,
+          aliases=("sample_multinomial",))
+def _sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
+    jax = _jax()
+    import jax.numpy as jnp
+
+    n = shape if isinstance(shape, int) else (shape[0] if shape else 1)
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    sampled = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(int(n),) + data.shape[:-1])
+    out = jnp.moveaxis(sampled, 0, -1).astype(np_dtype(dtype))
+    if data.ndim == 1:
+        out = out.reshape(-1)
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits),
+            out.astype(jnp.int32).reshape(data.shape[:-1] + (-1,)), axis=-1)
+        return out, lp.reshape(out.shape)
+    return out
+
+
+@register("_sample_unique_zipfian", needs_rng=True, differentiable=False)
+def _sample_unique_zipfian(key, range_max=1, shape=()):
+    jax = _jax()
+    import jax.numpy as jnp
+
+    shape, _ = _shape_dtype(shape, None)
+    u = jax.random.uniform(key, shape)
+    cls = (jnp.exp(u * np.log(range_max + 1.0)) - 1.0).astype(np.int64)
+    return jnp.clip(cls, 0, range_max - 1)
+
+
+@register("_shuffle", needs_rng=True, differentiable=False,
+          aliases=("shuffle",))
+def _shuffle_op(key, data):
+    jax = _jax()
+    return jax.random.permutation(key, data, axis=0)

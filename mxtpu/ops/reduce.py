@@ -1,0 +1,98 @@
+"""Reduction ops (reference: `src/operator/tensor/broadcast_reduce_op_*.cc`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _axes(axis, exclude=False, ndim=None):
+    if axis is None:
+        ax = None
+    elif isinstance(axis, int):
+        ax = (axis,)
+    else:
+        ax = tuple(axis)
+    if exclude and ax is not None:
+        ax = tuple(i for i in range(ndim) if i not in {a % ndim for a in ax})
+    return ax
+
+
+def _reduce_op(name, f, differentiable=True):
+    @register(name, differentiable=differentiable)
+    def _op(x, axis=None, keepdims=False, exclude=False, __f=f):
+        jnp = _jnp()
+        ax = _axes(axis, exclude, x.ndim)
+        return __f(jnp, x, ax, keepdims)
+
+    _op.__name__ = name
+    return _op
+
+
+_reduce_op("sum", lambda jnp, x, ax, kd: jnp.sum(x, axis=ax, keepdims=kd))
+_reduce_op("mean", lambda jnp, x, ax, kd: jnp.mean(x, axis=ax, keepdims=kd))
+_reduce_op("prod", lambda jnp, x, ax, kd: jnp.prod(x, axis=ax, keepdims=kd))
+_reduce_op("nansum", lambda jnp, x, ax, kd: jnp.nansum(x, axis=ax, keepdims=kd))
+_reduce_op("nanprod", lambda jnp, x, ax, kd: jnp.nanprod(x, axis=ax, keepdims=kd))
+_reduce_op("max", lambda jnp, x, ax, kd: jnp.max(x, axis=ax, keepdims=kd))
+_reduce_op("min", lambda jnp, x, ax, kd: jnp.min(x, axis=ax, keepdims=kd))
+
+
+@register("norm")
+def _norm(x, ord=2, axis=None, keepdims=False, out_dtype=None):
+    jnp = _jnp()
+    if isinstance(axis, (list, tuple)) and len(axis) == 1:
+        axis = axis[0]
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    if ord == 2:
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+    # reference supports only ord=1,2 (broadcast_reduce_op norm)
+    raise ValueError("norm only supports ord=1 or ord=2, got %r" % (ord,))
+
+
+@register("_square_sum")
+def _square_sum(x, axis=None, keepdims=False):
+    jnp = _jnp()
+    ax = _axes(axis)
+    return jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims)
+
+
+@register("argmax", differentiable=False)
+def _argmax(x, axis=None, keepdims=False):
+    jnp = _jnp()
+    res = jnp.argmax(x, axis=axis, keepdims=keepdims)
+    return res.astype(np.float32)  # reference returns real_t indices
+
+
+@register("argmin", differentiable=False)
+def _argmin(x, axis=None, keepdims=False):
+    jnp = _jnp()
+    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(np.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(x):
+    jnp = _jnp()
+    return jnp.argmax(x, axis=1).astype(np.float32)
+
+
+@register("pick")
+def _pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    jnp = _jnp()
+    ax = axis % x.ndim
+    idx = index.astype(np.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, x.shape[ax])
+    else:
+        idx = jnp.clip(idx, 0, x.shape[ax] - 1)
+    picked = jnp.take_along_axis(x, jnp.expand_dims(idx, ax), axis=ax)
+    if not keepdims:
+        picked = jnp.squeeze(picked, axis=ax)
+    return picked

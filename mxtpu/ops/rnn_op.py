@@ -1,0 +1,179 @@
+"""Fused multi-layer RNN op (reference: `src/operator/rnn.cc`,
+`rnn_impl.h`, `cudnn_rnn-inl.h`).
+
+The reference keeps a cuDNN-stateful operator; TPU-native design is a pure
+function: parameters arrive as the same flat cuDNN-layout vector (so
+Gluon `rnn_layer.py`-style packing round-trips), the input projection for
+the whole sequence is batched into ONE big matmul (MXU-friendly: (T*N, I) @
+(I, G*H)), and only the hidden recurrence runs under `lax.scan` (static
+trip count — XLA-compatible control flow).
+
+Param layout per layer l, direction d (cuDNN order, gates G):
+  weights: W_x (G*H, in), W_h (G*H, H)  for all (l, d); then
+  biases:  b_x (G*H),    b_h (G*H)      for all (l, d).
+Gate order: LSTM i,f,g,o; GRU r,z,n (cuDNN convention, as the reference).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(input_size: int, state_size: int, num_layers: int,
+                   bidirectional: bool, mode: str) -> int:
+    """Total flat parameter count (matches reference rnn-inl.h GetParamSize)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    size = 0
+    for l in range(num_layers):
+        in_sz = input_size if l == 0 else state_size * d
+        size += d * (g * state_size * (in_sz + state_size) + 2 * g * state_size)
+    return size
+
+
+def _unpack_params(params, input_size, state_size, num_layers, bidirectional,
+                   mode):
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    ws, bs = [], []
+    off = 0
+    for l in range(num_layers):
+        in_sz = input_size if l == 0 else h * d
+        layer = []
+        for _dir in range(d):
+            wx = params[off:off + g * h * in_sz].reshape(g * h, in_sz)
+            off += g * h * in_sz
+            wh = params[off:off + g * h * h].reshape(g * h, h)
+            off += g * h * h
+            layer.append((wx, wh))
+        ws.append(layer)
+    for l in range(num_layers):
+        layer = []
+        for _dir in range(d):
+            bx = params[off:off + g * h]
+            off += g * h
+            bh = params[off:off + g * h]
+            off += g * h
+            layer.append((bx, bh))
+        bs.append(layer)
+    return ws, bs
+
+
+def _cell_step(mode, h):
+    import jax
+    import jax.numpy as jnp
+
+    if mode == "lstm":
+        def step(carry, xproj, wh, bh):
+            hprev, cprev = carry
+            gates = xproj + hprev @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c = f * cprev + i * g
+            hnew = o * jnp.tanh(c)
+            return (hnew, c), hnew
+    elif mode == "gru":
+        def step(carry, xproj, wh, bh):
+            (hprev,) = carry
+            hproj = hprev @ wh.T + bh
+            xr, xz, xn = jnp.split(xproj, 3, axis=-1)
+            hr, hz, hn = jnp.split(hproj, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            hnew = (1.0 - z) * n + z * hprev
+            return (hnew,), hnew
+    else:
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+
+        def step(carry, xproj, wh, bh):
+            (hprev,) = carry
+            hnew = act(xproj + hprev @ wh.T + bh)
+            return (hnew,), hnew
+    return step
+
+
+def _run_direction(mode, x, h0, c0, wx, wh, bx, bh, reverse):
+    """x: (T, N, in) -> (T, N, H), h_T, c_T."""
+    import jax
+    import jax.numpy as jnp
+
+    t, n, in_sz = x.shape
+    gh = wx.shape[0]
+    # batched input projection: one big matmul over the whole sequence
+    xproj = (x.reshape(t * n, in_sz) @ wx.T + bx).reshape(t, n, gh)
+    if reverse:
+        xproj = jnp.flip(xproj, axis=0)
+    step = _cell_step(mode, h0)
+    carry0 = (h0, c0) if mode == "lstm" else (h0,)
+
+    def body(carry, xp):
+        return step(carry, xp, wh, bh)
+
+    carry, outs = jax.lax.scan(body, carry0, xproj)
+    if reverse:
+        outs = jnp.flip(outs, axis=0)
+    h_t = carry[0]
+    c_t = carry[1] if mode == "lstm" else None
+    return outs, h_t, c_t
+
+
+def _rnn_num_outputs(attrs):
+    if not attrs.get("state_outputs", False):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+@register("RNN", num_outputs=_rnn_num_outputs, needs_rng=True,
+          train_aware=True)
+def _rnn(key, data, parameters, state, *maybe_cell, state_size=0,
+         num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+         state_outputs=False, projection_size=None, lstm_state_clip_min=None,
+         lstm_state_clip_max=None, lstm_state_clip_nan=False, is_train=False):
+    import jax
+    import jax.numpy as jnp
+
+    if mode not in _GATES:
+        raise MXNetError("unknown RNN mode %r" % mode)
+    t, n, input_size = data.shape
+    d = 2 if bidirectional else 1
+    h = state_size
+    ws, bs = _unpack_params(parameters, input_size, h, num_layers,
+                            bidirectional, mode)
+    cell = maybe_cell[0] if (mode == "lstm" and maybe_cell) else None
+
+    x = data
+    h_finals, c_finals = [], []
+    for l in range(num_layers):
+        outs_dir = []
+        for di in range(d):
+            sidx = l * d + di
+            h0 = state[sidx]
+            c0 = cell[sidx] if cell is not None else None
+            wx, wh = ws[l][di]
+            bx, bh = bs[l][di]
+            outs, h_t, c_t = _run_direction(mode, x, h0, c0, wx, wh, bx, bh,
+                                            reverse=(di == 1))
+            outs_dir.append(outs)
+            h_finals.append(h_t)
+            if c_t is not None:
+                c_finals.append(c_t)
+        x = outs_dir[0] if d == 1 else jnp.concatenate(outs_dir, axis=-1)
+        if is_train and p > 0.0 and l < num_layers - 1:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1.0 - p, x.shape).astype(x.dtype)
+            x = x * mask / (1.0 - p)
+
+    if not state_outputs:
+        return x
+    h_out = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        c_out = jnp.stack(c_finals, axis=0)
+        return x, h_out, c_out
+    return x, h_out

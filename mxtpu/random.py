@@ -1,0 +1,143 @@
+"""Framework RNG state.
+
+Re-design of the reference's per-device RNG
+(`include/mxnet/random_generator.h`, `python/mxnet/random.py`): the
+reference seeds per-device Mersenne/cuRAND states; here a single threefry
+key chain feeds *stateless* XLA PRNG ops — `seed()` resets the chain, and
+each random op call consumes a fresh subkey (split on the host, used on
+device), so results are reproducible for a fixed seed and op sequence.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .base import getenv_int
+
+__all__ = ["seed", "uniform", "normal", "randint", "randn", "exponential",
+           "poisson", "gamma", "negative_binomial",
+           "generalized_negative_binomial", "multinomial", "shuffle",
+           "get_state"]
+
+_lock = threading.Lock()
+_key = None
+_seed_value = getenv_int("MXNET_TEST_SEED", 0) or None
+
+
+def seed(seed_state: int, ctx=None):
+    """Seed the global generator (reference `mx.random.seed`)."""
+    global _key, _seed_value
+    import jax
+
+    with _lock:
+        _seed_value = int(seed_state)
+        _key = jax.random.PRNGKey(_seed_value)
+
+
+def _next_key():
+    """Split a fresh subkey off the chain (called by the imperative layer
+    for every `needs_rng` op)."""
+    global _key
+    import jax
+
+    with _lock:
+        if _key is None:
+            _key = jax.random.PRNGKey(np.random.randint(0, 2 ** 31 - 1)
+                                      if _seed_value is None else _seed_value)
+        _key, sub = jax.random.split(_key)
+        return sub
+
+
+def get_state():
+    return _key
+
+
+# -- convenience samplers mirroring `mx.random.*` (reference
+#    python/mxnet/random.py; these route through the registered ops) ------
+
+def _shape(shape):
+    if shape is None or shape == ():
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def _invoke(name, **kwargs):
+    from .ndarray.ndarray import imperative_invoke
+
+    out = kwargs.pop("out", None)
+    return imperative_invoke(name, out=out, **kwargs)[0]
+
+
+def uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _invoke("_random_uniform", low=float(low), high=float(high),
+                   shape=_shape(shape), dtype=dtype, ctx=ctx, out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _invoke("_random_normal", loc=float(loc), scale=float(scale),
+                   shape=_shape(shape),
+                   dtype=dtype, ctx=ctx, out=out)
+
+
+def randn(*shape, dtype="float32", ctx=None):
+    return normal(0.0, 1.0, shape=tuple(shape) or (1,), dtype=dtype, ctx=ctx)
+
+
+def randint(low, high=None, shape=(1,), dtype="int32", ctx=None, out=None):
+    if high is None:
+        low, high = 0, low
+    return _invoke("_random_randint", low=int(low), high=int(high),
+                   shape=_shape(shape),
+                   dtype=dtype, ctx=ctx, out=out)
+
+
+def exponential(scale=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _invoke("_random_exponential", lam=1.0 / float(scale),
+                   shape=_shape(shape),
+                   dtype=dtype, ctx=ctx, out=out)
+
+
+def poisson(lam=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _invoke("_random_poisson", lam=float(lam),
+                   shape=_shape(shape),
+                   dtype=dtype, ctx=ctx, out=out)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _invoke("_random_gamma", alpha=float(alpha), beta=float(beta),
+                   shape=_shape(shape),
+                   dtype=dtype, ctx=ctx, out=out)
+
+
+def negative_binomial(k=1, p=1.0, shape=(), dtype="float32", ctx=None,
+                      out=None):
+    return _invoke("_random_negative_binomial", k=int(k), p=float(p),
+                   shape=_shape(shape),
+                   dtype=dtype, ctx=ctx, out=out)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(), dtype="float32",
+                                  ctx=None, out=None):
+    return _invoke("_random_generalized_negative_binomial", mu=float(mu),
+                   alpha=float(alpha),
+                   shape=_shape(shape),
+                   dtype=dtype, ctx=ctx, out=out)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", out=None):
+    from .ndarray.ndarray import imperative_invoke
+
+    res = imperative_invoke("_sample_multinomial", data,
+                            shape=shape if shape else 1, get_prob=get_prob,
+                            dtype=dtype, out=out)
+    return res if get_prob else res[0]
+
+
+def shuffle(data, out=None):
+    from .ndarray.ndarray import imperative_invoke
+
+    return imperative_invoke("_shuffle", data, out=out)[0]

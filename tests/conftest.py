@@ -1,0 +1,41 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (SURVEY.md §4: the reference's
+CPU-vs-GPU consistency + single-host multi-device kvstore tests map to a
+forced-CPU multi-device JAX platform here).  Must run before jax init.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# the axon sitecustomize imports jax at interpreter start, so the env var is
+# already captured — override through the config API before backend init.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything(request):
+    """Per-test deterministic seeding (reference:
+    `tests/python/unittest/common.py:113-169` with_seed())."""
+    seed = int(os.environ.get("MXTPU_TEST_SEED",
+                              os.environ.get("MXNET_TEST_SEED", "0")) or 0)
+    if seed == 0:
+        seed = abs(hash(request.node.nodeid)) % (2 ** 31 - 1)
+    np.random.seed(seed)
+    import mxtpu
+
+    mxtpu.random.seed(seed)
+    yield

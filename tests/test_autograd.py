@@ -1,0 +1,152 @@
+"""Autograd tape tests (reference analog: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import nd, autograd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_chain():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = y.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.exp(x.asnumpy()), rtol=1e-5)
+
+
+def test_two_inputs():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [3.0, 4.0])
+    np.testing.assert_allclose(b.grad.asnumpy(), [1.0, 2.0])
+
+
+def test_reuse_variable():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x  # two tape nodes reusing x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [12.0])
+
+
+def test_head_grad():
+    x = nd.array([1.0, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 20.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [30.0, 60.0])
+
+
+def test_matmul_grad():
+    a_np = np.random.randn(3, 4).astype(np.float32)
+    b_np = np.random.randn(4, 2).astype(np.float32)
+    a, b = nd.array(a_np), nd.array(b_np)
+    a.attach_grad()
+    with autograd.record():
+        out = nd.dot(a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(),
+                               np.ones((3, 2)) @ b_np.T, rtol=1e-5)
+
+
+def test_no_record_no_grad():
+    x = nd.array([1.0])
+    x.attach_grad()
+    y = x * 2  # not recorded
+    assert getattr(y, "_entry", None) is None
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])  # only d(y_detached*x)/dx
+
+
+def test_grad_function():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    (g,) = autograd.grad([y], [x])
+    np.testing.assert_allclose(g.asnumpy(), [6.0])
+
+
+def test_train_mode_flags():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+
+
+def test_dropout_train_vs_predict():
+    x = nd.ones((1000,))
+    with autograd.record():
+        y = nd.Dropout(x, p=0.5)
+    frac_zero = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    y2 = nd.Dropout(x, p=0.5)  # not recording -> identity
+    np.testing.assert_allclose(y2.asnumpy(), x.asnumpy())
+
+
+def test_softmax_output_grad():
+    x = nd.array(np.random.randn(4, 3).astype(np.float32))
+    label = nd.array([0.0, 1.0, 2.0, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    p = np.exp(x.asnumpy()) / np.exp(x.asnumpy()).sum(1, keepdims=True)
+    oh = np.eye(3)[label.asnumpy().astype(int)]
+    np.testing.assert_allclose(x.grad.asnumpy(), p - oh, rtol=1e-4, atol=1e-5)
+
+
+def test_sgd_update_op():
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.1, 0.1])
+    new_w = nd.sgd_update(w, g, lr=1.0, wd=0.0)
+    np.testing.assert_allclose(new_w.asnumpy(), [0.9, 1.9], rtol=1e-6)
+
+
+def test_numeric_gradient_check():
+    from mxtpu.ndarray.ndarray import imperative_invoke
+
+    x_np = np.random.rand(5).astype(np.float32) + 0.5
+    x = nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = (nd.log(x) * nd.sqrt(x)).sum()
+    y.backward()
+    eps = 1e-3
+    num = np.zeros_like(x_np)
+    for i in range(5):
+        xp = x_np.copy()
+        xm = x_np.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        f = lambda v: (np.log(v) * np.sqrt(v)).sum()
+        num[i] = (f(xp) - f(xm)) / (2 * eps)
+    np.testing.assert_allclose(x.grad.asnumpy(), num, rtol=1e-2, atol=1e-3)

@@ -1,0 +1,180 @@
+"""NDArray basics (reference analog: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def test_create_and_asnumpy():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    np.testing.assert_array_equal(a.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_zeros_ones_full_arange_eye():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    np.testing.assert_allclose(nd.full((2,), 3.5).asnumpy(), [3.5, 3.5])
+    np.testing.assert_allclose(nd.arange(0, 5).asnumpy(), np.arange(0, 5,
+                                                                    dtype=np.float32))
+    np.testing.assert_allclose(nd.eye(3).asnumpy(), np.eye(3))
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).asnumpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).asnumpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).asnumpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).asnumpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a + 1).asnumpy(), [2, 3, 4])
+    np.testing.assert_allclose((1 - a).asnumpy(), [0, -1, -2])
+    np.testing.assert_allclose((2 / a).asnumpy(), [2, 1, 2 / 3], rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).asnumpy(), [1, 4, 9])
+    np.testing.assert_allclose((-a).asnumpy(), [-1, -2, -3])
+
+
+def test_broadcast_arith():
+    a = nd.ones((2, 3))
+    b = nd.array([[1.0], [2.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[2, 2, 2], [3, 3, 3]])
+
+
+def test_inplace_ops():
+    a = nd.ones((3,))
+    a += 2
+    np.testing.assert_allclose(a.asnumpy(), [3, 3, 3])
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), [6, 6, 6])
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(a[1:3, 0].asnumpy(), [4, 8])
+    a[0, 0] = 100.0
+    assert a.asnumpy()[0, 0] == 100.0
+    a[:] = 0
+    assert a.asnumpy().sum() == 0
+
+
+def test_reshape_specials():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+
+
+def test_transpose_dims():
+    a = nd.array(np.arange(6).reshape(2, 3))
+    assert a.T.shape == (3, 2)
+    assert a.expand_dims(0).shape == (1, 2, 3)
+    assert a.expand_dims(0).squeeze(0).shape == (2, 3)
+    assert nd.zeros((2, 1, 3)).squeeze().shape == (2, 3)
+
+
+def test_reductions():
+    x = np.random.randn(3, 4).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(a.sum().asnumpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(a.mean(axis=0).asnumpy(), x.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(a.max(axis=1).asnumpy(), x.max(1), rtol=1e-5)
+    np.testing.assert_allclose(a.argmax(axis=1).asnumpy(), x.argmax(1))
+    np.testing.assert_allclose(a.norm().asnumpy(), np.linalg.norm(x), rtol=1e-5)
+
+
+def test_dot():
+    x = np.random.randn(3, 4).astype(np.float32)
+    y = np.random.randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(nd.dot(nd.array(x), nd.array(y)).asnumpy(),
+                               x @ y, rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(x), nd.array(y.T), transpose_b=True).asnumpy(),
+        x @ y, rtol=1e-5)
+
+
+def test_concat_stack_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    assert nd.concat(a, b, dim=0).shape == (4, 3)
+    assert nd.concat(a, b, dim=1).shape == (2, 6)
+    assert nd.stack(a, b, axis=0).shape == (2, 2, 3)
+    parts = nd.split(nd.ones((2, 6)), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+
+
+def test_astype_copy():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[0] = 99.0
+    assert a.asnumpy()[0] == 1.5
+
+
+def test_context_movement():
+    a = nd.ones((2, 2), ctx=mx.cpu())
+    assert a.ctx.device_type == "cpu"
+    b = a.as_in_context(mx.cpu(0))
+    assert b is a
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_allclose((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose((a == b).asnumpy(), [0, 1, 0])
+    np.testing.assert_allclose((a <= 2).asnumpy(), [1, 1, 0])
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs")
+    nd.save(fname, [nd.ones((2,)), nd.zeros((3,))])
+    lst = nd.load(fname)
+    assert isinstance(lst, list) and len(lst) == 2
+    nd.save(fname, {"w": nd.ones((2, 2))})
+    d = nd.load(fname)
+    assert "w" in d and d["w"].shape == (2, 2)
+
+
+def test_take_embedding_gather():
+    w = nd.array(np.arange(12).reshape(4, 3))
+    idx = nd.array([0, 2])
+    out = nd.take(w, idx)
+    np.testing.assert_allclose(out.asnumpy(), [[0, 1, 2], [6, 7, 8]])
+    emb = nd.Embedding(idx, w, input_dim=4, output_dim=3)
+    np.testing.assert_allclose(emb.asnumpy(), [[0, 1, 2], [6, 7, 8]])
+
+
+def test_topk_sort():
+    x = nd.array([[3.0, 1.0, 2.0]])
+    np.testing.assert_allclose(nd.topk(x, k=2).asnumpy(), [[0, 2]])
+    np.testing.assert_allclose(nd.sort(x).asnumpy(), [[1, 2, 3]])
+    np.testing.assert_allclose(nd.argsort(x).asnumpy(), [[1, 2, 0]])
+
+
+def test_where_clip():
+    cond = nd.array([1.0, 0.0, 1.0])
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([-1.0, -2.0, -3.0])
+    np.testing.assert_allclose(nd.where(cond, a, b).asnumpy(), [1, -2, 3])
+    np.testing.assert_allclose(nd.clip(a, 1.5, 2.5).asnumpy(), [1.5, 2, 2.5])
+
+
+def test_random_reproducible():
+    mx.random.seed(42)
+    a = mx.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = mx.random.uniform(shape=(5,)).asnumpy()
+    np.testing.assert_allclose(a, b)
+    assert ((a >= 0) & (a < 1)).all()
+
+
+def test_one_hot():
+    out = nd.one_hot(nd.array([0, 2]), depth=3)
+    np.testing.assert_allclose(out.asnumpy(), [[1, 0, 0], [0, 0, 1]])
