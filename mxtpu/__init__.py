@@ -33,9 +33,12 @@ from .random import seed  # noqa: F401  (mx.random.seed also via mx.seed? keep p
 
 from .ndarray import NDArray
 
-# Higher layers (symbol, gluon, module, kvstore, io...) are imported lazily
-# at the bottom as they land — import order matters: everything above is the
-# core substrate.
+# Higher layers — import order matters: everything above is the core
+# substrate.
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from .executor import Executor
 
 
 def tpu_count():

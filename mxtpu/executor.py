@@ -1,0 +1,352 @@
+"""Executor: whole-graph XLA lowering of a bound Symbol.
+
+TPU-native re-design of the reference's GraphExecutor
+(`src/executor/graph_executor.cc`).  The reference binds a Symbol by
+planning memory, attaching per-node engine ops, and pushing them one by
+one (`RunOps`, graph_executor.cc:1317).  Here binding lowers the ENTIRE
+graph to jitted XLA computations (the BASELINE.json north star):
+
+  * inference: one XLA module  args, aux, key -> outputs
+  * training:  one *fused* module  args, aux, key, ograds ->
+               (outputs, grads, new_aux)   — forward + backward in a
+               single compile, so XLA fuses across the boundary and no
+               activation is recomputed.  `forward(is_train=True)` runs
+               the fused step with default ones head-gradients (the
+               reference seeds ograds with ones too — imperative.cc:302),
+               and `backward()` publishes the cached grads; explicit
+               `backward(out_grads)` re-runs the step with those.
+
+Gradient bookkeeping (grad_req write/add/null per arg) matches
+`python/mxnet/executor.py`; PlanMemory/inplace passes have no analog —
+XLA buffer assignment owns memory.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .base import MXNetError, np_dtype
+from .context import Context, current_context
+from .ndarray.ndarray import NDArray
+from .symbol.symbol import Symbol, _topo_order
+
+__all__ = ["Executor"]
+
+_BN_OPS = {"BatchNorm", "BatchNorm_v1", "_contrib_SyncBatchNorm"}
+
+
+def _build_graph_fn(symbol: Symbol, arg_names: List[str],
+                    aux_names: List[str], is_train: bool):
+    """Return fn(arg_vals, aux_vals, key) -> (outputs, new_aux_vals)."""
+    import jax
+
+    nodes = _topo_order(symbol._outputs)
+    arg_pos = {n: i for i, n in enumerate(arg_names)}
+    aux_pos = {n: i for i, n in enumerate(aux_names)}
+
+    def graph_fn(arg_vals, aux_vals, key):
+        env: Dict[Tuple[int, int], Any] = {}
+        aux_new = list(aux_vals)
+        rng_i = 0
+        for node in nodes:
+            if node.is_variable:
+                if node.is_aux:
+                    env[(id(node), 0)] = aux_vals[aux_pos[node.name]]
+                else:
+                    env[(id(node), 0)] = arg_vals[arg_pos[node.name]]
+                continue
+            invals = [env[(id(inode), idx)] for inode, idx in node.inputs]
+            attrs = dict(node.attrs)
+            if node.op.train_aware:
+                attrs["is_train"] = is_train
+            if node.op.needs_rng:
+                sub = jax.random.fold_in(key, rng_i)
+                rng_i += 1
+                out = node.op.fn(sub, *invals, **attrs)
+            else:
+                out = node.op.fn(*invals, **attrs)
+            if not isinstance(out, tuple):
+                out = (out,)
+            for i, o in enumerate(out):
+                env[(id(node), i)] = o
+            # BatchNorm-family: fold the moving-stat update into the graph
+            # (reference mutates aux NDArrays in-place during forward)
+            if is_train and node.op.name in _BN_OPS \
+                    and not attrs.get("use_global_stats", False):
+                momentum = float(attrs.get("momentum", 0.9))
+                _, mean, var = out[0], out[1], out[2]
+                mm_node, mv_node = node.inputs[3][0], node.inputs[4][0]
+                for aux_node, batch_stat in ((mm_node, mean), (mv_node, var)):
+                    if aux_node.is_variable and aux_node.is_aux:
+                        p = aux_pos[aux_node.name]
+                        aux_new[p] = momentum * aux_new[p] + \
+                            (1.0 - momentum) * batch_stat
+        outputs = [env[(id(n), i)] for n, i in symbol._outputs]
+        return outputs, aux_new
+
+    return graph_fn
+
+
+class Executor(object):
+    def __init__(self, symbol: Symbol, ctx: Context,
+                 arg_arrays: List[NDArray],
+                 grad_arrays: List[Optional[NDArray]],
+                 grad_req: List[str],
+                 aux_arrays: List[NDArray]):
+        import jax
+
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self.arg_arrays = arg_arrays
+        self.grad_arrays = grad_arrays
+        self._grad_req = grad_req
+        self.aux_arrays = aux_arrays
+        self.arg_dict = dict(zip(self._arg_names, arg_arrays))
+        self.grad_dict = dict(zip(self._arg_names, grad_arrays))
+        self.aux_dict = dict(zip(self._aux_names, aux_arrays))
+        self.outputs: List[NDArray] = []
+        self._monitor_callback = None
+
+        self._diff_idx = [i for i, r in enumerate(grad_req) if r != "null"]
+        self._has_rng = any((not n.is_variable) and n.op.needs_rng
+                            for n in _topo_order(symbol._outputs))
+
+        infer_fn = _build_graph_fn(symbol, self._arg_names, self._aux_names,
+                                   is_train=False)
+        train_fn = _build_graph_fn(symbol, self._arg_names, self._aux_names,
+                                   is_train=True)
+
+        def fwd_infer(arg_vals, aux_vals, key):
+            outs, _ = infer_fn(arg_vals, aux_vals, key)
+            return outs
+
+        diff_idx = self._diff_idx
+
+        def fused_step(arg_vals, aux_vals, key, ograds):
+            diff_vals = [arg_vals[i] for i in diff_idx]
+
+            def f(dvals):
+                full = list(arg_vals)
+                for i, v in zip(diff_idx, dvals):
+                    full[i] = v
+                outs, aux_new = train_fn(full, aux_vals, key)
+                return outs, aux_new
+
+            (outs, aux_new), vjp = jax.vjp(f, diff_vals)
+            zero_aux = [jax.numpy.zeros_like(a) for a in aux_new]
+            (dgrads,) = vjp((list(ograds), zero_aux))
+            return outs, dgrads, aux_new
+
+        self._jit_fwd_infer = jax.jit(fwd_infer)
+        self._jit_step = jax.jit(fused_step)
+
+        def fwd_train_only(arg_vals, aux_vals, key):
+            return train_fn(arg_vals, aux_vals, key)
+
+        self._jit_fwd_train = jax.jit(fwd_train_only)
+        self._cached_grads = None
+
+    # -- binding entry points --------------------------------------------
+    @staticmethod
+    def _normalize_grad_req(grad_req, arg_names: List[str]) -> List[str]:
+        if isinstance(grad_req, str):
+            return [grad_req] * len(arg_names)
+        if isinstance(grad_req, (list, tuple)):
+            return list(grad_req)
+        if isinstance(grad_req, dict):
+            return [grad_req.get(n, "null") for n in arg_names]
+        raise MXNetError("bad grad_req %r" % (grad_req,))
+
+    @staticmethod
+    def _simple_bind(symbol: Symbol, ctx, grad_req, type_dict, shape_kwargs):
+        import jax.numpy as jnp
+
+        ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape_kwargs)
+        type_dict = type_dict or {}
+        arg_arrays = []
+        for name, shape in zip(arg_names, arg_shapes):
+            dt = np_dtype(type_dict.get(name, np.float32))
+            arg_arrays.append(NDArray(jnp.zeros(shape, dtype=dt), ctx=ctx))
+        reqs = Executor._normalize_grad_req(grad_req, arg_names)
+        # data/label inputs (the ones whose shapes the caller provided)
+        # default to no gradient, like the reference's simple_bind
+        for i, name in enumerate(arg_names):
+            if name in shape_kwargs and isinstance(grad_req, str):
+                reqs[i] = "null"
+        grad_arrays = [
+            NDArray(jnp.zeros(s, dtype=a.dtype), ctx=ctx)
+            if r != "null" else None
+            for s, a, r in zip(arg_shapes, arg_arrays, reqs)
+        ]
+        aux_arrays = [NDArray(jnp.zeros(s, dtype=np.float32), ctx=ctx)
+                      for s in aux_shapes]
+        return Executor(symbol, ctx, arg_arrays, grad_arrays, reqs, aux_arrays)
+
+    @staticmethod
+    def _bind(symbol: Symbol, ctx, args, args_grad, grad_req, aux_states):
+        import jax.numpy as jnp
+
+        ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, dict):
+            arg_arrays = [args[n] for n in arg_names]
+        else:
+            arg_arrays = list(args or [])
+        if len(arg_arrays) != len(arg_names):
+            raise MXNetError("bind: expected %d args, got %d"
+                             % (len(arg_names), len(arg_arrays)))
+        reqs = Executor._normalize_grad_req(grad_req, arg_names)
+        if args_grad is None:
+            grad_arrays = [None] * len(arg_names)
+            reqs = ["null"] * len(arg_names)
+        elif isinstance(args_grad, dict):
+            grad_arrays = [args_grad.get(n) for n in arg_names]
+            reqs = [r if g is not None else "null"
+                    for r, g in zip(reqs, grad_arrays)]
+        else:
+            grad_arrays = list(args_grad)
+        if aux_states is None:
+            aux_arrays = []
+            if aux_names:
+                _, _, aux_shapes = symbol.infer_shape(
+                    **{n: a.shape for n, a in zip(arg_names, arg_arrays)})
+                aux_arrays = [NDArray(jnp.zeros(s, dtype=np.float32), ctx=ctx)
+                              for s in aux_shapes]
+        elif isinstance(aux_states, dict):
+            aux_arrays = [aux_states[n] for n in aux_names]
+        else:
+            aux_arrays = list(aux_states)
+        return Executor(symbol, ctx, arg_arrays, grad_arrays, reqs, aux_arrays)
+
+    # -- execution --------------------------------------------------------
+    def _key(self):
+        if self._has_rng:
+            from . import random as _rnd
+
+            return _rnd._next_key()
+        import jax
+
+        return jax.random.PRNGKey(0)
+
+    def _arg_vals(self):
+        return [a._data for a in self.arg_arrays]
+
+    def _aux_vals(self):
+        return [a._data for a in self.aux_arrays]
+
+    def forward(self, is_train: bool = False, **kwargs):
+        for name, val in kwargs.items():
+            if name not in self.arg_dict:
+                raise MXNetError("unknown argument %r" % name)
+            dst = self.arg_dict[name]
+            src = val if isinstance(val, NDArray) else NDArray(val, ctx=self._ctx)
+            if src.shape != dst.shape:
+                raise MXNetError("shape mismatch for %r: %s vs bound %s"
+                                 % (name, src.shape, dst.shape))
+            dst._set_jax(src._data.astype(dst.dtype)
+                         if src.dtype != dst.dtype else src._data)
+        key = self._key()
+        self._last_key = key  # reused by explicit-ograd backward so the
+        # gradients see the SAME dropout/random masks as these outputs
+        if is_train and self._diff_idx:
+            import jax.numpy as jnp
+
+            ograds = [jnp.ones(s, dtype=d) for s, d in self._out_avals()]
+            outs, grads, aux_new = self._jit_step(
+                self._arg_vals(), self._aux_vals(), key, ograds)
+            self._cached_grads = grads
+            self._write_aux(aux_new)
+        elif is_train:
+            outs, aux_new = self._jit_fwd_train(
+                self._arg_vals(), self._aux_vals(), key)
+            self._write_aux(aux_new)
+        else:
+            outs = self._jit_fwd_infer(self._arg_vals(), self._aux_vals(), key)
+        self.outputs = [NDArray(o, ctx=self._ctx, _committed=True)
+                        for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if not self._diff_idx:
+            return
+        if out_grads is None:
+            if self._cached_grads is None:
+                raise MXNetError("backward() before forward(is_train=True)")
+            grads = self._cached_grads
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ograds = [g._data for g in out_grads]
+            key = getattr(self, "_last_key", None)
+            if key is None:
+                key = self._key()
+            _, grads, _ = self._jit_step(self._arg_vals(), self._aux_vals(),
+                                         key, ograds)
+        for j, i in enumerate(self._diff_idx):
+            garr = self.grad_arrays[i]
+            if garr is None:
+                continue
+            if self._grad_req[i] == "add":
+                garr._set_jax(garr._data + grads[j])
+            else:
+                garr._set_jax(grads[j])
+        self._cached_grads = None
+
+    def _out_avals(self):
+        if getattr(self, "_out_avals_c", None) is None:
+            import jax
+
+            outs, _ = jax.eval_shape(self._jit_fwd_train, self._arg_vals(),
+                                     self._aux_vals(), self._key())
+            self._out_avals_c = [(tuple(o.shape), np.dtype(o.dtype))
+                                 for o in outs]
+        return self._out_avals_c
+
+    def _write_aux(self, aux_new):
+        for arr, val in zip(self.aux_arrays, aux_new):
+            arr._set_jax(val)
+
+    # -- utilities --------------------------------------------------------
+    def copy_params_from(self, arg_params: Dict[str, NDArray],
+                         aux_params: Optional[Dict[str, NDArray]] = None,
+                         allow_extra_params: bool = False):
+        for name, arr in (arg_params or {}).items():
+            if name in self.arg_dict:
+                arr.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("unknown arg param %r" % name)
+        for name, arr in (aux_params or {}).items():
+            if name in self.aux_dict:
+                arr.copyto(self.aux_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("unknown aux param %r" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        shapes = {n: a.shape for n, a in self.arg_dict.items()}
+        shapes.update(kwargs)
+        new_exec = Executor._simple_bind(
+            self._symbol, self._ctx,
+            {n: r for n, r in zip(self._arg_names, self._grad_req)},
+            None, shapes)
+        for n, a in self.arg_dict.items():
+            if new_exec.arg_dict[n].shape == a.shape:
+                a.copyto(new_exec.arg_dict[n])
+        for n, a in self.aux_dict.items():
+            if new_exec.aux_dict[n].shape == a.shape:
+                a.copyto(new_exec.aux_dict[n])
+        return new_exec
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))

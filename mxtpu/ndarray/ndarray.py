@@ -537,7 +537,9 @@ class NDArray(object):
 # (reference: `Imperative::Invoke`, `src/imperative/imperative.cc:87-119`).
 # ---------------------------------------------------------------------------
 
-def imperative_invoke(op_name: str, *inputs, out=None, **attrs) -> Tuple[NDArray, ...]:
+def imperative_invoke(op_name: str, *inputs, out=None,
+                      _full_outputs: bool = False,
+                      **attrs) -> Tuple[NDArray, ...]:
     opdef = _reg.get_op(op_name)
 
     # drop None/_Null attrs so they don't pollute the jit cache key
@@ -588,6 +590,13 @@ def imperative_invoke(op_name: str, *inputs, out=None, **attrs) -> Tuple[NDArray
         if node is not None:
             nd._entry = (node, i)
         results.append(nd)
+
+    # hide non-visible outputs (reference NumVisibleOutputs — e.g.
+    # BatchNorm's batch mean/var); internal callers pass _full_outputs
+    if not _full_outputs:
+        n_vis = opdef.n_visible_outputs(attrs)
+        if n_vis < len(results):
+            results = results[:n_vis]
 
     if out is not None:
         outs_list = out if isinstance(out, (list, tuple)) else [out]

@@ -235,7 +235,9 @@ def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, 
     return jnp.asarray(out)
 
 
-@register("_contrib_SyncBatchNorm", num_outputs=3, train_aware=True)
+@register("_contrib_SyncBatchNorm", num_outputs=3, train_aware=True,
+          visible_outputs=lambda attrs: 3 if attrs.get("output_mean_var")
+          else 1)
 def _sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                      momentum=0.9, fix_gamma=True, use_global_stats=False,
                      output_mean_var=False, ndev=1, key=None, is_train=False):

@@ -192,26 +192,27 @@ def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
                    pooling_convention)
         for i in range(ns)
     ]
+    # NOTE: init values must be python scalars so lax.reduce_window
+    # specializes to reduce_window_max/add primitives (which carry the
+    # autodiff rules); a traced init array kills differentiability.
     if pool_type == "max":
         init = -np.inf if np.issubdtype(np.dtype(data.dtype), np.floating) else \
-            np.iinfo(np.dtype(data.dtype)).min
-        return lax.reduce_window(data, jnp.array(init, data.dtype), lax.max,
+            int(np.iinfo(np.dtype(data.dtype)).min)
+        return lax.reduce_window(data, np.dtype(data.dtype).type(init), lax.max,
                                  window, strides, pads)
+    zero = np.dtype(data.dtype).type(0)
     if pool_type in ("avg", "sum"):
-        s = lax.reduce_window(data, jnp.array(0, data.dtype), lax.add, window,
-                              strides, pads)
+        s = lax.reduce_window(data, zero, lax.add, window, strides, pads)
         if pool_type == "sum":
             return s
         if count_include_pad:
             return s / np.prod(kernel)
         ones = jnp.ones_like(data)
-        cnt = lax.reduce_window(ones, jnp.array(0, data.dtype), lax.add, window,
-                                strides, pads)
+        cnt = lax.reduce_window(ones, zero, lax.add, window, strides, pads)
         return s / cnt
     if pool_type == "lp":
-        s = lax.reduce_window(jnp.power(jnp.abs(data), p_value),
-                              jnp.array(0, data.dtype), lax.add, window,
-                              strides, pads)
+        s = lax.reduce_window(jnp.power(jnp.abs(data), p_value), zero,
+                              lax.add, window, strides, pads)
         return jnp.power(s, 1.0 / p_value)
     raise MXNetError("unknown pool_type %r" % pool_type)
 
@@ -265,7 +266,9 @@ def _upsampling(*args, scale=1, sample_type="nearest", num_args=1,
 # ---------------------------------------------------------------------------
 
 @register("BatchNorm", num_outputs=3, train_aware=True,
-          aliases=("BatchNorm_v1",))
+          aliases=("BatchNorm_v1",),
+          visible_outputs=lambda attrs: 3 if attrs.get("output_mean_var")
+          else 1)
 def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                 momentum=0.9, fix_gamma=True, use_global_stats=False,
                 output_mean_var=False, axis=1, cudnn_off=False, is_train=False):
@@ -287,7 +290,9 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     return out, mean, var
 
 
-@register("LayerNorm", num_outputs=3)
+@register("LayerNorm", num_outputs=3,
+          visible_outputs=lambda attrs: 3 if attrs.get("output_mean_var")
+          else 1)
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     jnp = _jnp()
     ax = axis % data.ndim
@@ -626,14 +631,13 @@ def _make_loss_core(grad_scale, normalization):
         return data
 
     def fwd(data):
-        return data, (data.shape, data.dtype)
+        return data, data
 
     def bwd(res, g):
-        shape, dtype = res
         scale = grad_scale
         if normalization == "batch":
-            scale = scale / shape[0]
-        return (jnp.full(shape, scale, dtype=dtype),)
+            scale = scale / res.shape[0]
+        return (jnp.full_like(res, scale),)
 
     f.defvjp(fwd, bwd)
     return f

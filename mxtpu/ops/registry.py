@@ -62,11 +62,15 @@ class OpDef(object):
         train_aware: bool = False,
         mutate_inputs: Sequence[int] = (),
         aliases: Sequence[str] = (),
+        visible_outputs: Any = None,
         doc: Optional[str] = None,
     ):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
+        # reference analog: NumVisibleOutputs — BatchNorm computes
+        # (out, mean, var) but only `out` is user-visible
+        self.visible_outputs = visible_outputs
         self.differentiable = differentiable
         self.needs_rng = needs_rng
         # train_aware ops take an `is_train` attr injected from the autograd
@@ -81,6 +85,13 @@ class OpDef(object):
             return self.num_outputs(attrs)
         return self.num_outputs
 
+    def n_visible_outputs(self, attrs: Dict[str, Any]) -> int:
+        if self.visible_outputs is None:
+            return self.n_outputs(attrs)
+        if callable(self.visible_outputs):
+            return self.visible_outputs(attrs)
+        return self.visible_outputs
+
     def __repr__(self):
         return "OpDef(%s)" % self.name
 
@@ -93,6 +104,7 @@ def register(
     train_aware: bool = False,
     mutate_inputs: Sequence[int] = (),
     aliases: Sequence[str] = (),
+    visible_outputs: Any = None,
 ):
     """Decorator registering a JAX function as a framework op."""
 
@@ -106,6 +118,7 @@ def register(
             train_aware=train_aware,
             mutate_inputs=mutate_inputs,
             aliases=aliases,
+            visible_outputs=visible_outputs,
         )
         if name in _OP_REGISTRY:
             raise MXNetError("op %r already registered" % name)

@@ -1,0 +1,26 @@
+"""`mxtpu.sym` — symbolic API (reference: `python/mxnet/symbol/`)."""
+import sys as _sys
+import types as _types
+
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     NameManager, AttrScope)
+from . import op_meta  # noqa: F401
+from . import register as _register_mod
+
+_this = _sys.modules[__name__]
+_register_mod._init_symbol_module(_this)
+
+# zeros/ones convenience (reference sym.zeros)
+zeros = getattr(_this, "_zeros")
+ones = getattr(_this, "_ones")
+
+# `sym.contrib` / `sym.linalg` sub-namespaces
+contrib = _types.ModuleType(__name__ + ".contrib")
+linalg = _types.ModuleType(__name__ + ".linalg")
+for _name in dir(_this):
+    if _name.startswith("_contrib_"):
+        setattr(contrib, _name[len("_contrib_"):], getattr(_this, _name))
+    elif _name.startswith("_linalg_"):
+        setattr(linalg, _name[len("_linalg_"):], getattr(_this, _name))
+_sys.modules[contrib.__name__] = contrib
+_sys.modules[linalg.__name__] = linalg

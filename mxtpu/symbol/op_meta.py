@@ -1,0 +1,253 @@
+"""Per-op metadata for symbolic composition.
+
+The reference's NNVM registry carries FListInputNames and FInferShape per
+op (`include/mxnet/op_attr_types.h`), which is what lets
+`Symbol.simple_bind` auto-create weight/bias variables and solve their
+shapes from the data shape (`src/executor/infer_graph_attr_pass.cc`).
+
+Here forward shape inference is free (`jax.eval_shape` on the op's JAX
+function), so this table only carries what JAX can't know:
+  * input names (for auto-created variables: "fc1_weight"...)
+  * which inputs are auxiliary states (BatchNorm moving stats)
+  * backward parameter-shape solving: given the data shape + attrs,
+    produce the parameter shapes.
+Ops not listed default to all-data inputs named from the function
+signature.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.registry import OpDef
+
+
+class OpMeta(object):
+    def __init__(self, input_names, aux_indices=(), param_shapes=None,
+                 variadic=False):
+        # input_names: list[str] | callable(attrs)->list[str]
+        self._input_names = input_names
+        self.aux_indices = tuple(aux_indices)
+        # param_shapes: callable(data_shapes: list[Optional[tuple]], attrs)
+        #               -> dict{input_index: shape}
+        self.param_shapes = param_shapes
+        self.variadic = variadic
+
+    def input_names(self, attrs) -> List[str]:
+        if callable(self._input_names):
+            return self._input_names(attrs)
+        return list(self._input_names)
+
+
+_META: Dict[str, OpMeta] = {}
+
+
+def register_meta(op_name: str, meta: OpMeta):
+    _META[op_name] = meta
+
+
+def get_meta(opdef: OpDef) -> OpMeta:
+    m = _META.get(opdef.name)
+    if m is not None:
+        return m
+    # derive from the python signature: positional params are inputs
+    fn = opdef.fn
+    sig = inspect.signature(fn)
+    names = []
+    variadic = False
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            variadic = True
+            continue
+        if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                      inspect.Parameter.POSITIONAL_OR_KEYWORD) \
+                and p.default is inspect.Parameter.empty:
+            if p.name == "key" and opdef.needs_rng:
+                continue
+            names.append(p.name)
+    m = OpMeta(names, variadic=variadic)
+    _META[opdef.name] = m
+    return m
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Layer ops with learnable parameters
+# ---------------------------------------------------------------------------
+
+def _fc_inputs(attrs):
+    return ["data", "weight"] if attrs.get("no_bias") else \
+        ["data", "weight", "bias"]
+
+
+def _fc_shapes(shapes, attrs):
+    data = shapes[0]
+    nh = int(attrs["num_hidden"])
+    if data is None:
+        return {}
+    in_units = _prod(data[1:]) if attrs.get("flatten", True) else data[-1]
+    out = {1: (nh, in_units)}
+    if not attrs.get("no_bias"):
+        out[2] = (nh,)
+    return out
+
+
+register_meta("FullyConnected", OpMeta(_fc_inputs, param_shapes=_fc_shapes))
+
+
+def _conv_inputs(attrs):
+    return ["data", "weight"] if attrs.get("no_bias") else \
+        ["data", "weight", "bias"]
+
+
+def _conv_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    nf = int(attrs["num_filter"])
+    g = int(attrs.get("num_group", 1))
+    kernel = tuple(attrs["kernel"])
+    out = {1: (nf, data[1] // g) + kernel}
+    if not attrs.get("no_bias"):
+        out[2] = (nf,)
+    return out
+
+
+register_meta("Convolution", OpMeta(_conv_inputs, param_shapes=_conv_shapes))
+register_meta("Convolution_v1", OpMeta(_conv_inputs, param_shapes=_conv_shapes))
+
+
+def _deconv_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    nf = int(attrs["num_filter"])
+    g = int(attrs.get("num_group", 1))
+    kernel = tuple(attrs["kernel"])
+    out = {1: (data[1], nf // g) + kernel}
+    if not attrs.get("no_bias", True):
+        out[2] = (nf,)
+    return out
+
+
+def _deconv_inputs(attrs):
+    return ["data", "weight"] if attrs.get("no_bias", True) else \
+        ["data", "weight", "bias"]
+
+
+register_meta("Deconvolution", OpMeta(_deconv_inputs,
+                                      param_shapes=_deconv_shapes))
+
+
+def _bn_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    axis = int(attrs.get("axis", 1))
+    c = data[axis % len(data)]
+    return {1: (c,), 2: (c,), 3: (c,), 4: (c,)}
+
+
+register_meta("BatchNorm", OpMeta(
+    ["data", "gamma", "beta", "moving_mean", "moving_var"],
+    aux_indices=(3, 4), param_shapes=_bn_shapes))
+register_meta("BatchNorm_v1", OpMeta(
+    ["data", "gamma", "beta", "moving_mean", "moving_var"],
+    aux_indices=(3, 4), param_shapes=_bn_shapes))
+register_meta("_contrib_SyncBatchNorm", OpMeta(
+    ["data", "gamma", "beta", "moving_mean", "moving_var"],
+    aux_indices=(3, 4), param_shapes=_bn_shapes))
+
+
+def _ln_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    axis = int(attrs.get("axis", -1))
+    c = data[axis % len(data)]
+    return {1: (c,), 2: (c,)}
+
+
+register_meta("LayerNorm", OpMeta(["data", "gamma", "beta"],
+                                  param_shapes=_ln_shapes))
+
+
+def _in_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    return {1: (data[1],), 2: (data[1],)}
+
+
+register_meta("InstanceNorm", OpMeta(["data", "gamma", "beta"],
+                                     param_shapes=_in_shapes))
+
+
+def _emb_shapes(shapes, attrs):
+    return {1: (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+register_meta("Embedding", OpMeta(["data", "weight"],
+                                  param_shapes=_emb_shapes))
+
+
+def _lrelu_inputs(attrs):
+    if attrs.get("act_type") == "prelu":
+        return ["data", "gamma"]
+    return ["data"]
+
+
+def _lrelu_shapes(shapes, attrs):
+    if attrs.get("act_type") != "prelu":
+        return {}
+    data = shapes[0]
+    if data is None:
+        return {}
+    return {1: (data[1],) if len(data) > 1 else (1,)}
+
+
+register_meta("LeakyReLU", OpMeta(_lrelu_inputs, param_shapes=_lrelu_shapes))
+
+
+def _rnn_inputs(attrs):
+    if attrs.get("mode", "lstm") == "lstm":
+        return ["data", "parameters", "state", "state_cell"]
+    return ["data", "parameters", "state"]
+
+
+def _rnn_shapes(shapes, attrs):
+    from ..ops.rnn_op import rnn_param_size
+
+    data = shapes[0]
+    if data is None:
+        return {}
+    t, n, input_size = data
+    h = int(attrs["state_size"])
+    layers = int(attrs["num_layers"])
+    bi = bool(attrs.get("bidirectional", False))
+    mode = attrs.get("mode", "lstm")
+    d = 2 if bi else 1
+    out = {1: (rnn_param_size(input_size, h, layers, bi, mode),),
+           2: (layers * d, n, h)}
+    if mode == "lstm":
+        out[3] = (layers * d, n, h)
+    return out
+
+
+register_meta("RNN", OpMeta(_rnn_inputs, param_shapes=_rnn_shapes))
+
+# loss heads: label is a plain input (not auto-shaped)
+register_meta("SoftmaxOutput", OpMeta(["data", "label"]))
+register_meta("Softmax", OpMeta(["data", "label"]))
+register_meta("LinearRegressionOutput", OpMeta(["data", "label"]))
+register_meta("MAERegressionOutput", OpMeta(["data", "label"]))
+register_meta("LogisticRegressionOutput", OpMeta(["data", "label"]))
+register_meta("SVMOutput", OpMeta(["data", "label"]))

@@ -1,0 +1,643 @@
+"""Symbol: the declarative graph IR.
+
+TPU-native re-design of the reference's `nnvm::Symbol`/`Graph`
+(`3rdparty/tvm/nnvm/include/nnvm/symbolic.h`, `python/mxnet/symbol/
+symbol.py:54`).  A Symbol is a small host-side DAG of op nodes; its ONLY
+execution path is whole-graph lowering: `simple_bind` turns the entire
+graph into a single jitted XLA computation (see `mxtpu.executor`) — the
+north-star design where the reference's GraphExecutor ran node-by-node
+through the engine.  Consequently the reference's PlanMemory/inplace
+passes have no analog (XLA buffer assignment does that); shape/type
+inference remains (`infer_shape` solves parameter shapes backward from
+the data shape via per-op metadata, then forward via `jax.eval_shape`).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+from ..ops.registry import OpDef, get_op
+from . import op_meta as _meta_mod
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "NameManager"]
+
+
+class NameManager(object):
+    """Auto-naming for anonymous ops (reference:
+    `python/mxnet/name.py`)."""
+
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter: Dict[str, int] = {}
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        if name:
+            return name
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return "%s%d" % (hint, idx)
+
+    @classmethod
+    def current(cls) -> "NameManager":
+        if not hasattr(cls._current, "value"):
+            cls._current.value = NameManager()
+        return cls._current.value
+
+    def __enter__(self):
+        self._old = getattr(NameManager._current, "value", None)
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, *args):
+        NameManager._current.value = self._old
+
+
+class AttrScope(object):
+    """with-scope attributes applied to new symbols (reference:
+    `python/mxnet/attribute.py`; carries ctx_group etc.)."""
+
+    _current = threading.local()
+
+    def __init__(self, **attrs):
+        self._attrs = attrs
+
+    @classmethod
+    def current_attrs(cls) -> Dict[str, Any]:
+        scope = getattr(cls._current, "value", None)
+        return dict(scope._attrs) if scope else {}
+
+    def __enter__(self):
+        self._old = getattr(AttrScope._current, "value", None)
+        # merge into a transient copy — never mutate self._attrs, the scope
+        # object may be reused under different parents
+        merged = dict(self._old._attrs) if self._old else {}
+        merged.update(self._attrs)
+        active = AttrScope()
+        active._attrs = merged
+        active._old = self._old
+        AttrScope._current.value = active
+        self._active = active
+        return self
+
+    def __exit__(self, *args):
+        AttrScope._current.value = self._active._old
+
+
+class SymbolNode(object):
+    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "ext_attrs",
+                 "__weakref__")
+
+    def __init__(self, op: Optional[OpDef], name: str, attrs: Dict[str, Any],
+                 inputs: List[Tuple["SymbolNode", int]], is_aux: bool = False):
+        self.op = op
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+        self.is_aux = is_aux
+        self.ext_attrs: Dict[str, str] = AttrScope.current_attrs()
+
+    @property
+    def is_variable(self) -> bool:
+        return self.op is None
+
+    def num_outputs(self) -> int:
+        if self.op is None:
+            return 1
+        return self.op.n_outputs(self.attrs)
+
+
+def _topo_order(out_entries: Sequence[Tuple[SymbolNode, int]]) -> List[SymbolNode]:
+    order: List[SymbolNode] = []
+    seen = set()
+    stack = [(e[0], False) for e in reversed(out_entries)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for (inode, _) in reversed(node.inputs):
+            if id(inode) not in seen:
+                stack.append((inode, False))
+    return order
+
+
+class Symbol(object):
+    """Immutable handle to one or more output entries of the graph."""
+
+    __slots__ = ("_outputs",)
+
+    def __init__(self, outputs: Sequence[Tuple[SymbolNode, int]]):
+        self._outputs = list(outputs)
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def name(self) -> str:
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return "grouped"
+
+    def __repr__(self):
+        return "<Symbol %s>" % ", ".join(
+            "%s[%d]" % (n.name, i) for n, i in self._outputs)
+
+    def __len__(self):
+        return len(self.list_outputs())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError("output %r not found" % index)
+            index = names.index(index)
+        if isinstance(index, int):
+            if index >= len(self.list_outputs()):
+                raise MXNetError("output index out of range")
+            return Symbol([self._entry_at(index)])
+        raise TypeError("bad index %r" % (index,))
+
+    def _entry_at(self, flat_index: int) -> Tuple[SymbolNode, int]:
+        i = 0
+        for node, idx in self._outputs:
+            if i == flat_index:
+                return (node, idx)
+            i += 1
+        raise IndexError(flat_index)
+
+    # -- graph queries ----------------------------------------------------
+    def _topo(self) -> List[SymbolNode]:
+        return _topo_order(self._outputs)
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self._topo() if n.is_variable and not n.is_aux]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in self._topo() if n.is_variable and n.is_aux]
+
+    def list_outputs(self) -> List[str]:
+        names = []
+        for node, idx in self._outputs:
+            if node.is_variable:
+                names.append(node.name)
+            elif node.op.n_visible_outputs(node.attrs) == 1:
+                names.append(node.name + "_output")
+            else:
+                names.append("%s_output%d" % (node.name, idx))
+        return names
+
+    def list_inputs(self) -> List[str]:
+        return [n.name for n in self._topo() if n.is_variable]
+
+    def get_internals(self) -> "Symbol":
+        entries = []
+        for node in self._topo():
+            for i in range(node.num_outputs()):
+                entries.append((node, i))
+        return Symbol(entries)
+
+    def get_children(self) -> Optional["Symbol"]:
+        if len(self._outputs) != 1:
+            return None
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # -- attrs ------------------------------------------------------------
+    def attr(self, key: str) -> Optional[str]:
+        node = self._outputs[0][0]
+        v = node.ext_attrs.get(key)
+        if v is None and key in node.attrs:
+            v = str(node.attrs[key])
+        return v
+
+    def _set_attr(self, **kwargs):
+        node = self._outputs[0][0]
+        node.ext_attrs.update({k: str(v) for k, v in kwargs.items()})
+
+    def attr_dict(self) -> Dict[str, Dict[str, str]]:
+        out = {}
+        for node in self._topo():
+            d = {k: str(v) for k, v in node.attrs.items()}
+            d.update(node.ext_attrs)
+            if d:
+                out[node.name] = d
+        return out
+
+    # -- shape/type inference --------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known: Dict[str, Tuple[int, ...]] = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+        shapes, dtypes = _infer_graph(self, known, {}, partial=partial)
+        arg_shapes = [shapes.get(n) for n in arg_names]
+        out_shapes = [shapes.get(node.name) if node.is_variable
+                      else shapes.get(("out", id(node), idx))
+                      for node, idx in self._outputs]
+        aux_shapes = [shapes.get(n) for n in self.list_auxiliary_states()]
+        if not partial and any(s is None for s in arg_shapes + out_shapes):
+            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+            raise MXNetError("infer_shape incomplete; unknown args: %s"
+                             % missing)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known: Dict[str, Any] = {}
+        if args:
+            for name, dt in zip(arg_names, args):
+                if dt is not None:
+                    known[name] = np_dtype(dt)
+        known.update({k: np_dtype(v) for k, v in kwargs.items()
+                      if v is not None})
+        # honor declared variable dtypes
+        decl = {}
+        for n in self._topo():
+            if n.is_variable and "__dtype__" in n.ext_attrs:
+                decl[n.name] = np.dtype(n.ext_attrs["__dtype__"])
+        arg_types = [known.get(n, decl.get(n, np.dtype(np.float32)))
+                     for n in arg_names]
+        # propagate through the graph when shapes are declared/known;
+        # otherwise fall back to float32 per output
+        out_types = [np.dtype(np.float32)] * len(self.list_outputs())
+        try:
+            shapes, dtypes = _infer_graph(self, {}, dict(known), partial=True)
+            out_types = [
+                dtypes.get(node.name, np.dtype(np.float32)) if node.is_variable
+                else (dtypes.get(("out", id(node), idx)) or
+                      np.dtype(np.float32))
+                for node, idx in self._outputs
+            ]
+        except Exception:
+            pass
+        aux_types = [np.dtype(np.float32)] * len(self.list_auxiliary_states())
+        return arg_types, out_types, aux_types
+
+    # -- composition ------------------------------------------------------
+    def __call__(self, *args, **kwargs) -> "Symbol":
+        """Compose: substitute this symbol's variable inputs with other
+        symbols (reference `Symbol.__call__`/Compose)."""
+        mapping: Dict[str, Symbol] = {}
+        arg_names = [n for n in self.list_inputs()]
+        if args:
+            for name, s in zip(arg_names, args):
+                mapping[name] = s
+        mapping.update(kwargs)
+        if not mapping:
+            return self
+        for s in mapping.values():
+            if len(s._outputs) != 1:
+                raise MXNetError("can only compose with 1-output symbols")
+        memo: Dict[int, Tuple[SymbolNode, int]] = {}
+
+        def clone_entry(entry) -> Tuple[SymbolNode, int]:
+            """Clone an (node, out_idx) entry, substituting variables with
+            the mapped symbol's full entry (node AND output index)."""
+            node, idx = entry
+            if id(node) in memo:
+                n, sub_idx = memo[id(node)]
+                # substituted variables carry their own output index;
+                # ordinary nodes keep the consumer's index
+                return (n, sub_idx if sub_idx is not None else idx)
+            if node.is_variable and node.name in mapping:
+                sub_entry = mapping[node.name]._outputs[0]
+                memo[id(node)] = (sub_entry[0], sub_entry[1])
+                return sub_entry
+            new = SymbolNode(node.op, node.name, dict(node.attrs),
+                             [clone_entry(e) for e in node.inputs],
+                             is_aux=node.is_aux)
+            new.ext_attrs = dict(node.ext_attrs)
+            memo[id(node)] = (new, None)
+            return (new, idx)
+
+        return Symbol([clone_entry(e) for e in self._outputs])
+
+    # -- arithmetic -------------------------------------------------------
+    def _binary(self, other, op_name, scalar_op, rscalar_op=None, swap=False):
+        from .register import invoke_symbol
+
+        if isinstance(other, Symbol):
+            a, b = (other, self) if swap else (self, other)
+            return invoke_symbol(op_name, [a, b], {})
+        if isinstance(other, (int, float, np.generic)):
+            name = rscalar_op if (swap and rscalar_op) else scalar_op
+            return invoke_symbol(name, [self], {"scalar": float(other)})
+        raise TypeError(type(other))
+
+    def __add__(self, other):
+        return self._binary(other, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary(other, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return self._binary(other, "elemwise_sub", "_minus_scalar",
+                            "_rminus_scalar", swap=True)
+
+    def __mul__(self, other):
+        return self._binary(other, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary(other, "elemwise_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return self._binary(other, "elemwise_div", "_div_scalar",
+                            "_rdiv_scalar", swap=True)
+
+    def __pow__(self, other):
+        return self._binary(other, "_power", "_power_scalar")
+
+    def __neg__(self):
+        from .register import invoke_symbol
+
+        return invoke_symbol("negative", [self], {})
+
+    def __eq__(self, other):
+        if isinstance(other, (Symbol, int, float, np.generic)):
+            return self._binary(other, "_equal", "_equal_scalar")
+        return NotImplemented
+
+    def __ne__(self, other):
+        if isinstance(other, (Symbol, int, float, np.generic)):
+            return self._binary(other, "_not_equal", "_not_equal_scalar")
+        return NotImplemented
+
+    def __gt__(self, other):
+        return self._binary(other, "_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._binary(other, "_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return self._binary(other, "_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._binary(other, "_lesser_equal", "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+    # -- serialization ----------------------------------------------------
+    def tojson(self) -> str:
+        nodes = self._topo()
+        node_index = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": "null" if n.is_variable else n.op.name,
+                "name": n.name,
+                "attrs": {k: json.dumps(_jsonable(v))
+                          for k, v in n.attrs.items()},
+                "ext_attrs": dict(n.ext_attrs),
+                "inputs": [[node_index[id(i)], idx, 0] for i, idx in n.inputs],
+                "is_aux": n.is_aux,
+            })
+        heads = [[node_index[id(n)], idx, 0] for n, idx in self._outputs]
+        arg_nodes = [i for i, n in enumerate(nodes) if n.is_variable]
+        return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
+                           "heads": heads,
+                           "attrs": {"mxtpu_version": ["str", "0.1.0"]}},
+                          indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- binding (whole-graph XLA lowering) -------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from ..executor import Executor
+
+        return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+
+        return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx=ctx, args=kwargs)
+        return ex.forward()
+
+    # convenience mirrors of common ops
+    def _invoke(self, op, attrs=None):
+        from .register import invoke_symbol
+
+        return invoke_symbol(op, [self], attrs or {})
+
+    def reshape(self, shape, **kw):
+        return self._invoke("Reshape", {"shape": tuple(shape)})
+
+    def transpose(self, axes=None):
+        return self._invoke("transpose", {"axes": tuple(axes) if axes else None})
+
+    def sum(self, axis=None, keepdims=False):
+        return self._invoke("sum", {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return self._invoke("mean", {"axis": axis, "keepdims": keepdims})
+
+    def softmax(self, axis=-1):
+        return self._invoke("softmax", {"axis": axis})
+
+    def flatten(self):
+        return self._invoke("Flatten", {})
+
+    def slice_axis(self, axis, begin, end):
+        return self._invoke("slice_axis",
+                            {"axis": axis, "begin": begin, "end": end})
+
+    def expand_dims(self, axis):
+        return self._invoke("expand_dims", {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return self._invoke("squeeze", {"axis": axis})
+
+    def astype(self, dtype):
+        return self._invoke("Cast", {"dtype": np_dtype(dtype).name})
+
+
+def _jsonable(v):
+    if isinstance(v, np.dtype):
+        return v.name
+    if isinstance(v, tuple):
+        return list(v)
+    return v
+
+
+def _unjson(v):
+    if isinstance(v, list):
+        return tuple(v)
+    return v
+
+
+def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs) -> Symbol:
+    """Create a variable symbol (reference `mx.sym.Variable`)."""
+    node = SymbolNode(None, name, {}, [])
+    if shape is not None:
+        node.ext_attrs["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        node.ext_attrs["__dtype__"] = np_dtype(dtype).name
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    entries = []
+    for s in symbols:
+        entries.extend(s._outputs)
+    return Symbol(entries)
+
+
+def load_json(json_str: str) -> Symbol:
+    data = json.loads(json_str)
+    nodes: List[SymbolNode] = []
+    for jn in data["nodes"]:
+        attrs = {k: _unjson(json.loads(v)) for k, v in jn.get("attrs", {}).items()}
+        if jn["op"] == "null":
+            node = SymbolNode(None, jn["name"], {}, [],
+                              is_aux=jn.get("is_aux", False))
+        else:
+            op = get_op(jn["op"])
+            inputs = [(nodes[i], idx) for i, idx, _ in jn["inputs"]]
+            node = SymbolNode(op, jn["name"], attrs, inputs)
+        node.ext_attrs = dict(jn.get("ext_attrs", {}))
+        nodes.append(node)
+    outputs = [(nodes[i], idx) for i, idx, _ in data["heads"]]
+    return Symbol(outputs)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# Whole-graph shape inference (reference: infer_graph_attr_pass.cc, but
+# forward shapes come from jax.eval_shape and parameter shapes from the
+# op_meta backward hooks)
+# ---------------------------------------------------------------------------
+
+def _infer_graph(symbol: Symbol, known_shapes: Dict[str, Tuple[int, ...]],
+                 known_dtypes: Dict[str, Any], partial: bool = False):
+    import jax
+
+    shapes: Dict[Any, Optional[Tuple[int, ...]]] = {}
+    dtypes: Dict[Any, Any] = {}
+    nodes = symbol._topo()
+
+    def var_shape(node):
+        if node.name in known_shapes:
+            return tuple(known_shapes[node.name])
+        if "__shape__" in node.ext_attrs:
+            import ast
+
+            return tuple(ast.literal_eval(node.ext_attrs["__shape__"]))
+        return None
+
+    def var_dtype(node):
+        if node.name in known_dtypes:
+            return np.dtype(known_dtypes[node.name])
+        if "__dtype__" in node.ext_attrs:
+            return np.dtype(node.ext_attrs["__dtype__"])
+        return np.dtype(np.float32)
+
+    for node in nodes:
+        if node.is_variable:
+            shapes[node.name] = var_shape(node)
+            dtypes[node.name] = var_dtype(node)
+            continue
+        meta = _meta_mod.get_meta(node.op)
+        in_entries = node.inputs
+        in_shapes = []
+        for (inode, idx) in in_entries:
+            if inode.is_variable:
+                in_shapes.append(shapes.get(inode.name))
+            else:
+                in_shapes.append(shapes.get(("out", id(inode), idx)))
+        # backward-solve unknown parameter shapes from the data shape
+        if meta.param_shapes is not None and any(s is None for s in in_shapes):
+            solved = meta.param_shapes(in_shapes, node.attrs)
+            for i, shp in (solved or {}).items():
+                if i < len(in_entries) and in_shapes[i] is None:
+                    inode, _ = in_entries[i]
+                    if inode.is_variable and shapes.get(inode.name) is None:
+                        shapes[inode.name] = tuple(shp)
+                        in_shapes[i] = tuple(shp)
+        if any(s is None for s in in_shapes):
+            if partial:
+                for i in range(node.num_outputs()):
+                    shapes[("out", id(node), i)] = None
+                continue
+            missing = [in_entries[i][0].name for i, s in enumerate(in_shapes)
+                       if s is None]
+            raise MXNetError("cannot infer shape for inputs %s of node %s"
+                             % (missing, node.name))
+        in_dtypes = []
+        for (inode, idx), shp in zip(in_entries, in_shapes):
+            if inode.is_variable:
+                in_dtypes.append(dtypes.get(inode.name, np.dtype(np.float32)))
+            else:
+                in_dtypes.append(dtypes.get(("out", id(inode), idx),
+                                            np.dtype(np.float32)))
+        out_shapes, out_dtypes = _eval_node_shape(node, in_shapes, in_dtypes)
+        for i, (shp, dt) in enumerate(zip(out_shapes, out_dtypes)):
+            shapes[("out", id(node), i)] = shp
+            dtypes[("out", id(node), i)] = dt
+    return shapes, dtypes
+
+
+def _eval_node_shape(node: SymbolNode, in_shapes, in_dtypes):
+    import functools
+
+    import jax
+
+    op = node.op
+    attrs = dict(node.attrs)
+    if op.train_aware:
+        attrs.setdefault("is_train", False)
+
+    structs = [jax.ShapeDtypeStruct(s, d) for s, d in zip(in_shapes, in_dtypes)]
+    fn = functools.partial(op.fn, **attrs)
+    if op.needs_rng:
+        key = jax.ShapeDtypeStruct((2,), np.uint32)
+        out = jax.eval_shape(fn, key, *structs)
+    else:
+        out = jax.eval_shape(fn, *structs)
+    if not isinstance(out, tuple):
+        out = (out,)
+    return [tuple(o.shape) for o in out], [np.dtype(o.dtype) for o in out]

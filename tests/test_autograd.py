@@ -150,3 +150,25 @@ def test_numeric_gradient_check():
         f = lambda v: (np.log(v) * np.sqrt(v)).sum()
         num[i] = (f(xp) - f(xm)) / (2 * eps)
     np.testing.assert_allclose(x.grad.asnumpy(), num, rtol=1e-2, atol=1e-3)
+
+
+def test_pooling_grad():
+    # regression: reduce_window init must stay a scalar literal or the
+    # max-pool loses its autodiff rule
+    x = nd.array(np.random.randn(2, 3, 4, 4).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+        z = y.sum()
+    z.backward()
+    g = x.grad.asnumpy()
+    assert g.shape == x.shape
+    np.testing.assert_allclose(g.sum(), y.size, rtol=1e-5)
+    x2 = nd.array(np.random.randn(2, 3, 4, 4).astype(np.float32))
+    x2.attach_grad()
+    with autograd.record():
+        z2 = nd.Pooling(x2, kernel=(2, 2), stride=(2, 2),
+                        pool_type="avg").sum()
+    z2.backward()
+    np.testing.assert_allclose(x2.grad.asnumpy(), np.full(x2.shape, 0.25),
+                               rtol=1e-5)

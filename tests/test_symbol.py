@@ -1,0 +1,170 @@
+"""Symbol + Executor tests (reference analog: test_symbol.py,
+test_executor.py, test_infer_shape.py)."""
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import nd, sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    act1 = sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(data=act1, num_hidden=3, name="fc2")
+    return sym.SoftmaxOutput(data=fc2, label=sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def test_compose_and_listing():
+    net = _mlp()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight",
+                    "fc2_bias", "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 10),
+                                                         softmax_label=(8,))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 10)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (3, 16)
+    assert out_shapes == [(8, 3)]
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    c = sym.Convolution(data=data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                        name="conv1")
+    p = sym.Pooling(data=c, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, _ = p.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(p.list_arguments(), arg_shapes))
+    assert d["conv1_weight"] == (8, 3, 3, 3)
+    assert out_shapes == [(2, 8, 4, 4)]
+
+
+def test_batchnorm_aux():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data=data, name="bn0")
+    assert bn.list_auxiliary_states() == ["bn0_moving_mean", "bn0_moving_var"]
+    assert "bn0_gamma" in bn.list_arguments()
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+
+
+def test_simple_bind_forward_backward():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(8, 10), softmax_label=(8,))
+    # init params
+    rng = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = nd.array(rng.randn(*arr.shape).astype(np.float32) * 0.1)
+    x = rng.randn(8, 10).astype(np.float32)
+    y = rng.randint(0, 3, (8,)).astype(np.float32)
+    outs = ex.forward(is_train=True, data=x, softmax_label=y)
+    p = outs[0].asnumpy()
+    np.testing.assert_allclose(p.sum(axis=1), np.ones(8), rtol=1e-5)
+    ex.backward()
+    g = ex.grad_dict["fc2_weight"].asnumpy()
+    assert g.shape == (3, 16) and np.abs(g).sum() > 0
+    # gradient must match the eager/autograd path
+    w1 = nd.array(ex.arg_dict["fc1_weight"].asnumpy())
+    b1 = nd.array(ex.arg_dict["fc1_bias"].asnumpy())
+    w2 = nd.array(ex.arg_dict["fc2_weight"].asnumpy())
+    b2 = nd.array(ex.arg_dict["fc2_bias"].asnumpy())
+    for p_ in (w1, b1, w2, b2):
+        p_.attach_grad()
+    from mxtpu import autograd
+
+    with autograd.record():
+        h = nd.relu(nd.FullyConnected(nd.array(x), w1, b1, num_hidden=16))
+        o = nd.FullyConnected(h, w2, b2, num_hidden=3)
+        out = nd.SoftmaxOutput(o, nd.array(y))
+    out.backward()
+    np.testing.assert_allclose(g, w2.grad.asnumpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ex.grad_dict["fc1_weight"].asnumpy(),
+                               w1.grad.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_executor_train_loop_converges():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(32, 10), softmax_label=(32,))
+    rng = np.random.RandomState(1)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = nd.array(rng.randn(*arr.shape).astype(np.float32) * 0.1)
+    w_true = rng.randn(10, 3).astype(np.float32)
+    X = rng.randn(32, 10).astype(np.float32)
+    y = X.dot(w_true).argmax(axis=1).astype(np.float32)
+    accs = []
+    for it in range(100):
+        outs = ex.forward(is_train=True, data=X, softmax_label=y)
+        ex.backward()
+        for name in ex.arg_dict:
+            if name in ("data", "softmax_label"):
+                continue
+            g = ex.grad_dict[name]
+            a = ex.arg_dict[name]
+            # grad is summed over the batch (normalization='null'): scale lr
+            a._set_jax((a - 0.02 * g)._data)
+        accs.append((outs[0].asnumpy().argmax(1) == y).mean())
+    assert accs[-1] > 0.9, accs[-1]
+
+
+def test_batchnorm_moving_stats_update():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data=data, fix_gamma=False, momentum=0.5, name="bn")
+    ex = bn.simple_bind(ctx=mx.cpu(), data=(4, 3), grad_req="null")
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    x = np.random.randn(4, 3).astype(np.float32) * 2 + 1
+    before = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True, data=x)
+    after = ex.aux_dict["bn_moving_mean"].asnumpy()
+    expected = 0.5 * before + 0.5 * x.mean(axis=0)
+    np.testing.assert_allclose(after, expected, rtol=1e-4, atol=1e-5)
+    # eval mode must not update
+    before2 = after.copy()
+    ex.forward(is_train=False, data=x)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                               before2)
+
+
+def test_symbol_arithmetic():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2 - a
+    ex = c.bind(ctx=mx.cpu(), args={"a": nd.array([1.0, 2.0]),
+                                    "b": nd.array([3.0, 4.0])})
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), [7.0, 10.0])
+
+
+def test_group_and_internals():
+    a = sym.Variable("a")
+    b = a * 2
+    c = b + 1
+    g = sym.Group([b, c])
+    assert len(g.list_outputs()) == 2
+    internals = c.get_internals()
+    assert any("mul" in n or "plus" in n for n in internals.list_outputs())
+
+
+def test_grad_req_add_and_null():
+    a = sym.Variable("a")
+    loss = sym.MakeLoss((a * a).sum())
+    ex = loss.bind(ctx=mx.cpu(), args={"a": nd.array([3.0])},
+                   args_grad={"a": nd.zeros((1,))}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), [12.0])
