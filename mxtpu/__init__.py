@@ -39,6 +39,14 @@ from . import symbol
 from . import symbol as sym
 from .symbol import Symbol
 from .executor import Executor
+from .cached_op import CachedOp
+from . import initializer
+from .initializer import init
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import recordio
+from . import gluon
 
 
 def tpu_count():

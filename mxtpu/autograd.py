@@ -133,24 +133,13 @@ class TapeNode(object):
         self.saved = None
 
 
-def _record_op(opdef, nd_inputs, jax_inputs, attrs: Dict[str, Any], rng_key=None):
-    """Run op under jax.vjp and tape it. Returns (jax outputs tuple, node)."""
+def _record_fn(name, tupled_fn, nd_inputs, jax_inputs):
+    """Run `tupled_fn` (returns a tuple of arrays) under jax.vjp and tape
+    it.  Used both for single ops and for whole traced graphs (CachedOp).
+    Returns (jax outputs tuple, node_or_None)."""
     import jax
 
-    fn = opdef.fn
-
-    if opdef.needs_rng:
-        def closed(*xs):
-            return fn(rng_key, *xs, **attrs)
-    else:
-        def closed(*xs):
-            return fn(*xs, **attrs)
-
-    def tupled(*xs):
-        out = closed(*xs)
-        return out if isinstance(out, tuple) else (out,)
-
-    outs, vjp_fn = jax.vjp(tupled, *jax_inputs)
+    outs, vjp_fn = jax.vjp(tupled_fn, *jax_inputs)
 
     entries = []
     tracked = False
@@ -170,8 +159,32 @@ def _record_op(opdef, nd_inputs, jax_inputs, attrs: Dict[str, Any], rng_key=None
         return outs, None
 
     out_avals = [(tuple(o.shape), o.dtype) for o in outs]
-    node = TapeNode(opdef.name, vjp_fn, entries, out_avals)
+    node = TapeNode(name, vjp_fn, entries, out_avals)
     return outs, node
+
+
+def _record_op(opdef, nd_inputs, jax_inputs, attrs: Dict[str, Any], rng_key=None):
+    """Run op under jax.vjp and tape it. Returns (jax outputs tuple, node).
+
+    The forward runs through the per-op jitted executable (jax.vjp of a
+    jit-wrapped fn keeps the compiled call; the transpose compiles too) —
+    so even taped eager ops execute as compiled XLA, matching the
+    reference's kernel-per-op execution."""
+    from .ops.registry import _jitted, canonical_attrs
+
+    fn = _jitted(opdef.name, canonical_attrs(attrs))
+
+    if opdef.needs_rng:
+        def closed(*xs):
+            return fn(rng_key, *xs)
+    else:
+        closed = fn
+
+    def tupled(*xs):
+        out = closed(*xs)
+        return out if isinstance(out, tuple) else (out,)
+
+    return _record_fn(opdef.name, tupled, nd_inputs, jax_inputs)
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):

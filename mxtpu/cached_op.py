@@ -1,0 +1,124 @@
+"""CachedOp: a reusable compiled graph for Gluon `hybridize()`.
+
+TPU-native re-design of the reference's CachedOp
+(`src/imperative/cached_op.{cc,h}`).  The reference caches an NNVM graph
+keyed by input signature and replays it through the engine with bulking;
+here the traced Symbol lowers to ONE jitted XLA callable (inference) and,
+under autograd, to `jax.vjp` over that jitted callable — the forward runs
+as a single compiled module, the transpose compiles on first backward, and
+the whole CachedOp tapes as a SINGLE autograd node (the reference tapes
+`_CachedOp` the same way).  static_alloc/static_shape have no analog: XLA
+executables are always statically planned.
+
+BatchNorm-family running stats inside the graph update functionally: the
+graph returns new aux values and the CachedOp writes them back into the
+aux NDArrays (reference: in-place aux mutation during forward).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+from . import autograd as _ag
+from .context import current_context
+from .executor import _build_graph_fn
+from .ndarray.ndarray import NDArray
+from .symbol.symbol import Symbol
+
+__all__ = ["CachedOp"]
+
+
+class CachedOp(object):
+    """Callable compiled graph.  Inputs are ALL graph arguments in
+    `symbol.list_arguments()` order; aux states (running stats) are passed
+    via `aux_arrays` and updated in place."""
+
+    def __init__(self, sym: Symbol, flags: Sequence[Tuple[str, Any]] = ()):
+        import jax
+
+        self._symbol = sym
+        self._flags = dict(flags)
+        self._arg_names = sym.list_arguments()
+        self._aux_names = sym.list_auxiliary_states()
+        self._n_outputs = len(sym.list_outputs())
+
+        infer_fn = _build_graph_fn(sym, self._arg_names, self._aux_names,
+                                   is_train=False)
+        train_fn = _build_graph_fn(sym, self._arg_names, self._aux_names,
+                                   is_train=True)
+
+        def fwd_infer(key, *flat):
+            n = len(self._arg_names)
+            outs, _ = infer_fn(list(flat[:n]), list(flat[n:]), key)
+            return tuple(outs)
+
+        def fwd_train(key, *flat):
+            n = len(self._arg_names)
+            outs, aux_new = train_fn(list(flat[:n]), list(flat[n:]), key)
+            return tuple(outs) + tuple(aux_new)
+
+        self._jit_infer = jax.jit(fwd_infer)
+        self._jit_train = jax.jit(fwd_train)
+        self._has_rng = any((not n.is_variable) and n.op.needs_rng
+                            for n in sym._topo())
+
+    @property
+    def symbol(self) -> Symbol:
+        return self._symbol
+
+    def _key(self):
+        if self._has_rng:
+            from . import random as _rnd
+
+            return _rnd._next_key()
+        import jax
+
+        return jax.random.PRNGKey(0)
+
+    def __call__(self, args: Sequence[NDArray],
+                 aux_arrays: Sequence[NDArray] = ()):
+        if len(args) != len(self._arg_names):
+            raise MXNetError("CachedOp expects %d args (%s), got %d"
+                             % (len(self._arg_names), self._arg_names,
+                                len(args)))
+        if len(aux_arrays) != len(self._aux_names):
+            raise MXNetError("CachedOp expects %d aux arrays, got %d"
+                             % (len(self._aux_names), len(aux_arrays)))
+        key = self._key()
+        flat = [a._data for a in args] + [a._data for a in aux_arrays]
+        ctx = args[0].ctx if args else current_context()
+        training = _ag.is_training()
+        recording = _ag.is_recording()
+
+        if recording:
+            if training:
+                def tupled(*xs):
+                    return self._jit_train(key, *xs)
+            else:
+                def tupled(*xs):
+                    return self._jit_infer(key, *xs)
+
+            all_nd = list(args) + list(aux_arrays)
+            outs, node = _ag._record_fn("_CachedOp", tupled, all_nd, flat)
+        else:
+            if training:
+                outs = self._jit_train(key, *flat)
+            else:
+                outs = self._jit_infer(key, *flat)
+            node = None
+
+        n_out = self._n_outputs
+        results = []
+        for i in range(n_out):
+            nd_out = NDArray(outs[i], ctx=ctx, _committed=True)
+            if node is not None:
+                nd_out._entry = (node, i)
+            results.append(nd_out)
+        # aux write-back (training graph returns updated aux after outputs)
+        if training and len(outs) > n_out:
+            for aux_arr, new_val in zip(aux_arrays, outs[n_out:]):
+                # detach from tape: aux updates carry no gradient
+                aux_arr._set_jax(new_val)
+        return results

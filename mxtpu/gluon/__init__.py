@@ -1,0 +1,12 @@
+"""`mxtpu.gluon` — imperative/hybrid high-level API (reference:
+`python/mxnet/gluon/`)."""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import rnn
+from . import data
+from . import utils
+from . import model_zoo

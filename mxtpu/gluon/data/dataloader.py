@@ -1,0 +1,124 @@
+"""DataLoader (reference: `python/mxnet/gluon/data/dataloader.py:26-111`).
+
+The reference forks worker processes that decode samples and ship them
+back through POSIX shared memory.  TPU-native design note: the heavy
+per-sample work (image decode/augment) belongs on host CPU threads while
+the chip runs ahead asynchronously, so this DataLoader uses a thread pool
+(`num_workers`) + a prefetch queue; batches land as committed host arrays
+ready for a single device transfer.  (The C++ IO pipeline in `src/` takes
+over the decode path as it lands.)
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray, array as nd_array
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        from ...ndarray import stack
+
+        return stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return nd_array(arr)
+
+
+class DataLoader(object):
+    def __init__(self, dataset: Dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 prefetch=None, thread_pool=True):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("batch_size is required when batch_sampler "
+                                 "is not given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise MXNetError("batch_size/shuffle/sampler/last_batch must "
+                             "not be set when batch_sampler is given")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        yield from self._threaded_iter()
+
+    def _threaded_iter(self):
+        """Thread-pool pipeline with bounded in-order prefetch."""
+        batches = list(self._batch_sampler)
+        results: "queue.Queue" = queue.Queue()
+        lock = threading.Lock()
+        next_submit = [0]
+        # bound how far workers run ahead of the consumer
+        budget = threading.Semaphore(max(self._prefetch, self._num_workers))
+
+        def worker():
+            while True:
+                budget.acquire()
+                with lock:
+                    i = next_submit[0]
+                    if i >= len(batches):
+                        budget.release()
+                        return
+                    next_submit[0] += 1
+                try:
+                    out = self._make_batch(batches[i])
+                    results.put((i, out, None))
+                except Exception as e:  # propagate to consumer
+                    results.put((i, None, e))
+
+        n_threads = min(self._num_workers, max(1, len(batches)))
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        want = 0
+        stash = {}
+        got = 0
+        while got < len(batches):
+            while want not in stash:
+                i, out, err = results.get()
+                stash[i] = (out, err)
+            out, err = stash.pop(want)
+            if err is not None:
+                raise err
+            yield out
+            budget.release()  # consumer consumed one: allow another ahead
+            want += 1
+            got += 1
+
+    def __len__(self):
+        return len(self._batch_sampler)

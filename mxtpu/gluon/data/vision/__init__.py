@@ -1,0 +1,3 @@
+"""`mxtpu.gluon.data.vision`."""
+from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100
+from . import transforms

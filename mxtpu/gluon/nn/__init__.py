@@ -1,0 +1,7 @@
+"""`mxtpu.gluon.nn` (reference: `python/mxnet/gluon/nn/`)."""
+from .basic_layers import *
+from .conv_layers import *
+from .basic_layers import Sequential, HybridSequential, Dense, Dropout, \
+    BatchNorm, LayerNorm, InstanceNorm, Embedding, Flatten, Lambda, \
+    HybridLambda, Activation, LeakyReLU, PReLU, ELU, SELU, GELU, Swish
+from ..block import Block, HybridBlock, SymbolBlock

@@ -1,0 +1,397 @@
+"""Parameter & ParameterDict (reference: `python/mxnet/gluon/parameter.py`).
+
+Same deferred-init lifecycle as the reference (`parameter.py:43`): shape
+may be partially unknown at construction (0 entries); `initialize()` defers
+until the first forward infers the full shape.  Data lives as one NDArray
+per context (single device by default; `reset_ctx`/multi-device replication
+handled by the Trainer/kvstore layer).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, OrderedDict as TOrderedDict
+from collections import OrderedDict
+
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+from ..context import Context, current_context, cpu
+from ..ndarray.ndarray import NDArray, zeros as nd_zeros
+from .. import initializer as _init_mod
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant",
+           "ParameterDict", "tensor_types"]
+
+tensor_types = (NDArray,)
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before its shape is known (reference
+    `parameter.py:36`)."""
+
+
+class Parameter(object):
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data: Optional[List[NDArray]] = None
+        self._grad: Optional[List[NDArray]] = None
+        self._ctx_list: Optional[List[Context]] = None
+        self._deferred_init = ()
+        self.name = name
+        self._shape = tuple(shape) if isinstance(shape, int) is False and \
+            shape is not None else ((shape,) if isinstance(shape, int) else None)
+        self.dtype = np_dtype(dtype) if dtype is not None else None
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req if differentiable else "null"
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._stype = stype
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self._shape,
+                                                      self.dtype)
+
+    # -- shape ------------------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        unknown_ok = all(s1 == s2 or s1 in (0, -1)
+                         for s1, s2 in zip(self._shape, new_shape)) \
+            and len(self._shape) == len(new_shape)
+        if not unknown_ok:
+            raise MXNetError(
+                "cannot update shape of %s from %s to %s"
+                % (self.name, self._shape, new_shape))
+        self._shape = tuple(new_shape)
+
+    def _shape_known(self):
+        return self._shape is not None and all(s > 0 for s in self._shape)
+
+    # -- init lifecycle ---------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        default_init = default_init or _init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if not self._shape_known():
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                "cannot initialize Parameter %s because it has invalid "
+                "shape %s; set allow_deferred_init=True or specify in_units/"
+                "in_channels" % (self.name, self._shape))
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init = self._deferred_init
+        if not self._shape_known():
+            raise DeferredInitializationError(
+                "Parameter %s has unknown shape %s after first forward"
+                % (self.name, self._shape))
+        self._deferred_init = ()
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        explicit = init if init is not None else self.init
+        data = nd_zeros(self._shape, ctx=ctx[0],
+                        dtype=self.dtype or np.float32)
+        if explicit is not None:
+            # an explicitly-chosen initializer wins over the name-suffix
+            # dispatch (reference passes it via the '__init__' attr hint,
+            # parameter.py:283) — bias_initializer='ones' must give ones
+            e = _init_mod.create(explicit) if isinstance(explicit, str) \
+                else explicit
+            if isinstance(e, _init_mod.Initializer):
+                e._init_weight(_init_mod.InitDesc(self.name), data)
+            else:
+                e(_init_mod.InitDesc(self.name), data)
+        else:
+            default = _init_mod.create(default_init) \
+                if isinstance(default_init, str) else default_init
+            default(_init_mod.InitDesc(self.name), data)
+        self._init_impl(data, ctx)
+
+    def _init_impl(self, data: NDArray, ctx_list: List[Context]):
+        self._data = [data if c == data.ctx else data.as_in_context(c)
+                      for c in ctx_list]
+        self._ctx_list = list(ctx_list)
+        self._init_grad()
+
+    def _init_grad(self):
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        self._grad = []
+        for d in self._data:
+            d.attach_grad(self.grad_req)
+            self._grad.append(d.grad)
+
+    # -- access -----------------------------------------------------------
+    def _check_initialized(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init:
+                raise DeferredInitializationError(
+                    "Parameter %s not initialized yet: first forward has not "
+                    "run" % self.name)
+            raise MXNetError(
+                "Parameter %s has not been initialized; call .initialize()"
+                % self.name)
+
+    def data(self, ctx: Optional[Context] = None) -> NDArray:
+        self._check_initialized(ctx)
+        if ctx is None:
+            return self._data[0]
+        for d in self._data:
+            if d.ctx == ctx:
+                return d
+        raise MXNetError("Parameter %s not initialized on %s" % (self.name,
+                                                                 ctx))
+
+    def list_data(self) -> List[NDArray]:
+        self._check_initialized()
+        return list(self._data)
+
+    def grad(self, ctx: Optional[Context] = None) -> NDArray:
+        self._check_initialized(ctx)
+        if self._grad is None:
+            raise MXNetError(
+                "Parameter %s has grad_req='null'; no gradient" % self.name)
+        if ctx is None:
+            return self._grad[0]
+        for d, g in zip(self._data, self._grad):
+            if d.ctx == ctx:
+                return g
+        raise MXNetError("no grad on ctx %s" % ctx)
+
+    def list_grad(self) -> List[NDArray]:
+        self._check_initialized()
+        if self._grad is None:
+            raise MXNetError("Parameter %s has grad_req='null'" % self.name)
+        return list(self._grad)
+
+    def list_ctx(self) -> List[Context]:
+        if self._data is None and self._deferred_init:
+            return list(self._deferred_init[1])
+        self._check_initialized()
+        return [d.ctx for d in self._data]
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        for g in self._grad:
+            g._set_jax((g * 0)._data)
+
+    def set_data(self, data):
+        self.shape = tuple(data.shape)
+        if self._data is None:
+            if self._deferred_init:
+                # keep for deferred finish
+                init, ctx, default_init = self._deferred_init
+                self._deferred_init = ()
+                src = data if isinstance(data, NDArray) else \
+                    NDArray(np.asarray(data))
+                self._init_impl(src.astype(self.dtype or src.dtype),
+                                ctx)
+                return
+            raise MXNetError("Parameter %s not initialized" % self.name)
+        for d in self._data:
+            src = data if isinstance(data, NDArray) else NDArray(
+                np.asarray(data), ctx=d.ctx)
+            src = src.astype(d.dtype) if src.dtype != d.dtype else src
+            d._set_jax(src.as_in_context(d.ctx)._data)
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is not None:
+            data = self._data[0]
+            self._init_impl(data.as_in_context(ctx[0]), ctx)
+        elif self._deferred_init:
+            init, _, default_init = self._deferred_init
+            self._deferred_init = (init, ctx, default_init)
+
+    def cast(self, dtype):
+        self.dtype = np_dtype(dtype)
+        if self._data is None:
+            return
+        with_grad = self._grad is not None
+        self._data = [d.astype(self.dtype) for d in self._data]
+        if with_grad:
+            self._init_grad()
+
+    def var(self):
+        from ..symbol.symbol import Variable
+
+        if self._var is None:
+            self._var = Variable(self.name, shape=self._shape
+                                 if self._shape_known() else None,
+                                 dtype=self.dtype)
+            if self.grad_req == "null" and (
+                    self.name.endswith("running_mean") or
+                    self.name.endswith("running_var") or
+                    self.name.endswith("moving_mean") or
+                    self.name.endswith("moving_var")):
+                self._var._outputs[0][0].is_aux = True
+        return self._var
+
+
+class Constant(Parameter):
+    """Non-learnable constant parameter (reference Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = NDArray(np.asarray(value, dtype=np.float32))
+        self.value = value
+
+        class _CInit(_init_mod.Initializer):
+            def _init_weight(s, _, arr):
+                _init_mod.Initializer._set(arr, value.asnumpy())
+
+            _init_default = _init_weight
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=_CInit())
+
+
+class ParameterDict(object):
+    """Prefixed dictionary of Parameters (reference `parameter.py:632`)."""
+
+    def __init__(self, prefix="", shared: Optional["ParameterDict"] = None):
+        self._prefix = prefix
+        self._params: "TOrderedDict[str, Parameter]" = OrderedDict()
+        self._shared = shared
+
+    def __repr__(self):
+        return "ParameterDict %s(%s)" % (
+            self._prefix, ", ".join(self._params))
+
+    def __getitem__(self, key) -> Parameter:
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs) -> Parameter:
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and existing is not None:
+                        # merge partial shapes
+                        v = tuple(v) if not isinstance(v, int) else (v,)
+                        if len(v) == len(existing):
+                            merged = tuple(
+                                a if a > 0 else b
+                                for a, b in zip(existing, v))
+                            param._shape = merged
+                        continue
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None) -> Constant:
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise MXNetError("no constant %r and no value given" % name)
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other: "ParameterDict"):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError("duplicate parameter %r" % k)
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = _init_mod.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import save as nd_save
+
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data()
+            if not param.name.startswith(strip_prefix):
+                raise MXNetError("prefix %r not in param name %r"
+                                 % (strip_prefix, param.name))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd_save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import load as nd_load
+
+        arg_dict = nd_load(filename)
+        arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise MXNetError("parameter %r missing in file" % name)
+        for name, val in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise MXNetError("parameter %r in file not in dict"
+                                     % name)
+                continue
+            self._params[name].set_data(val)

@@ -1,0 +1,5 @@
+"""`mxtpu.gluon.rnn` (reference: `python/mxnet/gluon/rnn/`)."""
+from .rnn_layer import RNN, LSTM, GRU
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell,
+                       LSTMCell, GRUCell, SequentialRNNCell, DropoutCell,
+                       ResidualCell, ZoneoutCell, BidirectionalCell)

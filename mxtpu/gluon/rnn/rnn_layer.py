@@ -1,0 +1,189 @@
+"""Fused recurrent layers RNN/LSTM/GRU (reference: `python/mxnet/gluon/rnn/
+rnn_layer.py`).
+
+Parameters are stored per-layer/direction ({l}{i}_i2h_weight ...) like the
+reference and packed into the flat cuDNN-layout vector consumed by the
+fused `RNN` op (lax.scan recurrence + batched MXU input projections).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ...ops.rnn_op import _GATES
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError("invalid layout %r; must be TNC or NTC" % layout)
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = _GATES[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in ["l", "r"][:self._dir]:
+                    self._register_param(
+                        "{}{}_i2h_weight".format(j, i),
+                        shape=(ng * nh, ni), init=i2h_weight_initializer)
+                    self._register_param(
+                        "{}{}_h2h_weight".format(j, i),
+                        shape=(ng * nh, nh), init=h2h_weight_initializer)
+                    self._register_param(
+                        "{}{}_i2h_bias".format(j, i),
+                        shape=(ng * nh,), init=i2h_bias_initializer)
+                    self._register_param(
+                        "{}{}_h2h_bias".format(j, i),
+                        shape=(ng * nh,), init=h2h_bias_initializer)
+                ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def _collect_ordered_params(self, F):
+        """Weights then biases, layer-major, direction-minor — the cuDNN
+        flat layout the RNN op unpacks."""
+        get = (lambda p: p.var()) if F.__name__.endswith("symbol") else \
+            (lambda p: p.data())
+        ws, bs = [], []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                ws.append(get(getattr(self, "{}{}_i2h_weight".format(j, i))))
+                ws.append(get(getattr(self, "{}{}_h2h_weight".format(j, i))))
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                bs.append(get(getattr(self, "{}{}_i2h_bias".format(j, i))))
+                bs.append(get(getattr(self, "{}{}_h2h_bias".format(j, i))))
+        return ws + bs
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as _nd
+
+        func = func or _nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            info = dict(info)
+            info.pop("__layout__", None)
+            info.update(kwargs)
+            states.append(func(**info))
+        return states
+
+    def __call__(self, inputs, states=None):
+        if states is None:
+            batch = inputs.shape[self._layout.find("N")]
+            states = self.begin_state(batch, ctx=inputs.ctx)
+            skip_states = True
+        else:
+            if hasattr(states, "shape"):
+                states = [states]
+            skip_states = False
+        out = super().__call__(inputs, states)
+        outputs, new_states = out
+        if skip_states:
+            return outputs
+        return outputs, new_states
+
+    def forward(self, inputs, states):
+        return super().forward(inputs, states)
+
+    def hybrid_forward(self, F, inputs, states, **kwargs):
+        if self._layout == "NTC":
+            inputs = F.SwapAxis(inputs, dim1=0, dim2=1)
+        # infer input_size on first call if deferred
+        params = self._collect_ordered_params(F)
+        flat = F._rnn_param_concat(*params, dim=0)
+        rnn_args = [inputs, flat] + list(states)
+        out = F.RNN(*rnn_args, state_size=self._hidden_size,
+                    num_layers=self._num_layers,
+                    bidirectional=self._dir == 2, mode=self._mode,
+                    p=self._dropout, state_outputs=True)
+        if self._mode == "lstm":
+            outputs, h, c = out
+            new_states = [h, c]
+        else:
+            outputs, h = out
+            new_states = [h]
+        if self._layout == "NTC":
+            outputs = F.SwapAxis(outputs, dim1=0, dim2=1)
+        return outputs, new_states
+
+    def _finish_shape(self, input_size):
+        ng, nh = self._gates, self._hidden_size
+        ni = input_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                getattr(self, "{}{}_i2h_weight".format(j, i)).shape = \
+                    (ng * nh, ni)
+            ni = nh * self._dir
+
+
+class RNN(_RNNLayer):
+    """Elman RNN with tanh/relu (reference rnn_layer.RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

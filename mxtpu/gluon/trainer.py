@@ -1,0 +1,172 @@
+"""Trainer (reference: `python/mxnet/gluon/trainer.py:27`).
+
+Applies an Optimizer to a set of Parameters.  Reference flow
+(`trainer.py:258`): `step()` -> `_allreduce_grads` (kvstore push/pull) ->
+`_update` (fused optimizer ops per device).  Here single-device updates
+run directly; multi-device/multi-chip gradient aggregation goes through
+the kvstore ('device'/'tpu' = XLA collectives — see mxtpu/kvstore.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer(object):
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a ParameterDict/list")
+        self._params: List[Parameter] = []
+        self._param2idx: Dict[str, int] = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError("invalid parameter %r" % p)
+            self._param2idx[p.name] = i
+            self._params.append(p)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._params_to_init = []
+        self._contexts = None
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params and list(optimizer_params) != ["rescale_grad"]:
+                raise MXNetError(
+                    "optimizer_params must be None when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer,
+                                             param_dict=param_dict,
+                                             **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx()
+            if contexts is not None and contexts != ctx:
+                raise MXNetError("all Parameters must be on the same "
+                                 "context set, got %s and %s"
+                                 % (contexts, ctx))
+            contexts = ctx
+        return contexts or []
+
+    def _init_kvstore(self):
+        self._contexts = self._check_contexts()
+        kv = self._kvstore_type
+        if kv is None or (isinstance(kv, str) and kv in ("", "none")) or \
+                len(self._contexts) <= 1 and kv in ("local", "device"):
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            from .. import kvstore as kv_mod
+
+            self._kvstore = kv if not isinstance(kv, str) \
+                else kv_mod.create(kv)
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(
+                    self._compression_params)
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = False
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._kvstore.init(i, param.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr if self._optimizer.lr_scheduler is None \
+            else self._optimizer.lr_scheduler(self._optimizer.num_update)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce gradients then apply optimizer (reference
+        `trainer.py:258`)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                self._kvstore.push(i, param.list_grad(), priority=-i)
+                if not self._update_on_kvstore:
+                    self._kvstore.pull(i, param.list_grad(), priority=-i,
+                                       ignore_sparse=False)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError("update() not supported with "
+                             "update_on_kvstore=True; call step()")
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if param._data is None:
+                if not ignore_stale_grad:
+                    raise MXNetError(
+                        "Parameter %s has not been initialized" % param.name)
+                continue
+            if self._update_on_kvstore and self._kvstore is not None:
+                self._kvstore.pull(i, param.list_data(), priority=-i)
+                continue
+            for upd, arr, grad in zip(
+                    self._updaters * len(param.list_data()),
+                    param.list_data(), param.list_grad()):
+                upd(i, grad, arr)
+
+    def save_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "rb") as f:
+            states = f.read()
+        for upd in self._updaters:
+            upd.set_states(states)
+            upd.optimizer = self._optimizer

@@ -1,0 +1,89 @@
+"""Gluon utilities (reference: `python/mxnet/gluon/utils.py`):
+split_data / split_and_load / clip_global_norm."""
+from __future__ import annotations
+
+import math
+from typing import List
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray.ndarray import NDArray, array as nd_array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis=0,
+               even_split=True) -> List[NDArray]:
+    """Split along the batch axis into `num_slice` pieces (reference
+    `utils.py:31`)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            "data with shape %s cannot be evenly split into %d slices; "
+            "set even_split=False" % (data.shape, num_slice))
+    step = size // num_slice
+    if not even_split:
+        step = int(math.ceil(size / num_slice))
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = min(size, (i + 1) * step)
+        if begin >= end:
+            break
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and move each slice to one context (reference `utils.py:88`)."""
+    if not isinstance(data, NDArray):
+        data = nd_array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm: float,
+                     check_isfinite=True):
+    """Rescale so the joint L2 norm <= max_norm (reference
+    `utils.py:117`)."""
+
+    def _norm(a):
+        return float((a * a).sum().asnumpy())
+
+    total = math.sqrt(sum(_norm(a) for a in arrays))
+    if check_isfinite and not math.isfinite(total):
+        import warnings
+
+        warnings.warn("nan or inf found in gradients; clip_global_norm did "
+                      "not rescale")
+        return total
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._set_jax((arr * scale)._data)
+    return total
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):  # pragma: no cover
+    raise MXNetError(
+        "download() is unavailable: this environment has no network egress. "
+        "Place files locally and pass the path instead.")

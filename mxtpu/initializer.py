@@ -1,0 +1,338 @@
+"""Weight initializers (reference: `python/mxnet/initializer.py`).
+
+Same registry/factory surface (`mx.init.Xavier()`, string shortcuts,
+pattern-based Mixed); initialization itself draws from the framework RNG
+chain so `mx.random.seed` reproduces parameter init like the reference.
+"""
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Zero", "One",
+           "Constant", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
+           "LSTMBias", "Mixed", "Load", "register", "create"]
+
+_INIT_REGISTRY: Dict[str, type] = {}
+
+
+def register(klass):
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs) -> "Initializer":
+    if isinstance(name, Initializer):
+        return name
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in _INIT_REGISTRY:
+        raise MXNetError("unknown initializer %r" % name)
+    return _INIT_REGISTRY[key](**kwargs)
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint (reference `initializer.py:46`)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer(object):
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    # -- dispatch by parameter name, like the reference ------------------
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        init_hint = desc.attrs.get("__init__", "")
+        if init_hint:
+            create(json.loads(init_hint)[0] if init_hint.startswith("[")
+                   else init_hint)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("moving_mean") or name.endswith("running_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_var") or name.endswith("running_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("moving_inv_var"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    def init_weight(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    # -- helpers ----------------------------------------------------------
+    @staticmethod
+    def _set(arr, value: np.ndarray):
+        from .ndarray.ndarray import NDArray
+
+        if isinstance(arr, NDArray):
+            arr._set_jax(__import__("jax").device_put(
+                value.astype(np.dtype(arr.dtype)), arr._data.device))
+        else:
+            arr[:] = value
+
+    def _rand_uniform(self, shape, low, high):
+        from . import random as _rnd
+
+        return np.asarray(_rnd.uniform(low, high, shape=tuple(shape)).asnumpy())
+
+    def _rand_normal(self, shape, sigma):
+        from . import random as _rnd
+
+        return np.asarray(_rnd.normal(0.0, sigma, shape=tuple(shape)).asnumpy())
+
+    def _init_zero(self, desc, arr):
+        self._set(arr, np.zeros(arr.shape, dtype=np.float32))
+
+    def _init_one(self, desc, arr):
+        self._set(arr, np.ones(arr.shape, dtype=np.float32))
+
+    def _init_bias(self, desc, arr):
+        self._init_zero(desc, arr)
+
+    def _init_gamma(self, desc, arr):
+        self._init_one(desc, arr)
+
+    def _init_beta(self, desc, arr):
+        self._init_zero(desc, arr)
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    def dumps(self) -> str:
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __repr__(self):
+        return "%s(%s)" % (self.__class__.__name__, self._kwargs)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        self._init_zero(desc, arr)
+
+
+_INIT_REGISTRY["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        self._init_one(desc, arr)
+
+
+_INIT_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, np.full(arr.shape, self.value, dtype=np.float32))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, self._rand_uniform(arr.shape, -self.scale, self.scale))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, self._rand_normal(arr.shape, self.sigma))
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = self._rand_uniform((nout, nin), -1.0, 1.0)
+        else:
+            tmp = self._rand_normal((nout, nin), 1.0)
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        self._set(arr, (self.scale * q).reshape(arr.shape))
+
+
+@register
+class Xavier(Initializer):
+    """Reference `initializer.py` Xavier: magnitude scaled by fan in/out."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError(
+                "Xavier initializer needs >= 2D weight, got %s for %s"
+                % (shape, desc))
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError("bad factor_type %r" % self.factor_type)
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            self._set(arr, self._rand_uniform(shape, -scale, scale))
+        else:
+            self._set(arr, self._rand_normal(shape, scale))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (for Deconvolution upsampling layers)."""
+
+    def _init_weight(self, desc, arr):
+        weight = np.zeros(int(np.prod(arr.shape)), dtype=np.float32)
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight.reshape(shape))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference `initializer.py` LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = np.zeros(arr.shape, dtype=np.float32)
+        n = arr.shape[0] // 4
+        b[n:2 * n] = self.forget_bias  # i, f, g, o gate order
+        self._set(arr, b)
+
+    _init_default = _init_weight
+    _init_bias = _init_weight
+
+
+class Load(object):
+    """Init from saved dict, fall back to default (reference Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        from .ndarray import load as nd_load
+
+        if isinstance(param, str):
+            param = nd_load(param)
+        self.param = {k.replace("arg:", "").replace("aux:", ""): v
+                      for k, v in param.items()}
+        self.default_init = default_init
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise MXNetError("shape mismatch loading %r" % name)
+            Initializer._set(arr, src.asnumpy())
+        else:
+            if self.default_init is None:
+                raise MXNetError("no init for %r" % name)
+            self.default_init(name, arr)
+
+
+class Mixed(object):
+    """Pattern-matched initializer list (reference Mixed)."""
+
+    def __init__(self, patterns: List[str], initializers: List[Initializer]):
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns/initializers length mismatch")
+        self.map = [(re.compile(p), init)
+                    for p, init in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.search(str(name)):
+                init(name, arr)
+                return
+        raise MXNetError("no initializer matched %r; add a '.*' pattern"
+                         % str(name))
+
+
+class init(object):  # namespace alias: mx.init.Xavier()
+    InitDesc = InitDesc
+    Initializer = Initializer
+    Uniform = Uniform
+    Normal = Normal
+    Zero = Zero
+    One = One
+    Constant = Constant
+    Orthogonal = Orthogonal
+    Xavier = Xavier
+    MSRAPrelu = MSRAPrelu
+    Bilinear = Bilinear
+    LSTMBias = LSTMBias
+    Mixed = Mixed
+    Load = Load

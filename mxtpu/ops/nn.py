@@ -658,24 +658,36 @@ def _softmax_cross_entropy(data, label):
 
 
 @register("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss", "_contrib_ctc_loss"))
-def _ctc_loss(data, label, blank_label="first",
+def _ctc_loss(data, label, *lengths, blank_label="first",
               use_data_lengths=False, use_label_lengths=False):
     """CTC loss (reference `src/operator/nn/ctc_loss.cc`).  data: (T, N, C),
-    label: (N, L) padded with 0 (blank at class 0, 'first' convention)."""
+    label: (N, L) padded with 0 (blank at class 0, 'first' convention).
+    Optional extra inputs in order: data_lengths (N,), label_lengths (N,)
+    when the corresponding use_*_lengths flag is set."""
     import optax
 
     jnp = _jnp()
     t, n, c = data.shape
     logits = jnp.transpose(data, (1, 0, 2))  # (N, T, C)
-    logit_pad = jnp.zeros((n, t), dtype=data.dtype)
-    labels = label.astype(np.int32)
-    label_pad = (labels <= 0).astype(data.dtype) if blank_label == "first" else \
-        (labels >= c - 1).astype(data.dtype)
-    if blank_label != "first":
-        blank_id = c - 1
+    li = 0
+    if use_data_lengths:
+        dlen = lengths[li].astype(np.int32)
+        li += 1
+        logit_pad = (jnp.arange(t)[None, :] >= dlen[:, None]).astype(data.dtype)
     else:
-        blank_id = 0
-    loss = optax.ctc_loss(logits, logit_pad, labels, label_pad, blank_id=blank_id)
+        logit_pad = jnp.zeros((n, t), dtype=data.dtype)
+    labels = label.astype(np.int32)
+    if use_label_lengths:
+        llen = lengths[li].astype(np.int32)
+        label_pad = (jnp.arange(label.shape[1])[None, :] >=
+                     llen[:, None]).astype(data.dtype)
+    elif blank_label == "first":
+        label_pad = (labels <= 0).astype(data.dtype)
+    else:
+        label_pad = (labels >= c - 1).astype(data.dtype)
+    blank_id = 0 if blank_label == "first" else c - 1
+    loss = optax.ctc_loss(logits, logit_pad, labels, label_pad,
+                          blank_id=blank_id)
     return loss
 
 

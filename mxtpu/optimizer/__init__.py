@@ -1,0 +1,7 @@
+"""`mxtpu.optimizer` (reference: `python/mxnet/optimizer/`)."""
+from .optimizer import (Optimizer, SGD, Signum, SignSGD, FTML, DCASGD, NAG,
+                        SGLD, Adam, AdaGrad, RMSProp, AdaDelta, Ftrl,
+                        Adamax, Nadam, LBSGD, Test, Updater, get_updater,
+                        create, register)
+
+opt = Optimizer

@@ -1,0 +1,583 @@
+"""Optimizers (reference: `python/mxnet/optimizer/optimizer.py`).
+
+Same registry + API (`Optimizer.create_optimizer('sgd', ...)`,
+`create_state`, `update(index, weight, grad, state)`, lr/wd multipliers,
+rescale_grad, clipping, `get_updater` for kvstore).  The arithmetic runs
+through the fused update ops (`mxtpu/ops/optimizer_ops.py`) so each update
+is one XLA executable, matching the reference's fused optimizer kernels
+(`src/operator/optimizer_op.cc`); results are written back into the
+weight/state NDArrays in place.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, imperative_invoke, zeros
+
+__all__ = ["Optimizer", "SGD", "Signum", "SignSGD", "FTML", "DCASGD", "NAG",
+           "SGLD", "Adam", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl",
+           "Adamax", "Nadam", "LBSGD", "Test", "Updater", "get_updater",
+           "create", "register"]
+
+
+class Optimizer(object):
+    opt_registry: Dict[str, type] = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() not in Optimizer.opt_registry:
+            raise MXNetError("unknown optimizer %r" % name)
+        return Optimizer.opt_registry[name.lower()](**kwargs)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult: Dict[Any, float] = {}
+        self.wd_mult: Dict[Any, float] = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[Any, int] = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.sym_info = ()
+        if sym is not None:
+            self.sym_info = (sym.attr_dict(), sym.list_arguments())
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == np.float16:
+            weight_master_copy = weight.astype(np.float32)
+            return (weight_master_copy, self.create_state(index,
+                                                          weight_master_copy))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            weight32, base_state = state
+            grad32 = grad.astype(np.float32)
+            self.update(index, weight32, grad32, base_state)
+            weight._set_jax(weight32._data.astype(weight._data.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- bookkeeping ------------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is set; cannot set lr directly")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attrs, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attrs and "__lr_mult__" in attrs[name]:
+                    self.lr_mult[name] = float(attrs[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attrs, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attrs and "__wd_mult__" in attrs[name]:
+                    self.wd_mult[name] = float(attrs[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx],
+                                  self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _common_kwargs(self):
+        kw = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+    @staticmethod
+    def _apply(op_name, weight, grad, states, **attrs):
+        """Run a fused update op and write results back in place."""
+        outs = imperative_invoke(op_name, weight, grad, *states, **attrs)
+        weight._set_jax(outs[0]._data)
+        for st, new in zip(states, outs[1:]):
+            st._set_jax(new._data)
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional multi-precision (reference
+    `optimizer.py:451-549`; fused ops sgd_update/sgd_mom_update/mp_*)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs()
+        if state is None:
+            self._apply("sgd_update", weight, grad, (), lr=lr, wd=wd, **kw)
+        else:
+            self._apply("sgd_mom_update", weight, grad, (state,), lr=lr,
+                        wd=wd, momentum=self.momentum, **kw)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs()
+        if state is None:
+            self._apply("signsgd_update", weight, grad, (), lr=lr, wd=wd, **kw)
+        else:
+            self._apply("signum_update", weight, grad, (state,), lr=lr, wd=wd,
+                        momentum=self.momentum, wd_lh=self.wd_lh, **kw)
+
+
+@register
+class SignSGD(Signum):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("momentum", 0.0)
+        super().__init__(**kwargs)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        self._apply("ftml_update", weight, grad, state, lr=lr, wd=wd,
+                    beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                    t=t, **self._common_kwargs())
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs()
+        if state is None:
+            self._apply("sgd_update", weight, grad, (), lr=lr, wd=wd, **kw)
+        else:
+            self._apply("nag_mom_update", weight, grad, (state,), lr=lr,
+                        wd=wd, momentum=self.momentum, **kw)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        from .. import random as _rnd
+
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        noise = _rnd.normal(0, math.sqrt(lr), shape=weight.shape,
+                            ctx=weight.ctx)
+        weight._set_jax(
+            (weight - (lr / 2) * (g + wd * weight) + noise)._data)
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous: Dict[Any, NDArray] = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        mom, previous = state
+        dc = self.lamda * g * g * (weight - previous)
+        if mom is not None:
+            mom._set_jax((self.momentum * mom - lr *
+                          (g + wd * weight + dc))._data)
+            step = mom
+        else:
+            step = -lr * (g + wd * weight + dc)
+        previous._set_jax(weight._data)
+        weight._set_jax((weight + step)._data)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        # bias correction folded into lr (reference adam)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr = lr * math.sqrt(coef2) / coef1
+        self._apply("adam_update", weight, grad, state, lr=lr, wd=wd,
+                    beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                    **self._common_kwargs())
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._apply("_sparse_adagrad_update", weight, grad, (state,), lr=lr,
+                    wd=wd, epsilon=self.float_stable_eps,
+                    **self._common_kwargs())
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                    zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                    zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype))
+        return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs()
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if self.centered:
+            self._apply("rmspropalex_update", weight, grad, state, lr=lr,
+                        wd=wd, gamma1=self.gamma1, gamma2=self.gamma2,
+                        epsilon=self.epsilon, **kw)
+        else:
+            self._apply("rmsprop_update", weight, grad, (state,), lr=lr,
+                        wd=wd, gamma1=self.gamma1, epsilon=self.epsilon, **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        self._apply("adadelta_update", weight, grad, state, rho=self.rho,
+                    epsilon=self.epsilon, wd=wd, **self._common_kwargs())
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._apply("ftrl_update", weight, grad, state, lr=lr, wd=wd,
+                    lamda1=self.lamda1, beta=self.beta,
+                    **self._common_kwargs())
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        from ..ndarray import ndarray as _nd_mod
+
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        m, u = state
+        m._set_jax((self.beta1 * m + (1.0 - self.beta1) * g)._data)
+        import jax.numpy as jnp
+
+        u._set_jax(jnp.maximum(self.beta2 * u._data, jnp.abs(g._data)))
+        weight._set_jax((weight - lr * m / (u + 1e-8))._data)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule *= momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        m._set_jax((self.beta1 * m + (1.0 - self.beta1) * g)._data)
+        v._set_jax((self.beta2 * v + (1.0 - self.beta2) * g * g)._data)
+        g_prime = g / (1.0 - self.m_schedule)
+        m_prime = m / (1.0 - m_schedule_next)
+        v_prime = v / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight._set_jax(
+            (weight - lr * m_bar / ((v_prime ** 0.5) + self.epsilon))._data)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise adaptive rate
+    (reference `optimizer.py:683`; simplified warmup handling)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False, warmup_strategy
+                 ="linear", warmup_epochs=5, batch_scale=1, updates_per_epoch
+                 =32, begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(momentum=momentum, multi_precision=multi_precision,
+                         **kwargs)
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.num_epochs = num_epochs
+
+    def _get_lars(self, weight, grad, wd):
+        w_norm = float(weight.norm().asnumpy())
+        g_norm = float(grad.norm().asnumpy())
+        if w_norm > 0 and g_norm > 0:
+            return w_norm / (g_norm + wd * w_norm + 1e-9)
+        return 1.0
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index) * self._get_lars(weight, grad,
+                                                  self._get_wd(index))
+        wd = self._get_wd(index)
+        kw = self._common_kwargs()
+        if state is None:
+            self._apply("sgd_update", weight, grad, (), lr=lr, wd=wd, **kw)
+        else:
+            self._apply("sgd_mom_update", weight, grad, (state,), lr=lr,
+                        wd=wd, momentum=self.momentum, **kw)
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer for tests (reference Test optimizer)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight._set_jax((weight + grad * self.rescale_grad)._data)
+        state._set_jax(weight._data)
+
+
+class Updater(object):
+    """kvstore-side updater closure (reference `optimizer.py` Updater)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def set_states(self, states):
+        import pickle
+
+        st = pickle.loads(states) if isinstance(states, bytes) else states
+        if isinstance(st, tuple) and len(st) == 2:
+            self.states, opt_state = st
+            if opt_state is not None:
+                self.optimizer.__dict__.update(opt_state)
+        else:
+            self.states = st
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        opt_state = None
+        if dump_optimizer:
+            # persist update counters so bias-corrected optimizers (Adam)
+            # resume with the right timestep; skip unpicklable members
+            opt_state = {
+                "num_update": self.optimizer.num_update,
+                "begin_num_update": self.optimizer.begin_num_update,
+                "_index_update_count": dict(
+                    self.optimizer._index_update_count),
+            }
+            if hasattr(self.optimizer, "m_schedule"):
+                opt_state["m_schedule"] = self.optimizer.m_schedule
+        return pickle.dumps((self.states, opt_state))
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

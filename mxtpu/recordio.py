@@ -1,0 +1,200 @@
+"""RecordIO file format (reference: `python/mxnet/recordio.py:37-378`,
+`src/io/image_recordio.h`).
+
+Binary-compatible with the reference's format: records framed by the magic
+`0xced7230a`, a length-word whose upper 3 bits carry the continuation
+cflag, 4-byte alignment padding, and an `.idx` sidecar of "key\\toffset"
+lines.  `IRHeader`/`pack`/`unpack`/`pack_img`/`unpack_img` match the
+reference API (image codecs go through PIL if present, else raw numpy
+buffers).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from collections import namedtuple
+from typing import Optional
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_CFLAG_BITS = 29
+_LEN_MASK = (1 << _CFLAG_BITS) - 1
+
+
+class MXRecordIO(object):
+    """Sequential record reader/writer (reference `recordio.py:37`)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        if flag not in ("r", "w"):
+            raise MXNetError("flag must be 'r' or 'w'")
+        self.open()
+
+    def open(self):
+        self._f = open(self.uri, "rb" if self.flag == "r" else "wb")
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self._f.close()
+            self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *args):
+        self.close()
+
+    def tell(self):
+        return self._f.tell()
+
+    def write(self, buf: bytes):
+        if self.flag != "w":
+            raise MXNetError("not opened for writing")
+        length = len(buf)
+        header = struct.pack("<II", _MAGIC, length & _LEN_MASK)
+        self._f.write(header)
+        self._f.write(buf)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self._f.write(b"\x00" * pad)
+
+    def read(self) -> Optional[bytes]:
+        if self.flag != "r":
+            raise MXNetError("not opened for reading")
+        header = self._f.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise MXNetError("invalid record magic 0x%x" % magic)
+        length = lrec & _LEN_MASK
+        buf = self._f.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self._f.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access record file with .idx sidecar (reference
+    `recordio.py:212`)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if flag == "r" and os.path.exists(idx_path):
+            with open(idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    key = key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.flag == "w" and getattr(self, "is_open", False):
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write("%s\t%d\n" % (key, self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        self._f.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack a header + payload (reference `recordio.py:340`)."""
+    flag = header.flag
+    label = header.label
+    if isinstance(label, (list, tuple, np.ndarray)) and not np.isscalar(label):
+        label = np.asarray(label, dtype=np.float32)
+        header_bytes = struct.pack(_IR_FORMAT, len(label), 0.0, header.id,
+                                   header.id2)
+        return header_bytes + label.tobytes() + s
+    return struct.pack(_IR_FORMAT, 0, float(label), header.id, header.id2) + s
+
+
+def unpack(s: bytes):
+    """Unpack to (IRHeader, payload)."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        arr = np.frombuffer(s[:flag * 4], dtype=np.float32)
+        return IRHeader(flag, arr, id_, id2), s[flag * 4:]
+    return IRHeader(flag, label, id_, id2), s
+
+
+def pack_img(header: IRHeader, img: np.ndarray, quality=95,
+             img_fmt=".jpg") -> bytes:
+    """Pack an image; uses PIL when available else raw .npy bytes."""
+    try:
+        import io
+
+        from PIL import Image
+
+        buf = io.BytesIO()
+        mode = "L" if img.ndim == 2 else "RGB"
+        Image.fromarray(img.astype(np.uint8), mode=mode).save(
+            buf, format="JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG",
+            quality=quality)
+        return pack(header, buf.getvalue())
+    except ImportError:
+        import io
+
+        buf = io.BytesIO()
+        np.save(buf, img)
+        return pack(header, buf.getvalue())
+
+
+def unpack_img(s: bytes, iscolor=-1):
+    header, payload = unpack(s)
+    try:
+        import io
+
+        from PIL import Image
+
+        img = np.asarray(Image.open(io.BytesIO(payload)))
+    except Exception:
+        import io
+
+        img = np.load(io.BytesIO(payload), allow_pickle=False)
+    return header, img

@@ -1,0 +1,253 @@
+"""Gluon tests (reference analog: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, autograd, gluon
+from mxtpu.gluon import nn
+
+
+def test_dense_forward_backward():
+    net = nn.Dense(4, in_units=3)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    x = nd.array(np.random.randn(2, 3).astype(np.float32))
+    with autograd.record():
+        y = net(x)
+        loss = y.sum()
+    loss.backward()
+    w = net.weight.data()
+    g = net.weight.grad()
+    assert y.shape == (2, 4)
+    np.testing.assert_allclose(g.asnumpy(), np.ones((2, 4)).T @ x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_dense_deferred_init():
+    net = nn.Dense(8)
+    net.initialize(ctx=mx.cpu())
+    x = nd.ones((4, 5))
+    y = net(x)
+    assert y.shape == (4, 8)
+    assert net.weight.shape == (8, 5)
+
+
+def test_sequential_mlp_training():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"))
+        net.add(nn.Dense(2))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5}, kvstore=None)
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 10).astype(np.float32)
+    w = rng.randn(10).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    xb, yb = nd.array(X), nd.array(y)
+    losses = []
+    for _ in range(40):
+        with autograd.record():
+            out = net(xb)
+            loss = loss_fn(out, yb)
+        loss.backward()
+        trainer.step(64)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_hybridize_matches_imperative():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(3))
+    net.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.randn(5, 8).astype(np.float32))
+    y_imp = net(x).asnumpy()
+    net.hybridize()
+    y_hyb = net(x).asnumpy()
+    np.testing.assert_allclose(y_imp, y_hyb, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_training_with_grads():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(2))
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01}, kvstore=None)
+    rng = np.random.RandomState(1)
+    X = rng.randn(32, 6).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32)
+    losses = []
+    for _ in range(50):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(X)), nd.array(y))
+        loss.backward()
+        trainer.step(32)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < losses[0] * 0.6
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, kernel_size=3, padding=1, activation="relu"))
+        net.add(nn.MaxPool2D())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(2))
+    net.initialize(ctx=mx.cpu())
+    x = nd.ones((2, 3, 8, 8))
+    y = net(x)
+    assert y.shape == (2, 2)
+    net.hybridize()
+    y2 = net(x)
+    np.testing.assert_allclose(y.asnumpy(), y2.asnumpy(), rtol=1e-5)
+
+
+def test_batchnorm_running_stats_eager_and_hybrid():
+    for hybridize in (False, True):
+        net = nn.BatchNorm(in_channels=3, momentum=0.5)
+        net.initialize(ctx=mx.cpu())
+        if hybridize:
+            net.hybridize()
+        x = nd.array(np.random.randn(8, 3).astype(np.float32) * 2 + 1)
+        with autograd.record():
+            y = net(x)
+        rm = net.running_mean.data().asnumpy()
+        expected = 0.5 * x.asnumpy().mean(0)
+        np.testing.assert_allclose(rm, expected, rtol=1e-3, atol=1e-4), \
+            ("hybrid" if hybridize else "eager")
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+        net.add(nn.Dense(2, in_units=4))
+    net.initialize(ctx=mx.cpu())
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+    x = nd.ones((1, 3))
+    y1 = net(x).asnumpy()
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3))
+        net2.add(nn.Dense(2, in_units=4))
+    net2.load_parameters(fname, ctx=mx.cpu())
+    y2 = net2(x).asnumpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-6)
+
+
+def test_losses():
+    pred = nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = nd.array([0.0, 1.0, 2.0, 3.0])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    expect = -np.log(np.exp(pred.asnumpy()) /
+                     np.exp(pred.asnumpy()).sum(1, keepdims=True))[
+        np.arange(4), label.asnumpy().astype(int)]
+    np.testing.assert_allclose(l.asnumpy(), expect, rtol=1e-5)
+    l2 = gluon.loss.L2Loss()(pred, nd.zeros((4, 5)))
+    np.testing.assert_allclose(l2.asnumpy(),
+                               (pred.asnumpy() ** 2).mean(1) / 2, rtol=1e-5)
+    l1 = gluon.loss.L1Loss()(pred, nd.zeros((4, 5)))
+    np.testing.assert_allclose(l1.asnumpy(),
+                               np.abs(pred.asnumpy()).mean(1), rtol=1e-5)
+
+
+def test_lstm_layer():
+    layer = gluon.rnn.LSTM(hidden_size=8, num_layers=2, input_size=4)
+    layer.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.randn(5, 3, 4).astype(np.float32))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 8)
+    states = layer.begin_state(batch_size=3)
+    out2, new_states = layer(x, states)
+    assert out2.shape == (5, 3, 8)
+    assert new_states[0].shape == (2, 3, 8)
+    assert new_states[1].shape == (2, 3, 8)
+
+
+def test_gru_bidirectional():
+    layer = gluon.rnn.GRU(hidden_size=4, num_layers=1, bidirectional=True,
+                          input_size=6)
+    layer.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.randn(7, 2, 6).astype(np.float32))
+    out = layer(x)
+    assert out.shape == (7, 2, 8)
+
+
+def test_lstm_cell_unroll():
+    cell = gluon.rnn.LSTMCell(hidden_size=8, input_size=4)
+    cell.initialize(ctx=mx.cpu())
+    inputs = [nd.array(np.random.randn(2, 4).astype(np.float32))
+              for _ in range(3)]
+    outputs, states = cell.unroll(3, inputs)
+    assert len(outputs) == 3
+    assert outputs[0].shape == (2, 8)
+    assert states[0].shape == (2, 8)
+
+
+def test_dataloader():
+    X = np.random.randn(25, 3).astype(np.float32)
+    y = np.arange(25).astype(np.float32)
+    dataset = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(dataset, batch_size=8, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (8, 3)
+    assert batches[-1][0].shape == (1, 3)
+    np.testing.assert_allclose(batches[0][1].asnumpy(), y[:8])
+    # threaded path keeps order
+    loader2 = gluon.data.DataLoader(dataset, batch_size=8, num_workers=3)
+    b2 = list(loader2)
+    np.testing.assert_allclose(b2[0][1].asnumpy(), y[:8])
+
+
+def test_split_and_load():
+    data = nd.arange(0, 12).reshape((6, 2))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(), mx.cpu()])
+    assert len(parts) == 2 and parts[0].shape == (3, 2)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    new_total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    np.testing.assert_allclose(new_total, 1.0, rtol=1e-4)
+
+
+def test_model_zoo_construct_small():
+    net = gluon.model_zoo.get_model("resnet18_v1", classes=10)
+    net.initialize(ctx=mx.cpu())
+    x = nd.ones((1, 3, 32, 32))
+    y = net(x)
+    assert y.shape == (1, 10)
+
+
+def test_mnist_dataset_synthetic():
+    ds = gluon.data.vision.MNIST(root="/nonexistent_dir_xyz", train=True)
+    assert len(ds) > 0
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    assert 0 <= int(label) < 10
+
+
+def test_export_symbolblock_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu", in_units=5))
+        net.add(nn.Dense(3, in_units=8))
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    x = nd.array(np.random.randn(2, 5).astype(np.float32))
+    y1 = net(x).asnumpy()
+    path = str(tmp_path / "exported")
+    net.export(path)
+    sb = gluon.SymbolBlock.imports(path + "-symbol.json", ["data0"],
+                                  path + "-0000.params", ctx=mx.cpu())
+    y2 = sb(x).asnumpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
