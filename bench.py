@@ -1,0 +1,70 @@
+"""Benchmark: ResNet-50 training throughput (images/sec) on one chip.
+
+Matches the reference's headline number (BASELINE.md: ResNet-50
+training, bs=32, fp32 — 298.51 img/s on 1xV100,
+`docs/faq/perf.md:208-217`; measured by
+`example/image-classification/train_imagenet.py` with synthetic data).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+BASELINE_TRAIN_IMGS_PER_SEC = 298.51  # 1xV100 fp32 bs=32
+BATCH = 32
+WARMUP = 3
+ITERS = 20
+
+
+def main():
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import autograd
+    from mxtpu.gluon import Trainer
+    from mxtpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxtpu.gluon.model_zoo import vision
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(ctx=ctx)
+    net.hybridize()
+
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(rng.rand(BATCH, 3, 224, 224).astype("float32"),
+                       ctx=ctx)
+    label = mx.nd.array(rng.randint(0, 1000, (BATCH,)).astype("float32"),
+                        ctx=ctx)
+    loss_fn = SoftmaxCrossEntropyLoss()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.01, "momentum": 0.9})
+
+    def step():
+        with autograd.record():
+            out = net(data)
+            loss = loss_fn(out, label)
+        loss.backward()
+        trainer.step(BATCH)
+        return loss
+
+    for _ in range(WARMUP):
+        step().wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = step()
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_bs32",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(imgs_per_sec / BASELINE_TRAIN_IMGS_PER_SEC,
+                             4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

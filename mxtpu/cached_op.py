@@ -63,6 +63,9 @@ class CachedOp(object):
         self._jit_train = jax.jit(fwd_train)
         self._has_rng = any((not n.is_variable) and n.op.needs_rng
                             for n in sym._topo())
+        # graphs without RNG ops get one fixed key (avoids a host-side
+        # key build + transfer on every hot-path call)
+        self._fixed_key = None if self._has_rng else jax.random.PRNGKey(0)
 
     @property
     def symbol(self) -> Symbol:
@@ -73,9 +76,7 @@ class CachedOp(object):
             from . import random as _rnd
 
             return _rnd._next_key()
-        import jax
-
-        return jax.random.PRNGKey(0)
+        return self._fixed_key
 
     def __call__(self, args: Sequence[NDArray],
                  aux_arrays: Sequence[NDArray] = ()):

@@ -140,12 +140,19 @@ class Block(object):
         return s.format(name=self.__class__.__name__, modstr=modstr)
 
     def __setattr__(self, name, value):
-        """Auto-register children and parameters (reference
-        `block.py:218`)."""
-        if hasattr(self, "_children") and isinstance(value, Block):
-            self._children[name] = value
-        if hasattr(self, "_reg_params") and isinstance(value, Parameter):
-            self._reg_params[name] = value
+        """Auto-register children and parameters; reassignment
+        unregisters the previous Block/Parameter bound to the name
+        (reference `block.py:218`)."""
+        if hasattr(self, "_children"):
+            if isinstance(value, Block):
+                self._children[name] = value
+            elif name in self._children:
+                del self._children[name]
+        if hasattr(self, "_reg_params"):
+            if isinstance(value, Parameter):
+                self._reg_params[name] = value
+            elif name in self._reg_params:
+                del self._reg_params[name]
         super().__setattr__(name, value)
 
     @property

@@ -82,12 +82,15 @@ class DataLoader(object):
         results: "queue.Queue" = queue.Queue()
         lock = threading.Lock()
         next_submit = [0]
+        stop = threading.Event()
         # bound how far workers run ahead of the consumer
         budget = threading.Semaphore(max(self._prefetch, self._num_workers))
 
         def worker():
             while True:
                 budget.acquire()
+                if stop.is_set():
+                    return
                 with lock:
                     i = next_submit[0]
                     if i >= len(batches):
@@ -105,20 +108,27 @@ class DataLoader(object):
                    for _ in range(n_threads)]
         for t in threads:
             t.start()
-        want = 0
-        stash = {}
-        got = 0
-        while got < len(batches):
-            while want not in stash:
-                i, out, err = results.get()
-                stash[i] = (out, err)
-            out, err = stash.pop(want)
-            if err is not None:
-                raise err
-            yield out
-            budget.release()  # consumer consumed one: allow another ahead
-            want += 1
-            got += 1
+        try:
+            want = 0
+            stash = {}
+            got = 0
+            while got < len(batches):
+                while want not in stash:
+                    i, out, err = results.get()
+                    stash[i] = (out, err)
+                out, err = stash.pop(want)
+                if err is not None:
+                    raise err
+                yield out
+                budget.release()  # consumer consumed: allow another ahead
+                want += 1
+                got += 1
+        finally:
+            # wake any blocked workers so they exit even if the consumer
+            # abandoned the generator early or a batch raised
+            stop.set()
+            for _ in threads:
+                budget.release()
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -319,14 +319,30 @@ class ParameterDict(object):
                 if hasattr(param, k) and getattr(param, k) is not None:
                     existing = getattr(param, k)
                     if k == "shape" and v is not None and existing is not None:
-                        # merge partial shapes
+                        # merge partial shapes; positive dims must agree
                         v = tuple(v) if not isinstance(v, int) else (v,)
                         if len(v) == len(existing):
+                            if any(a > 0 and b > 0 and a != b
+                                   for a, b in zip(existing, v)):
+                                raise MXNetError(
+                                    "Parameter %r already has shape %s, "
+                                    "inconsistent with requested %s"
+                                    % (name, existing, v))
                             merged = tuple(
                                 a if a > 0 else b
                                 for a, b in zip(existing, v))
                             param._shape = merged
+                        elif all(d > 0 for d in existing + v):
+                            raise MXNetError(
+                                "Parameter %r already has shape %s, "
+                                "inconsistent with requested %s"
+                                % (name, existing, v))
                         continue
+                    if k in ("dtype", "init", "grad_req") and \
+                            existing != v and v is not None:
+                        raise MXNetError(
+                            "Parameter %r already has %s=%r, inconsistent "
+                            "with requested %r" % (name, k, existing, v))
                 else:
                     setattr(param, k, v)
         return param

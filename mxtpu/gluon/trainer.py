@@ -151,9 +151,16 @@ class Trainer(object):
             if self._update_on_kvstore and self._kvstore is not None:
                 self._kvstore.pull(i, param.list_data(), priority=-i)
                 continue
-            for upd, arr, grad in zip(
-                    self._updaters * len(param.list_data()),
-                    param.list_data(), param.list_grad()):
+            # one updater per device replica: optimizer state (momentum,
+            # Adam m/v, step count) must not be shared across copies
+            # (reference keeps one updater per device too)
+            n_dev = len(param.list_data())
+            while len(self._updaters) < n_dev:
+                self._updaters.append(
+                    opt_mod.get_updater(self._optimizer))
+            for upd, arr, grad in zip(self._updaters,
+                                      param.list_data(),
+                                      param.list_grad()):
                 upd(i, grad, arr)
 
     def save_states(self, fname):

@@ -1,0 +1,45 @@
+"""mxtpu.parallel — TPU-native parallelism subsystem.
+
+This is the capability the reference implements with NCCL/ps-lite/manual
+`group2ctx` placement (SURVEY.md §2.4), re-designed for TPU: a single
+SPMD program over a `jax.sharding.Mesh`, with XLA collectives riding ICI.
+
+  * data parallel   — batch sharded over the "dp" mesh axis; gradient
+                      psum replaces KVStore push/pull (reference:
+                      `src/kvstore/comm.h`, `kvstore_nccl.h`).
+  * tensor parallel — weight matrices sharded over "tp"
+                      (column/row-parallel Dense; absent upstream,
+                      SURVEY.md §2.4 marks it "must be first-class").
+  * sequence/context parallel — ring attention over "sp" via
+                      `ppermute` neighbor exchange (absent upstream).
+  * pipeline parallel — stage-stacked weights over "pp", microbatch
+                      rotation via collective-permute (absent upstream;
+                      the reference only overlaps the DAG in its engine).
+  * expert parallel — MoE all_to_all dispatch over "ep".
+
+Public surface:
+  create_mesh / default_mesh_shape / MeshContext
+  collectives: all_reduce, all_gather, reduce_scatter, all_to_all,
+               collective_permute (engine-level, usable on NDArray)
+  ring_attention, blockwise_attention
+  ColumnParallelDense / RowParallelDense (gluon blocks w/ shardings)
+  transformer: sharded flagship TransformerLM + train_step (used by
+               __graft_entry__.dryrun_multichip)
+"""
+from .mesh import (create_mesh, default_mesh_shape, MeshContext,
+                   current_mesh, AXIS_DP, AXIS_TP, AXIS_PP, AXIS_SP,
+                   AXIS_EP)
+from .collectives import (all_reduce, all_gather, reduce_scatter,
+                          all_to_all, collective_permute, psum_scalar)
+from .ring_attention import ring_attention, blockwise_attention
+from . import transformer
+from .transformer import TransformerConfig
+
+__all__ = [
+    "create_mesh", "default_mesh_shape", "MeshContext", "current_mesh",
+    "AXIS_DP", "AXIS_TP", "AXIS_PP", "AXIS_SP", "AXIS_EP",
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+    "collective_permute", "psum_scalar",
+    "ring_attention", "blockwise_attention",
+    "transformer", "TransformerConfig",
+]

@@ -1,0 +1,200 @@
+"""Parallel subsystem tests on the virtual 8-device CPU mesh.
+
+Analog of the reference's single-process multi-device kvstore/consistency
+tests (`tests/python/unittest/test_kvstore.py`, gpu `check_consistency`):
+the ground truth for every sharded computation is the same computation on
+a 1-device mesh.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxtpu.parallel as par
+from mxtpu.parallel import transformer as tfm
+from mxtpu.parallel.mesh import (AXIS_DP, AXIS_PP, AXIS_TP, AXIS_SP,
+                                 AXIS_EP)
+
+
+def _mesh(dp=1, pp=1, tp=1, sp=1, ep=1):
+    n = dp * pp * tp * sp * ep
+    return par.create_mesh({AXIS_DP: dp, AXIS_PP: pp, AXIS_TP: tp,
+                            AXIS_SP: sp, AXIS_EP: ep},
+                           devices=jax.devices()[:n])
+
+
+def _data(cfg, B, T, seed=0):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(0, cfg.vocab, (B, T)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab, (B, T)).astype(np.int32)
+    return tokens, labels
+
+
+def _run_forward(cfg, mesh, tokens):
+    params = tfm.init_params(cfg, mesh, seed=3)
+    fwd = tfm.make_forward(cfg, mesh)
+    return np.asarray(jax.device_get(fwd(params, tokens)))
+
+
+CFG = tfm.TransformerConfig(vocab=32, d_model=16, n_heads=4, n_layers=2,
+                            d_ff=32, n_experts=0, max_len=64,
+                            dtype="float32")
+
+
+class TestShardedForwardConsistency:
+    def _check(self, **mesh_kw):
+        tokens, _ = _data(CFG, 4, 16)
+        ref = _run_forward(CFG, _mesh(), tokens)
+        got = _run_forward(CFG, _mesh(**mesh_kw), tokens)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    def test_dp(self):
+        self._check(dp=4)
+
+    def test_tp(self):
+        self._check(tp=4)
+
+    def test_sp(self):
+        self._check(sp=4)
+
+    def test_pp(self):
+        self._check(pp=2)
+
+    def test_all_axes(self):
+        self._check(dp=2, pp=2, tp=2)
+
+    def test_tp_sp(self):
+        self._check(tp=2, sp=2)
+
+
+class TestShardedTrainConsistency:
+    def _loss(self, cfg, mesh, n_micro=2):
+        tokens, labels = _data(cfg, 8, 16, seed=1)
+        params = tfm.init_params(cfg, mesh, seed=3)
+        step, sh = tfm.make_train_step(cfg, mesh, n_micro=n_micro,
+                                       lr=1e-2)
+        t = jax.device_put(tokens, sh["data"])
+        l = jax.device_put(labels, sh["data"])
+        losses = []
+        for _ in range(3):
+            params, loss = step(params, t, l)
+            losses.append(float(jax.device_get(loss)))
+        return losses
+
+    def test_train_matches_single_device(self):
+        ref = self._loss(CFG, _mesh())
+        got = self._loss(CFG, _mesh(dp=2, pp=2, tp=2))
+        np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
+        assert ref[-1] < ref[0]  # it actually learns
+
+    def test_train_sp_ring(self):
+        ref = self._loss(CFG, _mesh())
+        got = self._loss(CFG, _mesh(sp=4))
+        np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
+
+    def test_train_moe_ep(self):
+        cfg = tfm.TransformerConfig(vocab=32, d_model=16, n_heads=4,
+                                    n_layers=2, d_ff=32, n_experts=4,
+                                    max_len=64, dtype="float32")
+        ref = self._loss(cfg, _mesh())
+        got = self._loss(cfg, _mesh(ep=4))
+        np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
+
+
+class TestRingAttention:
+    def _naive(self, q, k, v, causal):
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if causal:
+            T = q.shape[2]
+            mask = np.triu(np.ones((T, T), bool), 1)
+            s = np.where(mask, -1e30, s)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_blockwise_matches_naive(self, causal):
+        rng = np.random.RandomState(0)
+        q, k, v = (rng.randn(2, 2, 33, 8).astype(np.float32)
+                   for _ in range(3))
+        out = np.asarray(par.blockwise_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            block_size=8, causal=causal))
+        np.testing.assert_allclose(out, self._naive(q, k, v, causal),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ring_matches_naive(self, causal):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _mesh(sp=4)
+        rng = np.random.RandomState(1)
+        q, k, v = (rng.randn(2, 2, 32, 8).astype(np.float32)
+                   for _ in range(3))
+
+        def f(q, k, v):
+            return par.ring_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), axis_name=AXIS_SP,
+                                      causal=causal)
+
+        spec = P(None, None, AXIS_SP, None)
+        sm = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+        out = np.asarray(jax.device_get(sm(q, k, v)))
+        np.testing.assert_allclose(out, self._naive(q, k, v, causal),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestCollectives:
+    def test_all_reduce(self):
+        mesh = _mesh(dp=8)
+        x = np.arange(16, dtype=np.float32).reshape(8, 2)
+        out = par.all_reduce(x, axis=AXIS_DP, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), x.sum(0,
+                                                          keepdims=True))
+
+    def test_all_gather(self):
+        mesh = _mesh(dp=8)
+        x = np.arange(8, dtype=np.float32)
+        out = par.all_gather(x, axis=AXIS_DP, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), x)
+
+    def test_reduce_scatter(self):
+        mesh = _mesh(dp=8)
+        # 8 stacked per-shard contributions of length 8: output is the
+        # elementwise sum, distributed one element per device
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        out = par.reduce_scatter(x.reshape(-1), axis=AXIS_DP, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), x.sum(0))
+
+    def test_collective_permute(self):
+        mesh = _mesh(dp=8)
+        x = np.arange(8, dtype=np.float32)
+        perm = [(i, (i + 1) % 8) for i in range(8)]
+        out = par.collective_permute(x, perm, axis=AXIS_DP, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), np.roll(x, 1))
+
+    def test_psum_scalar(self):
+        mesh = _mesh(dp=8)
+        assert par.psum_scalar(2.5, axis=AXIS_DP, mesh=mesh) == 20.0
+
+
+class TestMesh:
+    def test_default_shape(self):
+        s = par.default_mesh_shape(8, tp=2)
+        assert s == {"dp": 4, "pp": 1, "tp": 2, "sp": 1, "ep": 1}
+
+    def test_bad_factor(self):
+        from mxtpu.base import MXNetError
+
+        with pytest.raises(MXNetError):
+            par.default_mesh_shape(8, tp=3)
+
+    def test_mesh_context(self):
+        mesh = _mesh(dp=8)
+        assert par.current_mesh() is None
+        with par.MeshContext(mesh):
+            assert par.current_mesh() is mesh
+        assert par.current_mesh() is None
