@@ -46,6 +46,8 @@ from . import optimizer
 from . import lr_scheduler
 from . import metric
 from . import recordio
+from . import io
+from . import test_utils
 from . import gluon
 
 

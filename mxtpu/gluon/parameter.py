@@ -332,7 +332,8 @@ class ParameterDict(object):
                                 a if a > 0 else b
                                 for a, b in zip(existing, v))
                             param._shape = merged
-                        elif all(d > 0 for d in existing + v):
+                        else:  # rank mismatch is inconsistent regardless
+                            # of unknown dims
                             raise MXNetError(
                                 "Parameter %r already has shape %s, "
                                 "inconsistent with requested %s"

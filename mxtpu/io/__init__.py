@@ -1,0 +1,21 @@
+"""`mxtpu.io` — data iterators (reference: `python/mxnet/io/io.py`,
+`src/io/*`).
+
+The reference's IO layer is a C++ iterator registry (`src/io/io.cc`)
+with a threaded decode pipeline, surfaced in python as `DataIter`
+subclasses.  TPU-native design: iterators produce *host* numpy batches
+on background threads (decode/augment belongs on host CPU while the
+chip runs ahead); the single device transfer happens when the consumer
+touches `batch.data` as NDArray.  The C++ pipeline in `src/` (recordio
+chunk reader) backs `ImageRecordIter` when built.
+"""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, CSVIter, LibSVMIter, MNISTIter,
+                 SimpleIter, create)
+from .record_iter import ImageRecordIter, ImageRecordIter_v1, \
+    ImageRecordUInt8Iter, ImageDetRecordIter
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter",
+           "SimpleIter", "ImageRecordIter", "ImageRecordIter_v1",
+           "ImageRecordUInt8Iter", "ImageDetRecordIter", "create"]

@@ -1,0 +1,546 @@
+"""Core data iterators.
+
+Reference: `python/mxnet/io/io.py` (`DataIter` ABC :178, `NDArrayIter`
+:489, `PrefetchingIter` :345, `MXDataIter` :788) and the C++ iterators
+behind it (`src/io/iter_mnist.cc`, `iter_csv.cc`, `iter_libsvm.cc`).
+Iterators here are pure python/numpy on host threads; batches are
+converted to NDArray lazily so a full prefetch pipeline never touches
+the device.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import queue
+import struct
+import threading
+from collections import namedtuple
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array as nd_array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter",
+           "SimpleIter", "create"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Name/shape/type descriptor (reference `io.py` DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super(DataDesc, cls).__new__(cls, name, tuple(shape))
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch(object):
+    """A mini-batch: list of data arrays + list of label arrays
+    (reference `io.py` DataBatch)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            raise MXNetError("DataBatch.data must be a list of arrays")
+        if label is not None and not isinstance(label, (list, tuple)):
+            raise MXNetError("DataBatch.label must be a list of arrays")
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        shapes = [d.shape for d in self.data] if self.data else []
+        lshapes = [l.shape for l in self.label] if self.label else []
+        return "DataBatch: data shapes: %s label shapes: %s" % (shapes,
+                                                                lshapes)
+
+
+class DataIter(object):
+    """Iterator base (reference `io.py:178`).  Subclasses implement
+    `next()` raising StopIteration, plus `reset`, `provide_data`,
+    `provide_label`."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        return False
+
+    def getdata(self):
+        return None
+
+    def getlabel(self):
+        return None
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _as_nd_list(data, allow_empty=False, default_name="data"):
+    """Normalize data argument to list of (name, array) like the
+    reference's _init_data (`io.py`)."""
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data cannot be None")
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = {default_name: data}
+    if isinstance(data, (list, tuple)):
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d
+                    for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        v = np.asarray(v)
+        if v.dtype == np.float64:
+            v = v.astype(np.float32)
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays with shuffle/pad semantics
+    (reference `io.py:489`).
+
+    last_batch_handle: 'pad' (wrap around, report pad count),
+    'discard' (drop tail), 'roll_over' (tail carried to next epoch).
+    """
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super(NDArrayIter, self).__init__(batch_size)
+        self.data = _as_nd_list(data, default_name=data_name)
+        self.label = _as_nd_list(label, allow_empty=True,
+                                 default_name=label_name)
+        self.num_data = self.data[0][1].shape[0] if self.data else 0
+        for k, v in self.data + self.label:
+            if v.shape[0] != self.num_data:
+                raise MXNetError("inconsistent first dims: %s" % k)
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError("bad last_batch_handle %r" % last_batch_handle)
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.idx = np.arange(self.num_data)
+        self.cursor = -batch_size
+        self._cache = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and \
+                0 < self.cursor < self.num_data:
+            # keep the tail for next epoch (reference roll_over)
+            self.cursor = -self.batch_size + (self.cursor % self.num_data)
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, arrays):
+        lo = self.cursor
+        hi = self.cursor + self.batch_size
+        out = []
+        for _, v in arrays:
+            if lo < 0:  # roll_over head
+                sel = self.idx[np.arange(lo, hi) % self.num_data]
+            elif hi <= self.num_data:
+                sel = self.idx[lo:hi]
+            else:  # pad: wrap
+                sel = np.concatenate([self.idx[lo:],
+                                      self.idx[:hi - self.num_data]])
+            out.append(nd_array(v[sel]))
+        return out
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label) if self.label else []
+
+    def getpad(self):
+        hi = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and hi > self.num_data:
+            return hi - self.num_data
+        return 0
+
+    def getindex(self):
+        lo = max(self.cursor, 0)
+        hi = self.cursor + self.batch_size
+        return self.idx[np.arange(lo, hi) % self.num_data]
+
+
+class SimpleIter(DataIter):
+    """Wrap a python generator of DataBatch (used in examples/tests)."""
+
+    def __init__(self, provide_data, provide_label, gen_fn, num_batches):
+        super(SimpleIter, self).__init__(provide_data[0].shape[0])
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+        self._gen_fn = gen_fn
+        self._num = num_batches
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        if self._i >= self._num:
+            raise StopIteration
+        self._i += 1
+        data, label = self._gen_fn(self._i - 1)
+        return DataBatch(data=[nd_array(d) for d in data],
+                         label=[nd_array(l) for l in label],
+                         pad=0, index=None,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to `size` batches per epoch, resetting the
+    underlying iterator as needed (reference `io.py` ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super(ResizeIter, self).__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch over one or more iterators (reference
+    `io.py:345`, backed in C++ by `dmlc::ThreadedIter`,
+    `src/io/iter_prefetcher.h`).  When the native engine extension is
+    built, the producer runs on its IO lane."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super(PrefetchingIter, self).__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                     for d in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                     for d in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def _producer(self):
+        while not self._stop.is_set():
+            try:
+                batches = [i.next() for i in self.iters]
+            except StopIteration:
+                self._queue.put(None)
+                return
+            except Exception as e:  # surface async errors at next()
+                self._queue.put(e)
+                return
+            self._queue.put(batches)
+
+    def _start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join()
+        for i in self.iters:
+            i.reset()
+        self._start()
+
+    def next(self):
+        got = self._queue.get()
+        if got is None:
+            raise StopIteration
+        if isinstance(got, Exception):
+            raise got
+        batches = got
+        if len(batches) == 1:
+            return batches[0]
+        return DataBatch(
+            data=sum([b.data for b in batches], []),
+            label=sum([(b.label or []) for b in batches], []),
+            pad=max(b.pad or 0 for b in batches),
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
+    def __del__(self):
+        self._stop.set()
+
+
+class CSVIter(DataIter):
+    """Stream a CSV file in fixed-shape rows (reference C++
+    `src/io/iter_csv.cc`, registered as CSVIter)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32", **_):
+        super(CSVIter, self).__init__(batch_size)
+        self.data_shape = tuple(int(s) for s in
+                                (data_shape if isinstance(data_shape,
+                                                          (tuple, list))
+                                 else eval(str(data_shape))))
+        self.label_shape = tuple(int(s) for s in
+                                 (label_shape if isinstance(label_shape,
+                                                            (tuple, list))
+                                  else eval(str(label_shape))))
+        data = np.loadtxt(data_csv, delimiter=",",
+                          dtype=np.dtype(dtype), ndmin=2)
+        data = data.reshape((-1,) + self.data_shape)
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2).reshape((-1,) + self.label_shape)
+        else:
+            label = np.zeros((data.shape[0],) + self.label_shape,
+                             dtype=np.float32)
+        self._inner = NDArrayIter(
+            {"data": data}, {"label": label}, batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class LibSVMIter(DataIter):
+    """LibSVM text format -> (dense or CSR) batches (reference
+    `src/io/iter_libsvm.cc`).  Values materialize as CSR NDArray."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, round_batch=True, **_):
+        super(LibSVMIter, self).__init__(batch_size)
+        self.data_shape = tuple(data_shape) if isinstance(
+            data_shape, (tuple, list)) else (int(data_shape),)
+        num_col = int(np.prod(self.data_shape))
+        labels, rows = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = np.zeros(num_col, dtype=np.float32)
+                for kv in parts[1:]:
+                    k, v = kv.split(":")
+                    row[int(k)] = float(v)
+                rows.append(row)
+        data = np.stack(rows) if rows else np.zeros((0, num_col), np.float32)
+        label = np.asarray(labels, dtype=np.float32).reshape(-1, 1)
+        if label_libsvm is not None:
+            with open(label_libsvm) as f:
+                label = np.asarray(
+                    [[float(t) for t in line.split()]
+                     for line in f if line.strip()], dtype=np.float32)
+        self._sparse = True
+        self._inner = NDArrayIter(
+            {"data": data}, {"label": label}, batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        batch = self._inner.next()
+        try:  # present data as CSR like the reference iterator
+            batch.data = [d.tostype("csr") for d in batch.data]
+        except (AttributeError, MXNetError):
+            pass
+        return batch
+
+
+def _read_idx_file(path):
+    """Read an IDX (MNIST) file, gz-transparent."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dtype_code = (magic >> 8) & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        dt = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+              0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}[dtype_code]
+        data = np.frombuffer(f.read(), dtype=np.dtype(dt).newbyteorder(">"))
+        return data.reshape(dims).astype(dt)
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-file iterator (reference `src/io/iter_mnist.cc`).
+    Reads local idx/idx.gz files; `flat` yields (batch, 784)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=False, seed=0, **_):
+        super(MNISTIter, self).__init__(batch_size)
+        img = _read_idx_file(image).astype(np.float32) / 255.0
+        lab = _read_idx_file(label).astype(np.float32)
+        if flat:
+            img = img.reshape(img.shape[0], -1)
+        else:
+            img = img.reshape(img.shape[0], 1, img.shape[1], img.shape[2])
+        if shuffle:
+            rng = np.random.RandomState(seed)
+            order = rng.permutation(img.shape[0])
+            img, lab = img[order], lab[order]
+        self._inner = NDArrayIter({"data": img}, {"softmax_label": lab},
+                                  batch_size=batch_size,
+                                  last_batch_handle="discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+_ITER_REGISTRY = {
+    "MNISTIter": MNISTIter,
+    "CSVIter": CSVIter,
+    "LibSVMIter": LibSVMIter,
+    "NDArrayIter": NDArrayIter,
+}
+
+
+def create(name, **kwargs):
+    """Create a registered iterator by name (analog of
+    `MXDataIterCreateIter`, `src/io/io.cc` registry)."""
+    if name not in _ITER_REGISTRY:
+        raise MXNetError("unknown data iter %r (have %s)" %
+                         (name, sorted(_ITER_REGISTRY)))
+    return _ITER_REGISTRY[name](**kwargs)

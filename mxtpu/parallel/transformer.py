@@ -250,8 +250,7 @@ def _moe_ffn(cfg, x, router, we1, we2, ep_size):
     else:
         y = y.reshape(NE, cap, E)
 
-    out = y[expert, safe_pos] * jnp.where(keep, gate, 0.0)[:, None] \
-        .astype(x.dtype)
+    out = y[expert, safe_pos] * gate[:, None].astype(x.dtype)
     return out.reshape(B, T, E)
 
 

@@ -1,0 +1,319 @@
+"""Shared test infrastructure (reference: `python/mxnet/test_utils.py`,
+2,029 LoC).
+
+The reference's test strategy (SURVEY.md §4) rests on a small set of
+helpers used by every per-op test: `assert_almost_equal`,
+`check_numeric_gradient` (finite differences vs autograd),
+`check_symbolic_forward/backward`, `rand_ndarray`, `default_context`.
+This module provides the same surface for the TPU build; the
+cross-device ground truth (reference: CPU-vs-GPU `check_consistency`,
+`tests/python/gpu/test_operator_gpu.py`) becomes CPU-vs-TPU.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray.ndarray import NDArray, array as nd_array
+
+__all__ = [
+    "default_context", "set_default_context", "default_dtype",
+    "assert_almost_equal", "almost_equal", "same", "rand_shape_2d",
+    "rand_shape_3d", "rand_shape_nd", "rand_ndarray", "random_arrays",
+    "check_numeric_gradient", "check_symbolic_forward",
+    "check_symbolic_backward", "check_consistency", "numeric_grad",
+    "simple_forward", "create_2d_tensor",
+]
+
+_default_ctx: Optional[Context] = None
+
+
+def default_context() -> Context:
+    """Context for tests; honors MXNET_TEST_DEVICE=cpu|tpu
+    (analog of the reference's default_context switched by env)."""
+    global _default_ctx
+    if _default_ctx is not None:
+        return _default_ctx
+    dev = os.environ.get("MXNET_TEST_DEVICE", "")
+    if dev == "cpu":
+        return cpu()
+    if dev.startswith("tpu"):
+        from .context import tpu
+        return tpu()
+    return current_context()
+
+
+def set_default_context(ctx: Context):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def _asnumpy(x) -> np.ndarray:
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def same(a, b) -> bool:
+    return np.array_equal(_asnumpy(a), _asnumpy(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False) -> bool:
+    return np.allclose(_asnumpy(a), _asnumpy(b), rtol=rtol, atol=atol,
+                       equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
+                        equal_nan=False):
+    a_np, b_np = _asnumpy(a), _asnumpy(b)
+    if a_np.shape != b_np.shape:
+        raise AssertionError(
+            "shape mismatch %s %s vs %s %s" %
+            (names[0], a_np.shape, names[1], b_np.shape))
+    if not np.allclose(a_np, b_np, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        diff = np.abs(a_np - b_np)
+        denom = np.abs(b_np) + atol
+        rel = diff / np.where(denom == 0, 1, denom)
+        idx = np.unravel_index(np.argmax(rel), rel.shape) if rel.size else ()
+        raise AssertionError(
+            "%s and %s differ: max abs %.3e max rel %.3e at %s "
+            "(%r vs %r), rtol=%g atol=%g" %
+            (names[0], names[1], float(diff.max()), float(rel.max()), idx,
+             a_np[idx] if idx != () else a_np,
+             b_np[idx] if idx != () else b_np, rtol, atol))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 ctx=None, distribution="uniform"):
+    """Random NDArray, optionally sparse (reference rand_ndarray incl.
+    sparse, `python/mxnet/test_utils.py`)."""
+    dtype = dtype or default_dtype()
+    ctx = ctx or default_context()
+    if stype == "default":
+        if distribution == "uniform":
+            arr = np.random.uniform(-1.0, 1.0, size=shape)
+        else:
+            arr = np.random.normal(size=shape)
+        return nd_array(arr.astype(dtype), ctx=ctx)
+    from .ndarray import sparse as _sp
+    density = 0.1 if density is None else density
+    arr = np.random.uniform(-1.0, 1.0, size=shape).astype(dtype)
+    mask = np.random.uniform(size=shape) < density
+    arr = arr * mask
+    dense = nd_array(arr, ctx=ctx)
+    return dense.tostype(stype)
+
+
+def random_arrays(*shapes):
+    arrays = [np.random.randn(*s).astype(default_dtype()) if s else
+              np.asarray(np.random.randn()).astype(default_dtype())
+              for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Bind a symbol with the given numpy inputs and run forward once."""
+    ctx = ctx or default_context()
+    shapes = {k: v.shape for k, v in inputs.items()}
+    exe = sym.simple_bind(ctx=ctx, grad_req="null", **shapes)
+    for k, v in inputs.items():
+        exe.arg_dict[k][:] = v
+    outputs = [o.asnumpy() for o in exe.forward(is_train=is_train)]
+    if len(outputs) == 1:
+        return outputs[0]
+    return outputs
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Central finite differences d(sum(outputs))/d(arg) per argument
+    (reference numeric_grad used by check_numeric_gradient)."""
+    grads = {}
+    for name, arr in location.items():
+        base = arr.copy()
+        g = np.zeros_like(base, dtype=np.float64)
+        flat = base.reshape(-1)
+        gflat = g.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            executor.arg_dict[name][:] = base
+            fp = sum(float(o.asnumpy().astype(np.float64).sum())
+                     for o in executor.forward(is_train=use_forward_train))
+            flat[i] = orig - eps
+            executor.arg_dict[name][:] = base
+            fm = sum(float(o.asnumpy().astype(np.float64).sum())
+                     for o in executor.forward(is_train=use_forward_train))
+            flat[i] = orig
+            gflat[i] = (fp - fm) / (2.0 * eps)
+        executor.arg_dict[name][:] = base
+        grads[name] = g
+    return grads
+
+
+def _location_dict(sym, location):
+    if isinstance(location, dict):
+        return {k: _asnumpy(v).astype(np.float64) for k, v in
+                location.items()}
+    args = sym.list_arguments()
+    return {k: _asnumpy(v).astype(np.float64)
+            for k, v in zip(args, location)}
+
+
+def check_numeric_gradient(sym, location, aux_states=None,
+                           numeric_eps=1e-3, rtol=1e-2, atol=None,
+                           grad_nodes=None, ctx=None, dtype=np.float64):
+    """Finite-difference check of autograd gradients (reference
+    check_numeric_gradient, `python/mxnet/test_utils.py`)."""
+    ctx = ctx or default_context()
+    loc = _location_dict(sym, location)
+    loc32 = {k: v.astype(np.float32) for k, v in loc.items()}
+    grad_nodes = grad_nodes or list(loc.keys())
+    grad_req = {k: ("write" if k in grad_nodes else "null") for k in loc}
+
+    shapes = {k: v.shape for k, v in loc.items()}
+    exe = sym.simple_bind(ctx=ctx, grad_req=grad_req, **shapes)
+    for k, v in loc32.items():
+        exe.arg_dict[k][:] = v
+    if aux_states:
+        for k, v in aux_states.items():
+            exe.aux_dict[k][:] = _asnumpy(v)
+    outputs = exe.forward(is_train=True)
+    ograds = [nd_array(np.ones(o.shape, dtype=np.float32), ctx=ctx)
+              for o in outputs]
+    exe.backward(ograds)
+    sym_grads = {k: exe.grad_dict[k].asnumpy() for k in grad_nodes}
+
+    num_grads = numeric_grad(exe, {k: loc32[k].copy() for k in grad_nodes},
+                             eps=numeric_eps)
+    atol = atol if atol is not None else max(numeric_eps * 10, 1e-4)
+    for k in grad_nodes:
+        assert_almost_equal(sym_grads[k], num_grads[k].astype(np.float32),
+                            rtol=rtol, atol=atol,
+                            names=("autograd[%s]" % k, "numeric[%s]" % k))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-5,
+                           aux_states=None, ctx=None, equal_nan=False):
+    """Forward the bound symbol and compare against expected numpy outputs
+    (reference check_symbolic_forward)."""
+    ctx = ctx or default_context()
+    loc = _location_dict(sym, location)
+    shapes = {k: v.shape for k, v in loc.items()}
+    exe = sym.simple_bind(ctx=ctx, grad_req="null", **shapes)
+    for k, v in loc.items():
+        exe.arg_dict[k][:] = v.astype(np.float32)
+    if aux_states:
+        for k, v in aux_states.items():
+            exe.aux_dict[k][:] = _asnumpy(v)
+    outputs = exe.forward(is_train=False)
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    for i, (out, exp) in enumerate(zip(outputs, expected)):
+        assert_almost_equal(out, exp, rtol=rtol, atol=atol,
+                            names=("output[%d]" % i, "expected[%d]" % i),
+                            equal_nan=equal_nan)
+    return [o.asnumpy() for o in outputs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected,
+                            rtol=1e-4, atol=1e-5, aux_states=None,
+                            grad_req="write", ctx=None):
+    """Backward the bound symbol with the given head gradients and compare
+    input gradients against expected (reference check_symbolic_backward)."""
+    ctx = ctx or default_context()
+    loc = _location_dict(sym, location)
+    shapes = {k: v.shape for k, v in loc.items()}
+    if isinstance(grad_req, str):  # explicit dict: inputs DO get grads here
+        grad_req = {k: grad_req for k in loc}
+    exe = sym.simple_bind(ctx=ctx, grad_req=grad_req, **shapes)
+    for k, v in loc.items():
+        exe.arg_dict[k][:] = v.astype(np.float32)
+    if aux_states:
+        for k, v in aux_states.items():
+            exe.aux_dict[k][:] = _asnumpy(v)
+    exe.forward(is_train=True)
+    ograds = [nd_array(_asnumpy(g).astype(np.float32), ctx=ctx)
+              for g in (out_grads if isinstance(out_grads, (list, tuple))
+                        else [out_grads])]
+    exe.backward(ograds)
+    if isinstance(expected, dict):
+        exp_items = expected.items()
+    else:
+        exp_items = zip(sym.list_arguments(), expected)
+    grads = {}
+    for k, exp in exp_items:
+        grads[k] = exe.grad_dict[k].asnumpy()
+        assert_almost_equal(grads[k], exp, rtol=rtol, atol=atol,
+                            names=("grad[%s]" % k, "expected[%s]" % k))
+    return grads
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      rtol=1e-4, atol=1e-4):
+    """Cross-device consistency: run the same symbol on every context and
+    compare all outputs/gradients against the first (the reference's
+    CPU-vs-GPU ground truth, `tests/python/gpu/test_operator_gpu.py`;
+    here CPU-vs-TPU)."""
+    results = []
+    for spec in ctx_list:
+        spec = dict(spec)
+        ctx = spec.pop("ctx")
+        shapes = spec
+        req = ({k: grad_req for k in shapes}
+               if isinstance(grad_req, str) and grad_req != "null"
+               else grad_req)
+        exe = sym.simple_bind(ctx=ctx, grad_req=req, **shapes)
+        if not results:
+            np.random.seed(0)
+            init = {k: np.random.normal(size=v.shape, scale=scale)
+                    .astype(np.float32) for k, v in exe.arg_dict.items()}
+        for k, v in exe.arg_dict.items():
+            v[:] = init[k]
+        outputs = exe.forward(is_train=grad_req != "null")
+        if grad_req != "null":
+            exe.backward([nd_array(np.ones(o.shape, dtype=np.float32),
+                                   ctx=ctx) for o in outputs])
+        results.append(exe)
+    ref = results[0]
+    for other in results[1:]:
+        for i, (a, b) in enumerate(zip(ref.outputs, other.outputs)):
+            assert_almost_equal(a, b, rtol=rtol, atol=atol,
+                                names=("ctx0.out%d" % i, "ctxN.out%d" % i))
+        if grad_req != "null":
+            for k in ref.grad_dict:
+                if ref.grad_dict[k] is None:
+                    continue
+                assert_almost_equal(ref.grad_dict[k], other.grad_dict[k],
+                                    rtol=rtol, atol=atol,
+                                    names=("ctx0.grad[%s]" % k,
+                                           "ctxN.grad[%s]" % k))
+    return results
+
+
+def create_2d_tensor(rows, columns, dtype=np.int64):
+    data = np.arange(0, rows, dtype=dtype).reshape(rows, 1)
+    return nd_array(np.broadcast_to(data, (rows, columns)).copy())

@@ -1,0 +1,171 @@
+"""Data iterator tests (model: reference `tests/python/unittest/test_io.py`)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.io import (CSVIter, DataBatch, DataDesc, ImageRecordIter,
+                      MNISTIter, NDArrayIter, PrefetchingIter, ResizeIter)
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(100, dtype=np.float32).reshape(25, 4)
+    label = np.arange(25, dtype=np.float32)
+    it = NDArrayIter(data, label, batch_size=10, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (10, 4)
+    assert batches[2].pad == 5
+    # pad batch wraps around to the beginning
+    got = batches[2].data[0].asnumpy()
+    np.testing.assert_array_equal(got[:5], data[20:25])
+    np.testing.assert_array_equal(got[5:], data[:5])
+    # reset and iterate again
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_discard_and_shuffle():
+    data = np.arange(25, dtype=np.float32).reshape(25, 1)
+    it = NDArrayIter(data, None, batch_size=10, shuffle=True,
+                     last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 2
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in batches])
+    assert len(np.unique(seen)) == 20
+
+
+def test_ndarray_iter_provide():
+    it = NDArrayIter({"data": np.zeros((8, 3))},
+                     {"softmax_label": np.zeros((8,))}, batch_size=4)
+    assert it.provide_data[0].name == "data"
+    assert it.provide_data[0].shape == (4, 3)
+    assert it.provide_label[0].shape == (4,)
+
+
+def test_resize_iter():
+    data = np.zeros((10, 2), dtype=np.float32)
+    inner = NDArrayIter(data, None, batch_size=5)
+    it = ResizeIter(inner, size=7)
+    assert len(list(it)) == 7
+    it.reset()
+    assert len(list(it)) == 7
+
+
+def test_prefetching_iter():
+    data = np.arange(40, dtype=np.float32).reshape(20, 2)
+    inner = NDArrayIter(data, np.zeros(20), batch_size=5)
+    it = PrefetchingIter(inner)
+    batches = list(it)
+    assert len(batches) == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_csv_iter(tmp_path):
+    path = str(tmp_path / "data.csv")
+    arr = np.random.rand(12, 3).astype(np.float32)
+    np.savetxt(path, arr, delimiter=",")
+    it = CSVIter(data_csv=path, data_shape=(3,), batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), arr[:4],
+                               rtol=1e-5)
+
+
+def test_mnist_iter(tmp_path):
+    # write tiny idx files (10 samples of 8x8)
+    img_path = str(tmp_path / "images-idx3-ubyte")
+    lab_path = str(tmp_path / "labels-idx1-ubyte")
+    imgs = (np.random.rand(10, 8, 8) * 255).astype(np.uint8)
+    labs = np.arange(10, dtype=np.uint8)
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        f.write(struct.pack(">III", 10, 8, 8))
+        f.write(imgs.tobytes())
+    with open(lab_path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000801))
+        f.write(struct.pack(">I", 10))
+        f.write(labs.tobytes())
+    it = MNISTIter(image=img_path, label=lab_path, batch_size=5,
+                   shuffle=False)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 1, 8, 8)
+    np.testing.assert_array_equal(batches[0].label[0].asnumpy(),
+                                  labs[:5].astype(np.float32))
+    flat = MNISTIter(image=img_path, label=lab_path, batch_size=5,
+                     shuffle=False, flat=True)
+    assert next(iter(flat)).data[0].shape == (5, 64)
+
+
+def test_image_record_iter(tmp_path):
+    # pack raw HWC uint8 payloads into a recordio file
+    from mxtpu.recordio import IRHeader, MXRecordIO, pack
+    path = str(tmp_path / "data.rec")
+    rec = MXRecordIO(path, "w")
+    n, h, w, c = 12, 8, 8, 3
+    raw = (np.random.rand(n, h, w, c) * 255).astype(np.uint8)
+    for i in range(n):
+        header = IRHeader(0, float(i % 3), i, 0)
+        rec.write(pack(header, raw[i].tobytes()))
+    rec.close()
+
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                         batch_size=4, preprocess_threads=2)
+    batches = list(it)
+    assert len(batches) == 3
+    b0 = batches[0]
+    assert b0.data[0].shape == (4, 3, 8, 8)
+    assert b0.label[0].shape == (4,)
+    np.testing.assert_allclose(
+        b0.data[0].asnumpy()[0],
+        raw[0].astype(np.float32).transpose(2, 0, 1), rtol=1e-5)
+    np.testing.assert_array_equal(b0.label[0].asnumpy(),
+                                  np.array([0., 1., 2., 0.], np.float32))
+    # shuffle + crop epoch still covers the data
+    it2 = ImageRecordIter(path_imgrec=path, data_shape=(3, 6, 6),
+                          batch_size=4, shuffle=True, rand_crop=True,
+                          rand_mirror=True, preprocess_threads=1)
+    assert len(list(it2)) == 3
+
+
+def test_image_record_iter_sharded(tmp_path):
+    from mxtpu.recordio import IRHeader, MXRecordIO, pack
+    path = str(tmp_path / "data.rec")
+    rec = MXRecordIO(path, "w")
+    for i in range(10):
+        header = IRHeader(0, float(i), i, 0)
+        payload = np.full((4, 4, 3), i, dtype=np.uint8)
+        rec.write(pack(header, payload.tobytes()))
+    rec.close()
+    labels = []
+    for part in range(2):
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 4, 4),
+                             batch_size=5, num_parts=2, part_index=part,
+                             preprocess_threads=1)
+        for b in it:
+            labels.extend(b.label[0].asnumpy().tolist())
+    assert sorted(labels) == [float(i) for i in range(10)]
+
+
+def test_io_create_registry():
+    from mxtpu import io as mio
+    with pytest.raises(mx.MXNetError):
+        mio.create("NopeIter")
+
+
+def test_test_utils_numeric_gradient():
+    from mxtpu import test_utils as tu
+    import mxtpu.symbol as sym
+    x = sym.Symbol.var("x") if hasattr(sym.Symbol, "var") else sym.var("x")
+    y = sym.var("y")
+    z = (x * y) + x
+    loc = {"x": np.random.rand(3, 2), "y": np.random.rand(3, 2)}
+    tu.check_numeric_gradient(z, loc)
+    tu.check_symbolic_forward(z, loc, [loc["x"] * loc["y"] + loc["x"]])
+    og = np.ones((3, 2), np.float32)
+    tu.check_symbolic_backward(z, loc, [og],
+                               {"x": loc["y"] + 1.0, "y": loc["x"]})
