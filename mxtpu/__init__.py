@@ -48,6 +48,9 @@ from . import metric
 from . import recordio
 from . import io
 from . import test_utils
+from . import kvstore
+from . import kvstore as kv
+from . import kvstore_server
 from . import gluon
 
 

@@ -1,0 +1,515 @@
+"""Parameter-server transport for the ``dist_*`` KVStore backends.
+
+This replaces the reference's vendored ps-lite (ZMQ TCP; consumed in
+`src/kvstore/kvstore_dist.h:50,738` via `ps::KVWorker<char>::ZPush/ZPull`
+and `src/kvstore/kvstore_dist_server.h:155`) with a small native TCP
+protocol: length-prefixed pickled messages over persistent sockets.
+
+Roles mirror the reference (`include/mxnet/kvstore.h:282-326`):
+  * scheduler — rendezvous + rank assignment + barrier service
+  * server    — holds weights; sync mode accumulates pushes from all
+                workers then applies the updater once
+                (`kvstore_dist_server.h:346-358`); async applies per push
+  * worker    — pushes merged gradients, pulls weights
+
+Environment (MXTPU_* preferred, DMLC_* accepted for parity):
+  MXTPU_ROLE, MXTPU_PS_ROOT_URI, MXTPU_PS_ROOT_PORT,
+  MXTPU_NUM_WORKER, MXTPU_NUM_SERVER, MXTPU_KVSTORE_BIGARRAY_BOUND.
+
+Big arrays (>= bigarray bound) are sharded across the server group as
+contiguous flat chunks, the analog of the PSKV slicing at
+`kvstore_dist.h` (`MXNET_KVSTORE_BIGARRAY_BOUND`).
+
+On real TPU pods the sync path should use the ``tpu`` kvstore (XLA
+collectives over ICI) instead; this PS exists for exact `dist_sync` /
+`dist_async` (updater-on-server) semantics over DCN and for the
+multi-process local tests (`tools/launch.py`).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["Scheduler", "Server", "Worker", "role_from_env",
+           "run_scheduler", "run_server"]
+
+_LEN = struct.Struct("!Q")
+
+
+def _env(*names, default=None):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            return v
+    return default
+
+
+def role_from_env() -> Optional[str]:
+    return _env("MXTPU_ROLE", "DMLC_ROLE")
+
+
+def _root_addr() -> Tuple[str, int]:
+    host = _env("MXTPU_PS_ROOT_URI", "DMLC_PS_ROOT_URI", default="127.0.0.1")
+    port = int(_env("MXTPU_PS_ROOT_PORT", "DMLC_PS_ROOT_PORT",
+                    default="9091"))
+    return host, port
+
+
+def _num_workers() -> int:
+    return int(_env("MXTPU_NUM_WORKER", "DMLC_NUM_WORKER", default="1"))
+
+
+def _num_servers() -> int:
+    return int(_env("MXTPU_NUM_SERVER", "DMLC_NUM_SERVER", default="1"))
+
+
+def _bigarray_bound() -> int:
+    return int(_env("MXTPU_KVSTORE_BIGARRAY_BOUND",
+                    "MXNET_KVSTORE_BIGARRAY_BOUND", default="1000000"))
+
+
+# ---------------------------------------------------------------------------
+# Framed pickled messages over a socket
+# ---------------------------------------------------------------------------
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class _Client(object):
+    """Persistent request/response connection (thread-safe)."""
+
+    def __init__(self, addr: Tuple[str, int], retries: int = 100):
+        last = None
+        for _ in range(retries):
+            try:
+                self._sock = socket.create_connection(addr, timeout=None)
+                self._sock.setsockopt(socket.IPPROTO_TCP,
+                                      socket.TCP_NODELAY, 1)
+                break
+            except OSError as e:
+                last = e
+                time.sleep(0.1)
+        else:
+            raise ConnectionError("cannot reach %s: %s" % (addr, last))
+        self._lock = threading.Lock()
+
+    def request(self, obj):
+        with self._lock:
+            _send_msg(self._sock, obj)
+            return _recv_msg(self._sock)
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Scheduler
+# ---------------------------------------------------------------------------
+
+class Scheduler(object):
+    """Rendezvous: assigns ranks, distributes the server list, services
+    barriers, coordinates shutdown (the dmlc-tracker role)."""
+
+    def __init__(self, port: Optional[int] = None):
+        host, root_port = _root_addr()
+        self._nw = _num_workers()
+        self._ns = _num_servers()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port if port is not None else root_port))
+        self._sock.listen(128)
+        self._port = self._sock.getsockname()[1]
+        self._stop = False
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._servers: List[Tuple[str, int]] = []
+        self._worker_ranks = 0
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._done = 0
+        self._threads: List[threading.Thread] = []
+
+    def run(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            if self._stop:
+                conn.close()
+                break
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        # wait for in-flight handlers, then close
+        for t in self._threads:
+            t.join(timeout=5)
+        self._sock.close()
+
+    def _handle(self, conn: socket.socket):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                op = msg["op"]
+                if op == "register":
+                    _send_msg(conn, self._register(msg))
+                elif op == "barrier":
+                    self._barrier()
+                    _send_msg(conn, {"ok": True})
+                elif op == "done":
+                    with self._cv:
+                        self._done += 1
+                        self._cv.notify_all()
+                    _send_msg(conn, {"ok": True})
+                    if self._maybe_shutdown():
+                        break
+                else:
+                    _send_msg(conn, {"error": "bad op %r" % op})
+        except (ConnectionError, EOFError):
+            pass
+        finally:
+            conn.close()
+
+    def _register(self, msg):
+        with self._cv:
+            if msg["role"] == "server":
+                self._servers.append(tuple(msg["addr"]))
+                rank = len(self._servers) - 1
+                self._cv.notify_all()
+            else:
+                rank = self._worker_ranks
+                self._worker_ranks += 1
+            while len(self._servers) < self._ns:
+                self._cv.wait()
+            return {"rank": rank, "servers": list(self._servers),
+                    "num_workers": self._nw, "num_servers": self._ns}
+
+    def _barrier(self):
+        with self._cv:
+            gen = self._barrier_gen
+            self._barrier_count += 1
+            if self._barrier_count == self._nw:
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                self._cv.notify_all()
+            else:
+                while gen == self._barrier_gen:
+                    self._cv.wait()
+
+    def _maybe_shutdown(self) -> bool:
+        with self._cv:
+            if self._done < self._nw:
+                return False
+            servers = list(self._servers)
+        for addr in servers:
+            try:
+                c = _Client(addr, retries=3)
+                c.request({"op": "shutdown"})
+                c.close()
+            except ConnectionError:
+                pass
+        self._stop = True
+        # unblock our own accept() so run() can return
+        try:
+            socket.create_connection(("127.0.0.1", self._port),
+                                     timeout=1).close()
+        except OSError:
+            pass
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+class Server(object):
+    """Holds weights; reference `KVStoreDistServer`
+    (`kvstore_dist_server.h:155`): sync pushes accumulate until all
+    workers reported, then `ApplyUpdates` runs the updater once."""
+
+    def __init__(self):
+        self._nw = _num_workers()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(128)
+        self._addr = (socket.gethostbyname(socket.gethostname())
+                      if _root_addr()[0] not in ("127.0.0.1", "localhost")
+                      else "127.0.0.1", self._sock.getsockname()[1])
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._store: Dict[Any, np.ndarray] = {}
+        self._versions: Dict[Any, int] = {}
+        self._pending: Dict[Any, Tuple[np.ndarray, int]] = {}
+        self._errors: Dict[Any, str] = {}
+        self._updater = None
+        self._shutdown = False
+        # register with scheduler
+        self._sched = _Client(_root_addr())
+        info = self._sched.request({"op": "register", "role": "server",
+                                    "addr": self._addr})
+        self.rank = info["rank"]
+
+    def run(self):
+        threads = []
+        while not self._shutdown:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        self._sock.close()
+        self._sched.close()
+
+    def _handle(self, conn: socket.socket):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                op = msg["op"]
+                if op == "init":
+                    with self._lock:
+                        self._store[msg["key"]] = np.array(msg["value"])
+                        self._versions[msg["key"]] = 0
+                    _send_msg(conn, {"ok": True})
+                elif op == "push":
+                    _send_msg(conn, self._push(msg))
+                elif op == "pull":
+                    _send_msg(conn, self._pull(msg))
+                elif op == "command":
+                    self._command(msg)
+                    _send_msg(conn, {"ok": True})
+                elif op == "shutdown":
+                    with self._cv:
+                        self._shutdown = True
+                        self._cv.notify_all()
+                    _send_msg(conn, {"ok": True})
+                    # unblock accept()
+                    try:
+                        socket.create_connection(
+                            ("127.0.0.1", self._addr[1]), timeout=1).close()
+                    except OSError:
+                        pass
+                    break
+                else:
+                    _send_msg(conn, {"error": "bad op %r" % op})
+        except (ConnectionError, EOFError):
+            pass
+        finally:
+            conn.close()
+
+    def _apply(self, key, merged: np.ndarray):
+        """ApplyUpdates (`kvstore_dist_server.h:346-358`): updater if
+        set, else the merged value replaces the store."""
+        if self._updater is not None:
+            from .context import cpu
+            from .ndarray.ndarray import NDArray
+
+            recv = NDArray(merged, ctx=cpu())
+            stored = NDArray(self._store[key], ctx=cpu())
+            self._updater(key, recv, stored)
+            self._store[key] = stored.asnumpy()
+        else:
+            self._store[key] = merged
+        self._versions[key] = self._versions.get(key, 0) + 1
+
+    def _apply_safe(self, key, merged: np.ndarray):
+        """Apply, but never leave waiters hung: on updater failure the
+        version still advances and the error is recorded so every worker
+        sees it instead of deadlocking the round."""
+        try:
+            self._apply(key, merged)
+        except Exception as e:
+            self._errors[key] = "server updater failed for %r: %r" % (key, e)
+            self._versions[key] = self._versions.get(key, 0) + 1
+
+    def _push(self, msg):
+        key, value, sync = msg["key"], np.array(msg["value"]), msg["sync"]
+        with self._cv:
+            if key not in self._store:
+                return {"error": "key %r not initialized on server" % (key,)}
+            if not sync:
+                self._apply_safe(key, value)
+                self._cv.notify_all()
+                return {"version": self._versions[key],
+                        "error": self._errors.get(key)}
+            acc, count = self._pending.get(key, (None, 0))
+            acc = value if acc is None else acc + value
+            count += 1
+            target = self._versions.get(key, 0) + 1
+            if count == self._nw:
+                self._pending.pop(key, None)
+                self._apply_safe(key, acc)
+                self._cv.notify_all()
+            else:
+                self._pending[key] = (acc, count)
+            return {"version": target, "error": self._errors.get(key)}
+
+    def _pull(self, msg):
+        key, min_version = msg["key"], msg.get("min_version", 0)
+        with self._cv:
+            while (key not in self._store
+                   or self._versions.get(key, 0) < min_version) \
+                    and not self._shutdown and key not in self._errors:
+                self._cv.wait()
+            if key in self._errors:
+                return {"value": None, "error": self._errors[key]}
+            return {"value": self._store.get(key),
+                    "version": self._versions.get(key, 0)}
+
+    def _command(self, msg):
+        head, body = msg["head"], msg["body"]
+        if head == "set_optimizer":
+            from . import optimizer as opt_mod
+
+            optimizer = pickle.loads(body)
+            with self._lock:
+                self._updater = opt_mod.get_updater(optimizer)
+
+
+# ---------------------------------------------------------------------------
+# Worker
+# ---------------------------------------------------------------------------
+
+class Worker(object):
+    """Client side: shards keys over the server group and tracks the
+    push-round version per key so sync pulls see the full round
+    (reference `ps::KVWorker` usage at `kvstore_dist.h:350-412`)."""
+
+    _singleton = None
+
+    @classmethod
+    def from_env(cls) -> "Worker":
+        if cls._singleton is None:
+            cls._singleton = cls()
+        return cls._singleton
+
+    def __init__(self):
+        self._sched = _Client(_root_addr())
+        info = self._sched.request({"op": "register", "role": "worker"})
+        self.rank = info["rank"]
+        self.num_workers = info["num_workers"]
+        self._server_addrs = info["servers"]
+        self._servers = [_Client(tuple(a)) for a in self._server_addrs]
+        self._last_version: Dict[Any, int] = {}
+        self._meta_shape: Dict[Any, Tuple] = {}
+        self._bigarray = _bigarray_bound()
+
+    def register_meta(self, key, shape, dtype):
+        """Record a key's shape/dtype without initializing it on the
+        servers (non-root ranks: rank 0 does the server-side init)."""
+        self._meta_shape[key] = (tuple(shape), np.dtype(dtype))
+
+    # -- key placement ------------------------------------------------------
+    def _chunks(self, key, size: int):
+        """Map a flat array to [(server_idx, subkey, lo, hi)] — whole-array
+        on one server unless >= bigarray bound, then striped over all."""
+        ns = len(self._servers)
+        home = zlib.crc32(str(key).encode()) % ns
+        if size < self._bigarray or ns == 1:
+            return [(home, (key, 0), 0, size)]
+        out = []
+        step = (size + ns - 1) // ns
+        for i in range(ns):
+            lo, hi = i * step, min((i + 1) * step, size)
+            if lo < hi:
+                out.append(((home + i) % ns, (key, i), lo, hi))
+        return out
+
+    # -- API ----------------------------------------------------------------
+    def init(self, key, value: np.ndarray):
+        flat = np.ascontiguousarray(value).reshape(-1)
+        self._meta_shape[key] = (value.shape, value.dtype)
+        for sidx, subkey, lo, hi in self._chunks(key, flat.size):
+            self._servers[sidx].request({"op": "init", "key": subkey,
+                                         "value": flat[lo:hi]})
+
+    def push(self, key, value: np.ndarray, sync: bool = True):
+        flat = np.ascontiguousarray(value).reshape(-1)
+        self._meta_shape.setdefault(key, (value.shape, value.dtype))
+        for sidx, subkey, lo, hi in self._chunks(key, flat.size):
+            rep = self._servers[sidx].request(
+                {"op": "push", "key": subkey, "value": flat[lo:hi],
+                 "sync": sync})
+            if rep.get("error"):
+                raise ConnectionError("push of %r failed: %s"
+                                      % (key, rep["error"]))
+            self._last_version[subkey] = rep["version"]
+
+    def pull(self, key, sync: bool = True) -> np.ndarray:
+        shape, dtype = self._meta_shape[key]
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        flat = np.empty(size, dtype=dtype)
+        for sidx, subkey, lo, hi in self._chunks(key, size):
+            rep = self._servers[sidx].request(
+                {"op": "pull", "key": subkey,
+                 "min_version": self._last_version.get(subkey, 0)
+                 if sync else 0})
+            if rep.get("value") is None:
+                raise ConnectionError(
+                    "pull of %r failed: %s" % (key, rep.get(
+                        "error", "server shut down while waiting")))
+            flat[lo:hi] = rep["value"]
+        return flat.reshape(shape)
+
+    def barrier(self):
+        self._sched.request({"op": "barrier"})
+
+    def send_command(self, head: str, body):
+        for s in self._servers:
+            s.request({"op": "command", "head": head, "body": body})
+
+    def close(self):
+        try:
+            self._sched.request({"op": "done"})
+        except ConnectionError:
+            pass
+        for s in self._servers:
+            s.close()
+        self._sched.close()
+        Worker._singleton = None
+
+
+# ---------------------------------------------------------------------------
+# Role entry points (reference `python/mxnet/kvstore_server.py`)
+# ---------------------------------------------------------------------------
+
+def run_scheduler():
+    Scheduler().run()
+
+
+def run_server():
+    Server().run()

@@ -391,14 +391,22 @@ class HybridBlock(Block):
         if F is sym_mod:
             kwargs = {name: p.var() for name, p in self._reg_params.items()}
         else:
+            # pick the parameter copy on the input's device (reference
+            # HybridBlock.forward: `i.data(ctx)` per replica)
+            ctx = None
+            flat_in, _ = _flatten(list(inputs), "input")
+            for a in flat_in:
+                if isinstance(a, NDArray):
+                    ctx = a.ctx
+                    break
             try:
-                kwargs = {name: p.data() for name, p in
+                kwargs = {name: p.data(ctx) for name, p in
                           self._reg_params.items()}
             except DeferredInitializationError:
                 self._deferred_infer_shape(*inputs)
                 for p in self._collect_all_reg_params().values():
                     p._finish_deferred_init()
-                kwargs = {name: p.data() for name, p in
+                kwargs = {name: p.data(ctx) for name, p in
                           self._reg_params.items()}
         return self.hybrid_forward(F, *inputs, **kwargs)
 

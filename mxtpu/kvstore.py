@@ -1,0 +1,446 @@
+"""KVStore — key-value store for gradient aggregation / parameter sync.
+
+TPU-native re-design of the reference KVStore stack
+(`include/mxnet/kvstore.h:59-439`, `src/kvstore/kvstore.cc:40-72`,
+`src/kvstore/kvstore_local.h:173-275`, `src/kvstore/comm.h`,
+`src/kvstore/kvstore_nccl.h`, `src/kvstore/kvstore_dist.h`).
+
+Backends:
+  * ``local`` — reduce on host-ordered device merge (the analog of
+    CommCPU, `comm.h:103`): values are summed into a merge buffer via one
+    fused XLA executable.
+  * ``device`` / ``nccl`` — on-device merge + broadcast (the analog of
+    CommDevice GPU P2P merge `comm.h:451` and the NCCL ring
+    `kvstore_nccl.h:62`): device-to-device transfers ride ICI, the sum is
+    one jitted executable on the merge device.
+  * ``tpu`` — the north-star backend (SURVEY.md): when a
+    `mxtpu.parallel` mesh is active, push/pull is an XLA all-reduce over
+    the mesh's data axis (`jax.lax.psum` under shard_map); otherwise it
+    degrades to the on-device merge.
+  * ``dist_sync`` / ``dist_device_sync`` / ``dist_async`` — multi-process
+    parameter server over TCP (`mxtpu/_ps.py`), the analog of the ps-lite
+    path (`kvstore_dist.h:44`, `kvstore_dist_server.h:155`).  Roles are
+    read from MXTPU_ROLE / DMLC_ROLE env (bootstrapped by
+    `tools/launch.py` like the reference's dmlc-tracker).
+
+Semantics follow the reference exactly: ``push`` reduces a list of
+per-device values into a merge buffer; with an updater set the updater
+mutates the stored weight, otherwise the merged value replaces the
+store; ``pull`` broadcasts the stored value into the outputs
+(`kvstore_local.h:173-275`).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, zeros
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(key):
+    return key if isinstance(key, (list, tuple)) else [key]
+
+
+def _val_list(val):
+    if isinstance(val, NDArray):
+        return [val]
+    if isinstance(val, (list, tuple)) and val and isinstance(val[0], NDArray):
+        return list(val)
+    raise MXNetError("invalid value type %r" % type(val))
+
+
+def _group_kv(key, vals):
+    """Group (possibly list-of-list) values by key, reference
+    `KVStoreLocal::GroupKVPairs` (`kvstore_local.h`)."""
+    keys = _key_list(key)
+    if len(keys) == 1:
+        return keys, [_val_list(vals)]
+    if not isinstance(vals, (list, tuple)) or len(vals) != len(keys):
+        raise MXNetError("one value (or list) per key required")
+    return keys, [_val_list(v) for v in vals]
+
+
+# ---------------------------------------------------------------------------
+# Fused reduce / broadcast executables (the Comm layer).
+# One jitted executable per (n, shape, dtype) signature — the analog of
+# CommDevice's merge-buffer kernel (`comm.h:503-598`).
+# ---------------------------------------------------------------------------
+
+_REDUCE_CACHE: Dict[Any, Any] = {}
+
+
+def _fused_sum(jax_arrays):
+    import jax
+
+    if len(jax_arrays) == 1:
+        return jax_arrays[0]
+    key = (len(jax_arrays), tuple(jax_arrays[0].shape),
+           str(jax_arrays[0].dtype))
+    fn = _REDUCE_CACHE.get(key)
+    if fn is None:
+        def _sum(*xs):
+            acc = xs[0]
+            for x in xs[1:]:
+                acc = acc + x
+            return acc
+        fn = jax.jit(_sum)
+        _REDUCE_CACHE[key] = fn
+    dev = jax_arrays[0].devices() if hasattr(jax_arrays[0], "devices") else None
+    target = next(iter(dev)) if dev else None
+    moved = [x if target is None or
+             (hasattr(x, "devices") and target in x.devices())
+             else jax.device_put(x, target) for x in jax_arrays]
+    return fn(*moved)
+
+
+# ---------------------------------------------------------------------------
+# Gradient compression — 2-bit stochastic-threshold quantization with
+# error-feedback residual (reference `src/kvstore/gradient_compression.h:
+# 38-134`).  quantize(g + r): +threshold where > threshold, -threshold
+# where < -threshold, else 0; the residual keeps what was dropped.
+# ---------------------------------------------------------------------------
+
+class GradientCompression(object):
+    def __init__(self, type="2bit", threshold=0.5):
+        if type != "2bit":
+            raise MXNetError("unsupported compression type %r" % type)
+        if float(threshold) <= 0:
+            raise MXNetError("threshold must be positive")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residuals: Dict[Any, Any] = {}
+        self._fn = None
+
+    def _compiled(self):
+        import jax
+        import jax.numpy as jnp
+
+        if self._fn is None:
+            t = self.threshold
+
+            def quant(g, r):
+                x = g + r
+                q = jnp.where(x > t, t, jnp.where(x < -t, -t, 0.0)
+                              ).astype(g.dtype)
+                return q, x - q
+            self._fn = jax.jit(quant)
+        return self._fn
+
+    def compress(self, key, grad_jax):
+        r = self._residuals.get(key)
+        if r is None:
+            import jax.numpy as jnp
+
+            r = jnp.zeros(grad_jax.shape, grad_jax.dtype)
+        q, r_new = self._compiled()(grad_jax, r)
+        self._residuals[key] = r_new
+        return q
+
+
+# ---------------------------------------------------------------------------
+# Base / local / device KVStore
+# ---------------------------------------------------------------------------
+
+class KVStore(object):
+    """In-process KVStore (`local`); see module docstring."""
+
+    def __init__(self):
+        self._store: Dict[Any, NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._optimizer = None
+        self._compression: Optional[GradientCompression] = None
+
+    @property
+    def type(self):
+        return "local"
+
+    # -- init ---------------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _group_kv(key, value)
+        for k, vals in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % (k,))
+            if len(vals) != 1:
+                raise MXNetError("init requires a single value per key")
+            self._store[k] = vals[0].copy()
+
+    # -- push/pull ----------------------------------------------------------
+    def _reduce(self, k, vals: List[NDArray]) -> NDArray:
+        raws = [v._data for v in vals]
+        merged = _fused_sum(raws)
+        if self._compression is not None:
+            merged = self._compression.compress(k, merged)
+        return NDArray(merged, ctx=vals[0].ctx, _committed=True)
+
+    def push(self, key, value, priority=0):
+        keys, values = _group_kv(key, value)
+        for k, vals in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            merged = self._reduce(k, vals)
+            stored = self._store[k]
+            if self._updater is not None:
+                self._updater(k, merged, stored)
+            else:
+                stored._set_jax(merged._data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if out is None:
+            raise MXNetError("pull requires out=")
+        keys, outs = _group_kv(key, out)
+        for k, dsts in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            src = self._store[k]
+            for d in dsts:
+                if d.stype != "default":
+                    raise MXNetError(
+                        "pull into %s output: use row_sparse_pull"
+                        % d.stype)
+                src.copyto(d)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority=priority)
+        self.pull(key, out=out if out is not None else value,
+                  priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in `row_ids` (reference
+        `KVStoreLocal::PullRowSparseImpl`).  Dense store: gathers rows."""
+        if out is None or row_ids is None:
+            raise MXNetError("row_sparse_pull requires out= and row_ids=")
+        keys, outs = _group_kv(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        if len(rids) == 1 and len(outs[0]) > 1:
+            rids = rids * len(outs[0])
+        from .ndarray import sparse as _sp
+
+        for k, dsts in zip(keys, outs):
+            src = self._store[k]
+            for d, rid in zip(dsts, rids):
+                _sp.retain_rows_into(src, rid, d)
+
+    # -- updater / optimizer ------------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def _set_updater(self, updater):
+        self.set_updater(updater)
+
+    def set_optimizer(self, optimizer):
+        from . import optimizer as opt_mod
+
+        self._optimizer = optimizer
+        self.set_updater(opt_mod.get_updater(optimizer))
+
+    def set_gradient_compression(self, compression_params):
+        params = dict(compression_params or {})
+        self._compression = GradientCompression(
+            type=params.get("type", "2bit"),
+            threshold=params.get("threshold", 0.5))
+
+    # -- distributed surface (degenerate single-process defaults) -----------
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def barrier(self):
+        pass
+
+    def send_command_to_servers(self, head, body):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._optimizer is None:
+            raise MXNetError("no optimizer set")
+        with open(fname, "wb") as f:
+            f.write(pickle.dumps(self._optimizer))
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            self._optimizer = pickle.loads(f.read())
+
+    def close(self):
+        pass
+
+
+class KVStoreDevice(KVStore):
+    """On-device merge + broadcast (CommDevice / NCCL analog): identical
+    host logic, but the merge is pinned to the first value's device so
+    transfers ride device interconnect, never the host."""
+
+    @property
+    def type(self):
+        return "device"
+
+
+class KVStoreTPU(KVStoreDevice):
+    """`tpu` backend: XLA all-reduce over the active mesh's data axis.
+
+    With a live mesh whose ``dp`` axis matches the number of pushed
+    per-device values, the merge is `jax.lax.psum` under shard_map (one
+    compiled collective over ICI); otherwise falls back to the on-device
+    fused merge.  This is the BASELINE.json ``kvstore=tpu`` north star.
+    """
+
+    def __init__(self, mesh=None, axis="dp"):
+        super().__init__()
+        self._mesh = mesh
+        self._axis = axis
+
+    @property
+    def type(self):
+        return "tpu"
+
+    def _reduce(self, k, vals: List[NDArray]) -> NDArray:
+        from .parallel.mesh import current_mesh
+
+        mesh = self._mesh or current_mesh()
+        n = len(vals)
+        if mesh is not None and n > 1 and self._axis in mesh.shape \
+                and mesh.shape[self._axis] == n:
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from .parallel import collectives
+
+            stacked = jax.device_put(
+                jnp.stack([v._data for v in vals], axis=0),
+                NamedSharding(mesh, PartitionSpec(self._axis)))
+            merged = collectives.all_reduce(stacked, axis=self._axis,
+                                            mesh=mesh)[0]
+            if self._compression is not None:
+                merged = self._compression.compress(k, merged)
+            return NDArray(merged, ctx=vals[0].ctx, _committed=True)
+        return super()._reduce(k, vals)
+
+
+# ---------------------------------------------------------------------------
+# Distributed KVStore (parameter server over TCP — `mxtpu/_ps.py`)
+# ---------------------------------------------------------------------------
+
+class KVStoreDist(KVStoreDevice):
+    """Multi-process KVStore: local device merge, then push/pull against
+    the server group (reference `KVStoreDist`, `kvstore_dist.h:44`).
+
+    sync mode: the server accumulates pushes from all workers, then
+    applies its updater once (`kvstore_dist_server.h:346-358`); async:
+    the server applies each push immediately.
+    """
+
+    def __init__(self, type_name="dist_sync"):
+        super().__init__()
+        self._type = type_name
+        from . import _ps
+
+        self._worker = _ps.Worker.from_env()
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return self._worker.rank
+
+    @property
+    def num_workers(self):
+        return self._worker.num_workers
+
+    def init(self, key, value):
+        keys, values = _group_kv(key, value)
+        for k, vals in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % (k,))
+            self._store[k] = vals[0].copy()
+            if self._worker.rank == 0:
+                self._worker.init(k, vals[0].asnumpy())
+            else:
+                self._worker.register_meta(k, vals[0].shape,
+                                           vals[0].dtype)
+        self._worker.barrier()
+
+    def push(self, key, value, priority=0):
+        keys, values = _group_kv(key, value)
+        for k, vals in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            merged = self._reduce(k, vals)
+            self._worker.push(k, merged.asnumpy(),
+                              sync=self._type != "dist_async")
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if out is None:
+            raise MXNetError("pull requires out=")
+        keys, outs = _group_kv(key, out)
+        for k, dsts in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            arr = self._worker.pull(k, sync=self._type != "dist_async")
+            src = NDArray(np.asarray(arr), ctx=dsts[0].ctx)
+            for d in dsts:
+                if d.stype != "default":
+                    raise MXNetError(
+                        "pull into %s output: use row_sparse_pull"
+                        % d.stype)
+                src.copyto(d)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        if out is None or row_ids is None:
+            raise MXNetError("row_sparse_pull requires out= and row_ids=")
+        keys, outs = _group_kv(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        if len(rids) == 1 and len(outs[0]) > 1:
+            rids = rids * len(outs[0])
+        from .ndarray import sparse as _sp
+
+        for k, dsts in zip(keys, outs):
+            arr = self._worker.pull(k, sync=self._type != "dist_async")
+            src = NDArray(np.asarray(arr), ctx=dsts[0].ctx)
+            for d, rid in zip(dsts, rids):
+                _sp.retain_rows_into(src, rid, d)
+
+    def set_optimizer(self, optimizer):
+        # reference: optimizer is serialized to the servers and runs there
+        # (`python/mxnet/kvstore.py set_optimizer` → SendCommandToServers)
+        self._optimizer = optimizer
+        if self._worker.rank == 0:
+            self._worker.send_command("set_optimizer",
+                                      pickle.dumps(optimizer))
+        self._worker.barrier()
+
+    def barrier(self):
+        self._worker.barrier()
+
+    def send_command_to_servers(self, head, body):
+        self._worker.send_command(head, body)
+
+    def close(self):
+        self._worker.close()
+
+
+# ---------------------------------------------------------------------------
+# Factory (reference `src/kvstore/kvstore.cc:40-72`)
+# ---------------------------------------------------------------------------
+
+def create(name: str = "local", **kwargs) -> KVStore:
+    name = (name or "local").lower()
+    if name.startswith("dist"):
+        return KVStoreDist(name)
+    if name == "tpu":
+        return KVStoreTPU(**kwargs)
+    if name in ("device", "nccl"):
+        return KVStoreDevice()
+    if name == "local":
+        return KVStore()
+    raise MXNetError("unknown kvstore type %r" % name)

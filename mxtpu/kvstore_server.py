@@ -1,0 +1,36 @@
+"""Server/scheduler bootstrap for distributed KVStore.
+
+Reference: `python/mxnet/kvstore_server.py` — a process whose
+MXTPU_ROLE/DMLC_ROLE is ``server`` or ``scheduler`` calls
+:func:`init_module` (the reference does this at import of mxnet inside
+the launched process) and blocks serving until the worker group
+finishes.  Launched by `tools/launch.py`.
+"""
+from __future__ import annotations
+
+from . import _ps
+
+__all__ = ["KVStoreServer", "init_module"]
+
+
+class KVStoreServer(object):
+    def __init__(self):
+        self._role = _ps.role_from_env()
+
+    def run(self):
+        if self._role == "scheduler":
+            _ps.run_scheduler()
+        elif self._role == "server":
+            _ps.run_server()
+        else:
+            raise RuntimeError("KVStoreServer started with role %r"
+                               % self._role)
+
+
+def init_module():
+    """If this process is a server/scheduler, serve and exit (mirrors the
+    reference's blocking server loop)."""
+    role = _ps.role_from_env()
+    if role in ("server", "scheduler"):
+        KVStoreServer().run()
+        raise SystemExit(0)

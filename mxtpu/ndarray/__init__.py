@@ -48,6 +48,10 @@ _this.load = load
 # `nd.random` sub-namespace (reference: mxnet.ndarray.random)
 from .. import random as random  # noqa: E402
 
+# `nd.sparse` sub-namespace (reference: mxnet.ndarray.sparse)
+from . import sparse  # noqa: E402
+from .sparse import CSRNDArray, RowSparseNDArray  # noqa: E402
+
 # `nd.contrib` sub-namespace: expose _contrib_* ops without the prefix
 contrib = _types.ModuleType(__name__ + ".contrib")
 for _name in dir(_this):

@@ -199,6 +199,9 @@ class NDArray(object):
             return out
         if not isinstance(other, NDArray):
             raise TypeError("copyto target must be NDArray or Context")
+        if other.stype != "default":
+            from .sparse import cast_storage
+            return cast_storage(self, other.stype).copyto(other)
         data = jax.device_put(self._data, _dev_of_ctx(other.ctx))
         if data.dtype != other._data.dtype:
             data = data.astype(other._data.dtype)
@@ -550,6 +553,12 @@ def imperative_invoke(op_name: str, *inputs, out=None,
     nd_inputs: List[NDArray] = []
     for x in inputs:
         if isinstance(x, NDArray):
+            # storage-fallback dispatch (reference
+            # `attach_op_execs_pass.cc:45`): ops without a sparse
+            # formulation run on the densified array; sparse-native
+            # kernels live in ndarray/sparse.py and bypass this funnel
+            if x.stype != "default":
+                x = x.todense()
             nd_inputs.append(x)
         elif isinstance(x, (int, float, np.generic, np.ndarray, list, tuple)):
             nd_inputs.append(array(x))

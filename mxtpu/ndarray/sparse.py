@@ -1,0 +1,401 @@
+"""Sparse NDArrays: CSR and row-sparse.
+
+TPU-native re-design of the reference sparse stack
+(`include/mxnet/ndarray.h:61-66` NDArrayStorageType, dense/row_sparse/csr
+chunks with aux arrays; python `python/mxnet/ndarray/sparse.py`
+CSRNDArray/RowSparseNDArray; kernels under `src/operator/tensor/
+cast_storage-inl.h`, `dot-inl.h`, `sparse_retain-inl.h`,
+`square_sum-inl.h`).
+
+TPU has no native sparse representation (SURVEY.md §7 "Sparse on TPU"),
+so the aux arrays are ordinary dense `jax.Array`s and every kernel is a
+gather/scatter/segment-sum formulation that XLA compiles well:
+
+  * row_sparse: ``data`` [nnz_rows, ...] + ``indices`` [nnz_rows]
+  * csr:        ``data`` [nnz] + ``indices`` [nnz] + ``indptr`` [m+1]
+  * ``cast_storage`` dense<->sparse via nonzero/scatter;
+  * ``dot(csr, dense)`` = row-segment-sum of gathered rhs rows scaled by
+    values (one fused XLA executable);
+  * ops with no sparse formulation fall back to dense, mirroring the
+    reference's storage-fallback dispatch
+    (`src/executor/attach_op_execs_pass.cc:45`).
+
+Like the reference, a sparse array's unspecified entries are zeros, and
+`retain` / `row_sparse_pull` keep only requested rows.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+from ..context import Context, current_context
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "cast_storage", "zeros",
+           "empty", "array", "dot", "retain", "retain_rows_into",
+           "add", "elemwise_add"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# Classes
+# ---------------------------------------------------------------------------
+
+class BaseSparseNDArray(NDArray):
+    """Common base (reference `python/mxnet/ndarray/sparse.py:
+    BaseSparseNDArray`).  `_data` holds the *packed value* array; the
+    logical dense shape lives in `_shape`."""
+
+    __slots__ = ("_shape", "_aux")
+
+    def __init__(self, data, aux, shape, ctx: Optional[Context] = None):
+        super().__init__(data, ctx=ctx)
+        self._aux = tuple(NDArray(a, ctx=self._ctx)
+                          if not isinstance(a, NDArray) else a for a in aux)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._shape
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._data, ctx=self._ctx, _committed=True)
+
+    @property
+    def indices(self) -> NDArray:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (type(self).__name__,
+                                  "x".join(map(str, self._shape)), self._ctx)
+
+    def asnumpy(self) -> np.ndarray:
+        return self.todense().asnumpy()
+
+    def astype(self, dtype, copy: bool = True):
+        raise MXNetError("astype on sparse: tostype('default') first")
+
+    def todense(self) -> NDArray:
+        return cast_storage(self, "default")
+
+    def tostype(self, stype: str):
+        return cast_storage(self, stype)
+
+    def copy(self):
+        # jax buffers are immutable, so sharing them is safe — a fresh
+        # wrapper is a true copy (later _set_jax only rebinds the wrapper)
+        return type(self)(self._data, self._aux, self._shape, ctx=self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            raise MXNetError("sparse copyto(Context) unsupported; tostype")
+        if isinstance(other, BaseSparseNDArray):
+            src = self if self.stype == other.stype \
+                else cast_storage(self, other.stype)
+            other._set_jax(src._data)
+            other._aux = src._aux
+            other._shape = src._shape
+            return other
+        return self.todense().copyto(other)
+
+    def __getitem__(self, key):
+        raise MXNetError("indexing not supported on %s" % self.stype)
+
+    def __setitem__(self, key, value):
+        raise MXNetError("assignment not supported on %s" % self.stype)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2-D compressed sparse row array (reference `sparse.py:CSRNDArray`,
+    chunk layout `include/mxnet/ndarray.h` kCSRStorage)."""
+
+    @property
+    def stype(self) -> str:
+        return "csr"
+
+    @property
+    def indices(self) -> NDArray:
+        return self._aux[1]
+
+    @property
+    def indptr(self) -> NDArray:
+        return self._aux[0]
+
+    @property
+    def nnz(self) -> int:
+        return int(self._data.shape[0])
+
+    def dot(self, other, transpose_a=False, **kw):
+        return dot(self, other, transpose_a=transpose_a)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """First-dim-sparse array (reference `sparse.py:RowSparseNDArray`,
+    kRowSparseStorage): ``data`` holds the stored rows, ``indices`` their
+    row ids (sorted, unique)."""
+
+    @property
+    def stype(self) -> str:
+        return "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        return self._aux[0]
+
+    def retain(self, row_ids) -> "RowSparseNDArray":
+        return retain(self, row_ids)
+
+
+# ---------------------------------------------------------------------------
+# Constructors (reference `sparse.py: csr_matrix / row_sparse_array`)
+# ---------------------------------------------------------------------------
+
+def _as_jax(x, dtype=None):
+    import jax.numpy as jnp
+
+    if isinstance(x, NDArray):
+        return x._data if dtype is None else x._data.astype(dtype)
+    return jnp.asarray(np.asarray(x), dtype=dtype)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    """csr_matrix((data, indices, indptr), shape=(m, n)) or from a dense
+    NDArray/numpy/scipy source."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise MXNetError("shape required for (data, indices, indptr)")
+        jd = _as_jax(data, np_dtype(dtype) if dtype else None)
+        ji = _as_jax(indices, np.int32)
+        jp = _as_jax(indptr, np.int32)
+        return CSRNDArray(jd, (jp, ji), shape, ctx=ctx)
+    if hasattr(arg1, "tocsr"):  # scipy sparse
+        sp = arg1.tocsr()
+        return csr_matrix((sp.data, sp.indices, sp.indptr), shape=sp.shape,
+                          ctx=ctx, dtype=dtype)
+    dense = arg1 if isinstance(arg1, NDArray) else _dense_array(
+        arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
+    """row_sparse_array((data, indices), shape=...) or from dense."""
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not np.isscalar(arg1[0]):
+        data, indices = arg1
+        if shape is None:
+            raise MXNetError("shape required for (data, indices)")
+        jd = _as_jax(data, np_dtype(dtype) if dtype else None)
+        ji = _as_jax(indices, np.int32)
+        return RowSparseNDArray(jd, (ji,), shape, ctx=ctx)
+    dense = arg1 if isinstance(arg1, NDArray) else _dense_array(
+        arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def zeros(stype: str, shape, ctx=None, dtype=None):
+    jnp = _jnp()
+    dt = np_dtype(dtype)
+    shape = tuple(shape)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + shape[1:], dt),
+                                (jnp.zeros((0,), np.int32),), shape, ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dt),
+                          (jnp.zeros((shape[0] + 1,), np.int32),
+                           jnp.zeros((0,), np.int32)), shape, ctx=ctx)
+    if stype == "default":
+        from . import ndarray as _nd
+
+        return _nd.zeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError("unknown stype %r" % stype)
+
+
+empty = zeros
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, BaseSparseNDArray):
+        return source_array.copy()
+    if hasattr(source_array, "tocsr"):
+        return csr_matrix(source_array, ctx=ctx, dtype=dtype)
+    raise MXNetError("use csr_matrix/row_sparse_array for dense sources")
+
+
+# ---------------------------------------------------------------------------
+# cast_storage (reference `src/operator/tensor/cast_storage-inl.h`)
+# ---------------------------------------------------------------------------
+
+def cast_storage(arr: NDArray, stype: str):
+    jnp = _jnp()
+    src_stype = arr.stype
+    if stype == src_stype:
+        return arr
+    if src_stype == "default":
+        a = arr._data
+        if stype == "row_sparse":
+            # nonzero rows -> gathered data (host-side nonzero: aux shapes
+            # are data-dependent, same as the reference's host-synced nnz)
+            host = np.asarray(arr.wait_to_read()._data)
+            flat = np.abs(host).reshape(host.shape[0], -1) \
+                if host.ndim > 1 else np.abs(host)[:, None]
+            rows = np.nonzero(flat.sum(axis=1) > 0)[0].astype(np.int32)
+            data = jnp.take(a, jnp.asarray(rows), axis=0)
+            return RowSparseNDArray(data, (jnp.asarray(rows),), arr.shape,
+                                    ctx=arr.ctx)
+        if stype == "csr":
+            if arr.ndim != 2:
+                raise MXNetError("csr requires 2-D")
+            host = np.asarray(arr.wait_to_read()._data)
+            r, c = np.nonzero(host)
+            data = host[r, c]
+            indptr = np.zeros(arr.shape[0] + 1, np.int32)
+            np.add.at(indptr, r + 1, 1)
+            indptr = np.cumsum(indptr)
+            return CSRNDArray(jnp.asarray(data), (jnp.asarray(indptr),
+                                                  jnp.asarray(c.astype(np.int32))),
+                              arr.shape, ctx=arr.ctx)
+        raise MXNetError("unknown stype %r" % stype)
+    if stype == "default":
+        if src_stype == "row_sparse":
+            out = jnp.zeros(arr.shape, arr._data.dtype)
+            if arr._data.shape[0]:
+                out = out.at[arr._aux[0]._data].set(arr._data)
+            return NDArray(out, ctx=arr.ctx, _committed=True)
+        if src_stype == "csr":
+            m, n = arr.shape
+            indptr = np.asarray(arr._aux[0]._data)
+            rows = np.repeat(np.arange(m, dtype=np.int32),
+                             np.diff(indptr))
+            out = jnp.zeros((m, n), arr._data.dtype)
+            if arr._data.shape[0]:
+                out = out.at[jnp.asarray(rows), arr._aux[1]._data].add(
+                    arr._data)
+            return NDArray(out, ctx=arr.ctx, _committed=True)
+    # sparse -> other sparse: via dense
+    return cast_storage(cast_storage(arr, "default"), stype)
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+def dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
+    """Sparse dot (reference `src/operator/tensor/dot-inl.h`):
+    csr·dense, csrᵀ·dense; formulated as gather + segment-sum so XLA maps
+    it to MXU-friendly batched ops."""
+    import jax
+
+    jnp = _jnp()
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        if transpose_b:
+            raise MXNetError("dot(csr, dense, transpose_b) unsupported")
+        m, n = lhs.shape
+        indptr = np.asarray(lhs._aux[0]._data)
+        rows = jnp.asarray(np.repeat(np.arange(m, dtype=np.int32),
+                                     np.diff(indptr)))
+        cols, vals = lhs._aux[1]._data, lhs._data
+        d = rhs._data
+        if not transpose_a:
+            # out[m, k] = Σ_nnz vals * rhs[cols]  segment-summed by row
+            gathered = jnp.take(d, cols, axis=0) * vals[:, None]
+            out = jax.ops.segment_sum(gathered, rows, num_segments=m)
+        else:
+            # out[n, k] = Σ_nnz vals * rhs[rows]  scattered by col
+            gathered = jnp.take(d, rows, axis=0) * vals[:, None]
+            out = jax.ops.segment_sum(gathered, cols, num_segments=n)
+        return NDArray(out, ctx=rhs.ctx, _committed=True)
+    if isinstance(lhs, NDArray) and not isinstance(lhs, BaseSparseNDArray) \
+            and isinstance(rhs, CSRNDArray):
+        # Dᵃ · Sᵇ = (Sᵇᵀ · Dᵃᵀ)ᵀ, with Dᵃᵀ = D when transpose_a else Dᵀ
+        inner = lhs if transpose_a else NDArray(lhs._data.T, ctx=lhs.ctx,
+                                                _committed=True)
+        out = dot(rhs, inner, transpose_a=not transpose_b)
+        return NDArray(out._data.T, ctx=lhs.ctx, _committed=True)
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+        r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+        from .ndarray import imperative_invoke
+
+        return imperative_invoke("dot", l, r, transpose_a=transpose_a,
+                                 transpose_b=transpose_b)[0]
+    from .ndarray import imperative_invoke
+
+    return imperative_invoke("dot", lhs, rhs, transpose_a=transpose_a,
+                             transpose_b=transpose_b)[0]
+
+
+def retain(arr: RowSparseNDArray, row_ids) -> RowSparseNDArray:
+    """Keep only `row_ids` (reference `_sparse_retain`,
+    `src/operator/tensor/sparse_retain-inl.h`)."""
+    jnp = _jnp()
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("retain expects row_sparse")
+    rids = np.unique(np.asarray(_as_jax(row_ids)).astype(np.int32))
+    stored = np.asarray(arr._aux[0]._data)
+    # positions of requested rows inside the stored set
+    pos = np.searchsorted(stored, rids)
+    valid = (pos < len(stored))
+    valid[valid] &= stored[pos[valid]] == rids[valid]
+    keep_pos = pos[valid]
+    data = jnp.take(arr._data, jnp.asarray(keep_pos), axis=0) \
+        if len(keep_pos) else jnp.zeros((0,) + tuple(arr._data.shape[1:]),
+                                        arr._data.dtype)
+    return RowSparseNDArray(data, (jnp.asarray(stored[keep_pos]
+                                               if len(keep_pos) else
+                                               np.zeros((0,), np.int32)),),
+                            arr.shape, ctx=arr.ctx)
+
+
+def retain_rows_into(src: NDArray, row_ids, dst) -> None:
+    """KVStore row_sparse_pull helper: gather `row_ids` rows of dense
+    `src` into `dst` (row_sparse target gets exactly those rows; dense
+    target gets them scattered into zeros)."""
+    jnp = _jnp()
+    rids_np = np.unique(np.asarray(_as_jax(row_ids)).astype(np.int32))
+    rids = jnp.asarray(rids_np)
+    rows = jnp.take(src._data, rids, axis=0)
+    if isinstance(dst, RowSparseNDArray):
+        dst._set_jax(rows)
+        dst._aux = (NDArray(rids, ctx=dst.ctx),)
+        dst._shape = tuple(src.shape)
+    elif isinstance(dst, NDArray):
+        out = jnp.zeros(src.shape, src._data.dtype).at[rids].set(rows)
+        dst._set_jax(out)
+    else:
+        raise MXNetError("bad row_sparse_pull target %r" % type(dst))
+
+
+def add(lhs, rhs):
+    """elemwise_add with sparse-aware fast paths: rsp+rsp stays sparse
+    (reference FComputeEx for add with row_sparse inputs)."""
+    jnp = _jnp()
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        if lhs.shape != rhs.shape:
+            raise MXNetError("shape mismatch")
+        rows = np.union1d(np.asarray(lhs._aux[0]._data),
+                          np.asarray(rhs._aux[0]._data)).astype(np.int32)
+        out = jnp.zeros((len(rows),) + tuple(lhs._data.shape[1:]),
+                        lhs._data.dtype)
+        li = np.searchsorted(rows, np.asarray(lhs._aux[0]._data))
+        ri = np.searchsorted(rows, np.asarray(rhs._aux[0]._data))
+        if lhs._data.shape[0]:
+            out = out.at[jnp.asarray(li)].add(lhs._data)
+        if rhs._data.shape[0]:
+            out = out.at[jnp.asarray(ri)].add(rhs._data)
+        return RowSparseNDArray(out, (jnp.asarray(rows),), lhs.shape,
+                                ctx=lhs.ctx)
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return l + r
+
+
+elemwise_add = add

@@ -181,9 +181,34 @@ class SGD(Optimizer):
         return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         kw = self._common_kwargs()
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            # lazy row-sparse update (reference sgd[_mom]_update with
+            # row_sparse grad, `src/operator/optimizer_op.cc`): only the
+            # rows present in the gradient are touched
+            import jax.numpy as jnp
+
+            rows = grad.indices._data
+            g = grad.data._data * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            w = weight._data
+            wr = jnp.take(w, rows, axis=0)
+            if state is None:
+                weight._set_jax(w.at[rows].set(
+                    wr - lr * (g + wd * wr)))
+            else:
+                mr = jnp.take(state._data, rows, axis=0)
+                mr = self.momentum * mr - lr * (g + wd * wr)
+                state._set_jax(state._data.at[rows].set(mr))
+                weight._set_jax(w.at[rows].set(wr + mr))
+            return
+        if isinstance(grad, RowSparseNDArray):
+            grad = grad.todense()
         if state is None:
             self._apply("sgd_update", weight, grad, (), lr=lr, wd=wd, **kw)
         else:
@@ -347,8 +372,26 @@ class AdaGrad(Optimizer):
         return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        if isinstance(grad, RowSparseNDArray):
+            # reference `_sparse_adagrad_update`: history/weight touched
+            # only on the gradient's rows
+            import jax.numpy as jnp
+
+            rows = grad.indices._data
+            g = grad.data._data * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            hr = jnp.take(state._data, rows, axis=0) + g * g
+            state._set_jax(state._data.at[rows].set(hr))
+            wr = jnp.take(weight._data, rows, axis=0)
+            upd = wr - lr * (g / (jnp.sqrt(hr) + self.float_stable_eps)
+                             + wd * wr)
+            weight._set_jax(weight._data.at[rows].set(upd))
+            return
         self._apply("_sparse_adagrad_update", weight, grad, (state,), lr=lr,
                     wd=wd, epsilon=self.float_stable_eps,
                     **self._common_kwargs())

@@ -1,0 +1,59 @@
+"""Worker script for the multi-process dist_sync test (run under
+tools/launch.py; reference: `tests/nightly/dist_sync_kvstore.py:30-60`).
+
+Asserts exact synchronous allreduce semantics: after every worker pushes
+rank-dependent values, every worker pulls the identical sum; also
+exercises the >bigarray-bound sharded path and updater-on-server.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import mxtpu as mx
+
+SHAPE = (8, 8)
+BIG_SHAPE = (1400, 1000)  # > default 1e6 bigarray bound -> server-sharded
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker > 1, "run under tools/launch.py -n 2"
+
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(99, mx.nd.zeros(BIG_SHAPE))
+
+    # round 1: each worker pushes (rank+1); sum = n(n+1)/2
+    kv.push(3, mx.nd.ones(SHAPE) * (rank + 1))
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    expected = nworker * (nworker + 1) / 2
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, expected),
+                               rtol=1e-5)
+
+    # big array: sharded across the server group
+    kv.push(99, mx.nd.ones(BIG_SHAPE) * (rank + 1))
+    big = mx.nd.empty(BIG_SHAPE)
+    kv.pull(99, out=big)
+    np.testing.assert_allclose(big.asnumpy(),
+                               np.full(BIG_SHAPE, expected), rtol=1e-5)
+
+    # updater-on-server: sgd with lr 0.1 -> stored -= 0.1 * merged
+    kv.barrier()
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1, wd=0.0))
+    kv.init(7, mx.nd.zeros(SHAPE))
+    kv.push(7, mx.nd.ones(SHAPE))
+    out7 = mx.nd.empty(SHAPE)
+    kv.pull(7, out=out7)
+    np.testing.assert_allclose(out7.asnumpy(),
+                               np.full(SHAPE, -0.1 * nworker), rtol=1e-5)
+
+    kv.barrier()
+    kv.close()
+    print("DIST_SYNC_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,170 @@
+"""KVStore tests (modeled on `tests/python/unittest/test_kvstore.py` and
+`tests/nightly/dist_sync_kvstore.py` of the reference)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+STR_KEYS = ["b", "c", "d"]
+
+
+def _init_kv(kv_type="local"):
+    kv = mx.kv.create(kv_type)
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def _check_diff_to_scalar(arr, x):
+    np.testing.assert_allclose(arr.asnumpy(), np.full(SHAPE, x), rtol=1e-5)
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device", "tpu"])
+def test_single_kv_pair(kv_type):
+    kv = _init_kv(kv_type)
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    _check_diff_to_scalar(out, 1.0)
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device", "tpu"])
+def test_list_kv_pair(kv_type):
+    kv = _init_kv(kv_type)
+    kv.push(KEYS, [mx.nd.ones(SHAPE) * 4] * len(KEYS))
+    outs = [mx.nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        _check_diff_to_scalar(o, 4.0)
+
+
+def test_aggregator_multi_device():
+    """Push a list of per-device values -> reduced sum broadcast back
+    (reference test_aggregator)."""
+    num_devs = 4
+    kv = _init_kv("device")
+    vals = [mx.nd.ones(SHAPE) for _ in range(num_devs)]
+    kv.push(3, vals)
+    outs = [mx.nd.empty(SHAPE) for _ in range(num_devs)]
+    kv.pull(3, out=outs)
+    for o in outs:
+        _check_diff_to_scalar(o, num_devs)
+
+
+def test_tpu_allreduce_over_mesh():
+    """'tpu' kvstore reduce = psum over the dp axis of the active mesh."""
+    import jax
+
+    import mxtpu.parallel as par
+
+    n = 4
+    mesh = par.create_mesh({"dp": n}, devices=jax.devices()[:n])
+    with par.MeshContext(mesh):
+        kv = mx.kv.create("tpu")
+        kv.init(3, mx.nd.zeros(SHAPE))
+        kv.push(3, [mx.nd.ones(SHAPE) * (i + 1) for i in range(n)])
+        out = mx.nd.empty(SHAPE)
+        kv.pull(3, out=out)
+    _check_diff_to_scalar(out, sum(range(1, n + 1)))
+
+
+def test_updater():
+    """Custom updater runs on push (reference test_updater)."""
+    kv = _init_kv("device")
+    kv.set_updater(lambda key, recv, stored: stored.__iadd__(recv * 2))
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    _check_diff_to_scalar(out, 2.0)
+    # accumulate across pushes
+    num_push = 3
+    for _ in range(num_push):
+        kv.push(3, mx.nd.ones(SHAPE))
+    kv.pull(3, out=out)
+    _check_diff_to_scalar(out, 2.0 * (num_push + 1))
+
+
+def test_get_type_and_str_keys():
+    kv = mx.kv.create("device")
+    assert kv.type == "device"
+    kv.init(STR_KEYS, [mx.nd.ones(SHAPE)] * len(STR_KEYS))
+    outs = [mx.nd.empty(SHAPE) for _ in STR_KEYS]
+    kv.pull(STR_KEYS, out=outs)
+    for o in outs:
+        _check_diff_to_scalar(o, 1.0)
+
+
+def test_gradient_compression_exact():
+    """2-bit quantization with error feedback matches the python model
+    (reference computes expected values in
+    `tests/nightly/dist_sync_kvstore.py` compute_expected_2bit_quantization)."""
+    threshold = 0.5
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": threshold})
+    kv.init(3, mx.nd.zeros(SHAPE))
+
+    rng = np.random.RandomState(0)
+    grads = [rng.uniform(-1.2, 1.2, SHAPE).astype(np.float32)
+             for _ in range(4)]
+    residual = np.zeros(SHAPE, dtype=np.float32)
+    for g in grads:
+        kv.push(3, mx.nd.array(g))
+        out = mx.nd.empty(SHAPE)
+        kv.pull(3, out=out)
+        x = g + residual
+        expected = np.where(x > threshold, threshold,
+                            np.where(x < -threshold, -threshold,
+                                     0.0)).astype(np.float32)
+        residual = x - expected
+        np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-6)
+
+
+def test_optimizer_on_kvstore():
+    """set_optimizer routes pushes through the fused sgd update."""
+    kv = _init_kv("device")
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
+                                         rescale_grad=1.0, wd=0.0))
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    _check_diff_to_scalar(out, -0.1)
+
+
+def test_trainer_with_kvstore_device():
+    """Trainer multi-replica aggregation through the kvstore."""
+    from mxtpu import autograd, gluon
+
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize(ctx=[mx.cpu(0), mx.cpu(1)])
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.0})
+    x = mx.nd.ones((2, 3))
+    with autograd.record():
+        y = net(x)
+        loss = (y * y).sum()
+    loss.backward()
+    trainer.step(2)  # smoke: aggregation + update runs
+
+
+def test_dist_sync_kvstore_local_launcher():
+    """Multi-process dist_sync over the local launcher (reference:
+    `tools/launch.py -n 2 python dist_sync_kvstore.py`,
+    `tests/nightly/test_all.sh:55`)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "tests", "dist_sync_kvstore.py")
+    launcher = os.path.join(repo, "tools", "launch.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    res = subprocess.run(
+        [sys.executable, launcher, "-n", "2", "-s", "2",
+         sys.executable, script],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("DIST_SYNC_OK") == 2, res.stdout + res.stderr

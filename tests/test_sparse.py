@@ -1,0 +1,145 @@
+"""Sparse NDArray tests (modeled on the reference
+`tests/python/unittest/test_sparse_ndarray.py` /
+`test_sparse_operator.py`)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.ndarray import sparse
+
+
+def _rand_dense_with_zero_rows(m, n, frac=0.5, seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(m, n).astype(np.float32)
+    zero_rows = rng.choice(m, int(m * frac), replace=False)
+    a[zero_rows] = 0
+    return a
+
+
+def test_csr_roundtrip():
+    a = _rand_dense_with_zero_rows(8, 5)
+    a[a < 0] = 0  # element sparsity
+    csr = sparse.csr_matrix(mx.nd.array(a))
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), a, rtol=1e-6)
+    dense = csr.todense()
+    assert dense.stype == "default"
+    np.testing.assert_allclose(dense.asnumpy(), a, rtol=1e-6)
+
+
+def test_csr_from_triple():
+    data = [1.0, 2.0, 3.0]
+    indices = [0, 2, 1]
+    indptr = [0, 1, 2, 2, 3]
+    csr = sparse.csr_matrix((data, indices, indptr), shape=(4, 3))
+    expected = np.zeros((4, 3), np.float32)
+    expected[0, 0], expected[1, 2], expected[3, 1] = 1, 2, 3
+    np.testing.assert_allclose(csr.asnumpy(), expected)
+    assert csr.nnz == 3
+
+
+def test_row_sparse_roundtrip():
+    a = _rand_dense_with_zero_rows(10, 4)
+    rsp = sparse.row_sparse_array(mx.nd.array(a))
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_allclose(rsp.asnumpy(), a, rtol=1e-6)
+    assert rsp.data.shape[0] == int((np.abs(a).sum(1) > 0).sum())
+
+
+def test_cast_storage_all_pairs():
+    a = _rand_dense_with_zero_rows(6, 3)
+    a[a < 0] = 0
+    nd = mx.nd.array(a)
+    for st in ("csr", "row_sparse"):
+        sp = nd.tostype(st)
+        np.testing.assert_allclose(sp.asnumpy(), a, rtol=1e-6)
+        back = sp.tostype("default")
+        np.testing.assert_allclose(back.asnumpy(), a, rtol=1e-6)
+
+
+def test_sparse_dot():
+    a = _rand_dense_with_zero_rows(8, 6, seed=1)
+    a[np.abs(a) < 0.7] = 0
+    b = np.random.RandomState(2).randn(6, 4).astype(np.float32)
+    csr = sparse.csr_matrix(mx.nd.array(a))
+    out = sparse.dot(csr, mx.nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5, atol=1e-5)
+    # transpose_a
+    bt = np.random.RandomState(3).randn(8, 4).astype(np.float32)
+    out_t = sparse.dot(csr, mx.nd.array(bt), transpose_a=True)
+    np.testing.assert_allclose(out_t.asnumpy(), a.T @ bt, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_sparse_retain():
+    a = _rand_dense_with_zero_rows(10, 3, seed=4)
+    rsp = sparse.row_sparse_array(mx.nd.array(a))
+    keep = mx.nd.array(np.array([0, 3, 7], np.int64))
+    ret = sparse.retain(rsp, keep)
+    expected = np.zeros_like(a)
+    for r in (0, 3, 7):
+        expected[r] = a[r]
+    np.testing.assert_allclose(ret.asnumpy(), expected, rtol=1e-6)
+
+
+def test_rsp_add():
+    a = _rand_dense_with_zero_rows(8, 3, seed=5)
+    b = _rand_dense_with_zero_rows(8, 3, seed=6)
+    ra = sparse.row_sparse_array(mx.nd.array(a))
+    rb = sparse.row_sparse_array(mx.nd.array(b))
+    out = sparse.add(ra, rb)
+    assert out.stype == "row_sparse"
+    np.testing.assert_allclose(out.asnumpy(), a + b, rtol=1e-6)
+
+
+def test_sparse_sgd_lazy_update():
+    """Row-sparse SGD touches only the gradient's rows (reference
+    sgd_update w/ row_sparse, lazy_update=True)."""
+    w0 = np.random.RandomState(7).randn(10, 4).astype(np.float32)
+    g = np.zeros_like(w0)
+    g[2], g[5] = 1.0, 2.0
+    weight = mx.nd.array(w0)
+    grad = sparse.row_sparse_array(mx.nd.array(g))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, wd=0.0,
+                              rescale_grad=1.0)
+    opt.update(0, weight, grad, opt.create_state(0, weight))
+    expected = w0.copy()
+    expected[2] -= 0.1 * 1.0
+    expected[5] -= 0.1 * 2.0
+    np.testing.assert_allclose(weight.asnumpy(), expected, rtol=1e-6)
+
+
+def test_sparse_adagrad():
+    w0 = np.random.RandomState(8).randn(6, 2).astype(np.float32)
+    g = np.zeros_like(w0)
+    g[1] = 0.5
+    weight = mx.nd.array(w0)
+    grad = sparse.row_sparse_array(mx.nd.array(g))
+    opt = mx.optimizer.create("adagrad", learning_rate=0.1, wd=0.0,
+                              rescale_grad=1.0)
+    state = opt.create_state(0, weight)
+    opt.update(0, weight, grad, state)
+    expected = w0.copy()
+    hist = 0.5 * 0.5
+    expected[1] -= 0.1 * 0.5 / (np.sqrt(hist) + 1e-7)
+    np.testing.assert_allclose(weight.asnumpy(), expected, rtol=1e-5)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("device")
+    w = np.random.RandomState(9).randn(8, 3).astype(np.float32)
+    kv.init(3, mx.nd.array(w))
+    out = sparse.zeros("row_sparse", (8, 3))
+    kv.row_sparse_pull(3, out=out, row_ids=mx.nd.array([1, 4]))
+    expected = np.zeros_like(w)
+    expected[1], expected[4] = w[1], w[4]
+    np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-6)
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("csr", (4, 5))
+    assert z.stype == "csr" and z.shape == (4, 5)
+    assert np.all(z.asnumpy() == 0)
+    zr = sparse.zeros("row_sparse", (4, 5))
+    assert zr.stype == "row_sparse"
+    assert np.all(zr.asnumpy() == 0)

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Local launcher for distributed KVStore jobs.
+
+The analog of the reference's `tools/launch.py` → dmlc-tracker
+(`tools/launch.py:71-111`): spawns 1 scheduler + S servers + W workers
+as local processes with the role environment set
+(MXTPU_ROLE/MXTPU_PS_ROOT_URI/...), waits for the workers, then reaps
+the rest.  Only the ``local`` launcher is provided — on real clusters
+multi-host jobs use the TPU coordination service (jax.distributed), not
+this PS bootstrap.
+
+Usage:  python tools/launch.py -n 2 [-s 1] python my_script.py args...
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=None)
+    ap.add_argument("--launcher", choices=["local"], default="local")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    ns = args.num_servers if args.num_servers is not None else args.num_workers
+
+    base = dict(os.environ)
+    base.update({
+        "MXTPU_PS_ROOT_URI": "127.0.0.1",
+        "MXTPU_PS_ROOT_PORT": str(_free_port()),
+        "MXTPU_NUM_WORKER": str(args.num_workers),
+        "MXTPU_NUM_SERVER": str(ns),
+    })
+
+    procs = []
+
+    def spawn(role, extra=None):
+        env = dict(base)
+        env["MXTPU_ROLE"] = role
+        env.update(extra or {})
+        if role in ("scheduler", "server"):
+            cmd = [sys.executable, "-c",
+                   "import mxtpu.kvstore_server as s; s.init_module()"]
+        else:
+            cmd = args.command
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    spawn("scheduler")
+    for _ in range(ns):
+        spawn("server")
+    workers = []
+    for _ in range(args.num_workers):
+        spawn("worker")
+        workers.append(procs[-1])
+
+    rc = 0
+    try:
+        for w in workers:
+            rc |= w.wait()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
