@@ -51,6 +51,13 @@ from . import test_utils
 from . import kvstore
 from . import kvstore as kv
 from . import kvstore_server
+from . import model
+from . import callback
+from . import profiler
+from . import monitor
+from . import visualization
+from . import module
+from . import module as mod
 from . import gluon
 
 

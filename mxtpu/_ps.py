@@ -387,6 +387,13 @@ class Server(object):
                 self._cv.wait()
             if key in self._errors:
                 return {"value": None, "error": self._errors[key]}
+            if key not in self._store or \
+                    self._versions.get(key, 0) < min_version:
+                # woken by shutdown before the round completed — do NOT
+                # hand out stale pre-round weights
+                return {"value": None,
+                        "error": "server shut down before %r reached "
+                                 "version %d" % (key, min_version)}
             return {"value": self._store.get(key),
                     "version": self._versions.get(key, 0)}
 

@@ -243,6 +243,15 @@ class Executor(object):
         return [a._data for a in self.aux_arrays]
 
     def forward(self, is_train: bool = False, **kwargs):
+        from . import profiler as _prof
+
+        if _prof.is_recording("symbolic"):
+            with _prof.span("Executor::forward(%s)"
+                            % self._symbol.name, "symbolic"):
+                return self._forward_impl(is_train, **kwargs)
+        return self._forward_impl(is_train, **kwargs)
+
+    def _forward_impl(self, is_train: bool = False, **kwargs):
         for name, val in kwargs.items():
             if name not in self.arg_dict:
                 raise MXNetError("unknown argument %r" % name)

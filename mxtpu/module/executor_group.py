@@ -1,0 +1,240 @@
+"""DataParallelExecutorGroup — per-device executors over a sliced batch.
+
+Reference: `python/mxnet/module/executor_group.py:143` — the group owns
+one Executor per context, slices each batch across devices by workload
+(`decide_slices`, :281), runs forward (:436) / backward (:572), and
+exposes param/grad arrays as [per-param][per-device] lists for the
+kvstore update path.
+
+TPU note: on a pod slice the idiomatic path is ONE sharded executor over
+a mesh (`mxtpu.parallel`), not N executors; this group exists for the
+reference's multi-context Module semantics and for the `kvstore=tpu`
+per-key allreduce path, and degenerates to a single executor on one
+context with zero overhead.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..executor import Executor
+from ..io.io import DataDesc
+from ..ndarray import ndarray as nd_mod
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DataParallelExecutorGroup", "_split_input_slice"]
+
+
+def _split_input_slice(batch_size: int, work_load_list: Sequence[float]):
+    """Split batch into per-device slices proportional to workload
+    (reference `executor_manager.py:31`)."""
+    total = sum(work_load_list)
+    if total <= 0:
+        raise MXNetError("invalid workload")
+    slices = []
+    begin = 0
+    for i, w in enumerate(work_load_list):
+        if i == len(work_load_list) - 1:
+            end = batch_size
+        else:
+            end = min(int(round(begin + batch_size * w / total)), batch_size)
+        if end <= begin and batch_size >= len(work_load_list):
+            raise MXNetError("too many slices for batch size %d" % batch_size)
+        slices.append(slice(begin, end))
+        begin = end
+    return slices
+
+
+def _desc_list(shapes):
+    out = []
+    for s in shapes or []:
+        if isinstance(s, DataDesc):
+            out.append(s)
+        else:
+            out.append(DataDesc(s[0], s[1]))
+    return out
+
+
+class DataParallelExecutorGroup(object):
+    def __init__(self, symbol, contexts: List[Context],
+                 workload: Optional[List[float]],
+                 data_shapes, label_shapes, param_names: List[str],
+                 for_training: bool, inputs_need_grad: bool,
+                 shared_group: Optional["DataParallelExecutorGroup"] = None,
+                 logger=logging, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1.0] * len(contexts)
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.logger = logger
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.execs: List[Executor] = []
+        self.data_shapes = _desc_list(data_shapes)
+        self.label_shapes = _desc_list(label_shapes)
+        self.data_names = [d.name for d in self.data_shapes]
+        self.label_names = [l.name for l in self.label_shapes]
+        self.batch_size = self.data_shapes[0].shape[0]
+        self.slices = _split_input_slice(self.batch_size, self.workload)
+
+        grad_req_dict: Dict[str, str] = {}
+        for name in self.arg_names:
+            if name in self.param_names:
+                grad_req_dict[name] = "null" if not for_training or \
+                    name in self.fixed_param_names else \
+                    (grad_req if isinstance(grad_req, str)
+                     else grad_req.get(name, "write"))
+            elif name in self.data_names:
+                grad_req_dict[name] = "write" if inputs_need_grad else "null"
+            else:
+                grad_req_dict[name] = "null"
+
+        shared_execs = shared_group.execs if shared_group else None
+        for i, ctx in enumerate(contexts):
+            sl = self.slices[i]
+            n = sl.stop - sl.start
+            shape_kwargs = {}
+            for d in self.data_shapes:
+                shape_kwargs[d.name] = (n,) + tuple(d.shape[1:])
+            for l in self.label_shapes:
+                shape_kwargs[l.name] = (n,) + tuple(l.shape[1:])
+            ex = symbol.simple_bind(ctx=ctx, grad_req=grad_req_dict,
+                                    **shape_kwargs)
+            if shared_execs is not None:
+                # share parameter storage with the shared group's executor
+                # on the same context (BucketingModule memory sharing,
+                # reference executor_group.py shared_data_arrays)
+                src = shared_execs[i]
+                for name in self.param_names:
+                    if name in src.arg_dict and name in ex.arg_dict:
+                        ex.arg_dict[name] = src.arg_dict[name]
+                        ex.arg_arrays[ex._arg_names.index(name)] = \
+                            src.arg_dict[name]
+                        gi = ex._arg_names.index(name)
+                        src_grad = src.grad_arrays[
+                            src._arg_names.index(name)]
+                        if src_grad is not None:
+                            ex.grad_arrays[gi] = src_grad
+                            ex.grad_dict[name] = src_grad
+                for name, arr in src.aux_dict.items():
+                    if name in ex.aux_dict:
+                        ex.aux_dict[name] = arr
+                        ex.aux_arrays[ex._aux_names.index(name)] = arr
+            self.execs.append(ex)
+
+        # [per-param][per-device] views (reference param_arrays property)
+        self.param_arrays = [[ex.arg_dict[name] for ex in self.execs]
+                             for name in self.param_names
+                             if name in self.arg_names]
+        self.grad_arrays = [[ex.grad_dict.get(name) for ex in self.execs]
+                            for name in self.param_names
+                            if name in self.arg_names]
+        self.aux_arrays = [[ex.aux_dict[name] for ex in self.execs]
+                           for name in self.aux_names]
+
+    # -- params -----------------------------------------------------------
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for ex in self.execs:
+            ex.copy_params_from(arg_params, aux_params,
+                                allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params: Dict[str, NDArray],
+                   aux_params: Dict[str, NDArray]):
+        """Average per-device copies into the given dicts (reference
+        `executor_group.py:400`)."""
+        for name, blocks in zip(self.param_names, self.param_arrays):
+            weight = blocks[0]
+            if len(blocks) > 1:
+                acc = blocks[0].copyto(blocks[0].ctx)
+                for b in blocks[1:]:
+                    acc += b.as_in_context(acc.ctx)
+                weight = acc / len(blocks)
+            arg_params[name] = weight.copyto(weight.ctx)
+        for name, blocks in zip(self.aux_names, self.aux_arrays):
+            weight = blocks[0]
+            if len(blocks) > 1:
+                acc = blocks[0].copyto(blocks[0].ctx)
+                for b in blocks[1:]:
+                    acc += b.as_in_context(acc.ctx)
+                weight = acc / len(blocks)
+            aux_params[name] = weight.copyto(weight.ctx)
+
+    # -- execution --------------------------------------------------------
+    def _slice_to(self, arrays, names):
+        """Scatter host batch arrays into each executor's bound args."""
+        for name, arr in zip(names, arrays):
+            for ex, sl in zip(self.execs, self.slices):
+                if name not in ex.arg_dict:
+                    continue
+                dst = ex.arg_dict[name]
+                src = arr[sl.start:sl.stop] if arr.shape[0] != \
+                    (sl.stop - sl.start) or len(self.execs) > 1 else arr
+                if src.ctx != dst.ctx:
+                    src = src.as_in_context(dst.ctx)
+                dst._set_jax(src._data.astype(dst.dtype)
+                             if src.dtype != dst.dtype else src._data)
+
+    def forward(self, data_batch, is_train: Optional[bool] = None):
+        if is_train is None:
+            is_train = self.for_training
+        self._slice_to(data_batch.data, self.data_names)
+        if self.label_shapes and getattr(data_batch, "label", None):
+            self._slice_to(data_batch.label, self.label_names)
+        for ex in self.execs:
+            ex.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        if not self.for_training:
+            raise MXNetError("re-bind with for_training=True to backward")
+        for i, ex in enumerate(self.execs):
+            og = None
+            if out_grads is not None:
+                og = [g[self.slices[i].start:self.slices[i].stop]
+                      for g in out_grads]
+            ex.backward(out_grads=og)
+
+    def get_outputs(self, merge_multi_context: bool = True):
+        if merge_multi_context and len(self.execs) > 1:
+            merged = []
+            for oi in range(len(self.execs[0].outputs)):
+                parts = [ex.outputs[oi] for ex in self.execs]
+                ctx0 = parts[0].ctx
+                parts = [p.as_in_context(ctx0) for p in parts]
+                merged.append(nd_mod.concat(*parts, dim=0))
+            return merged
+        if len(self.execs) == 1:
+            return list(self.execs[0].outputs)
+        return [[ex.outputs[oi] for ex in self.execs]
+                for oi in range(len(self.execs[0].outputs))]
+
+    def get_input_grads(self, merge_multi_context: bool = True):
+        grads = []
+        for name in self.data_names:
+            parts = [ex.grad_dict.get(name) for ex in self.execs]
+            if merge_multi_context and len(parts) > 1:
+                ctx0 = parts[0].ctx
+                grads.append(nd_mod.concat(
+                    *[p.as_in_context(ctx0) for p in parts], dim=0))
+            else:
+                grads.append(parts[0] if len(parts) == 1 else parts)
+        return grads
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        for i, (ex, sl) in enumerate(zip(self.execs, self.slices)):
+            labels_slice = []
+            for label in (labels[i] if pre_sliced else labels):
+                labels_slice.append(label if pre_sliced
+                                    else label[sl.start:sl.stop])
+            eval_metric.update(labels_slice, list(ex.outputs))
+
+    def install_monitor(self, mon):
+        for ex in self.execs:
+            mon.install(ex)

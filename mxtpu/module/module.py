@@ -1,0 +1,367 @@
+"""Module — symbolic training over one or more devices.
+
+Reference: `python/mxnet/module/module.py` — `bind` (:364) builds the
+DataParallelExecutorGroup, `init_optimizer` (:474) decides
+kvstore/update_on_kvstore via `model._create_kvstore`, `update`
+(:644-662) routes through the kvstore or per-device updaters.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..initializer import InitDesc, Uniform
+from ..io.io import DataDesc
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint,
+                     save_checkpoint)
+from ..ndarray.ndarray import NDArray, zeros
+from .. import optimizer as opt_mod
+from .base_module import BaseModule
+from .executor_group import DataParallelExecutorGroup
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None,
+                 group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = [current_context()]
+        if isinstance(context, Context):
+            context = [context]
+        self._context = list(context)
+        self._work_load_list = work_load_list
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._compression_params = compression_params
+
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names + \
+            list(state_names or [])
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params: Optional[Dict[str, NDArray]] = None
+        self._aux_params: Optional[Dict[str, NDArray]] = None
+        self._params_dirty = False
+
+        self._exec_group: Optional[DataParallelExecutorGroup] = None
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Create a Module from a checkpoint (reference `module.py:149`)."""
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._sync_params_from_devices()
+        save_checkpoint(prefix, epoch, self.symbol, self._arg_params,
+                        self._aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        if not self.binded:
+            raise MXNetError("not bound")
+        return self._exec_group.data_shapes
+
+    @property
+    def label_shapes(self):
+        if not self.binded:
+            raise MXNetError("not bound")
+        return self._exec_group.label_shapes
+
+    @property
+    def output_shapes(self):
+        if not self.binded:
+            raise MXNetError("not bound")
+        shapes = self.symbol.infer_shape(
+            **{d.name: d.shape for d in self.data_shapes})[1]
+        return list(zip(self._output_names, shapes))
+
+    # -- params -------------------------------------------------------------
+    def get_params(self):
+        if not (self.binded and self.params_initialized):
+            raise MXNetError("bind() and init_params() first")
+        self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def _sync_params_from_devices(self):
+        if self._params_dirty and self._exec_group is not None:
+            self._exec_group.get_params(self._arg_params, self._aux_params)
+            if self._kvstore is not None and self._update_on_kvstore:
+                for name, arr in sorted(self._arg_params.items()):
+                    try:
+                        self._kvstore.pull(name, arr)
+                    except MXNetError:
+                        pass
+            self._params_dirty = False
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("bind() first")
+        if self._arg_params is None:
+            self._arg_params = {
+                name: zeros(arrs[0].shape, dtype=arrs[0].dtype)
+                for name, arrs in zip(self._exec_group.param_names,
+                                      self._exec_group.param_arrays)}
+        if self._aux_params is None:
+            self._aux_params = {
+                name: zeros(arrs[0].shape, dtype=arrs[0].dtype)
+                for name, arrs in zip(self._exec_group.aux_names,
+                                      self._exec_group.aux_arrays)}
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                cache[name].copyto(arr)
+            elif cache is not None and not allow_missing:
+                raise MXNetError("%s not found in provided params" % name)
+            elif initializer is not None:
+                initializer(InitDesc(name, attrs=self.symbol.attr_dict()
+                                     .get(name, {})), arr)
+
+        attrs = {}
+        for name, arr in sorted(self._arg_params.items()):
+            _impl(name, arr, arg_params)
+        for name, arr in sorted(self._aux_params.items()):
+            _impl(name, arr, aux_params)
+        self.params_initialized = True
+        self._params_dirty = False
+        self._exec_group.set_params(self._arg_params, self._aux_params,
+                                    allow_extra=allow_extra)
+
+    # -- bind ---------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if force_rebind:
+            self._exec_group = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+        shared_group = None
+        if shared_module is not None:
+            if not (shared_module.binded and
+                    shared_module.params_initialized):
+                raise MXNetError("shared_module must be bound+initialized")
+            shared_group = shared_module._exec_group
+
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol, self._context, self._work_load_list, data_shapes,
+            label_shapes if for_training else (label_shapes or None),
+            self._param_names, for_training, inputs_need_grad, shared_group,
+            logger=self.logger, fixed_param_names=self._fixed_param_names,
+            grad_req=grad_req)
+        if shared_module is not None and shared_module.params_initialized:
+            self._arg_params = shared_module._arg_params
+            self._aux_params = shared_module._aux_params
+            self.params_initialized = True
+        elif self.params_initialized:
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+
+    # -- optimizer ----------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if not (self.binded and self.params_initialized):
+            raise MXNetError("bind() and init_params() first")
+        if self.optimizer_initialized and not force_init:
+            return
+        if self._params_dirty:
+            self._sync_params_from_devices()
+
+        kvstore, update_on_kvstore = _create_kvstore(
+            kvstore, len(self._context), self._arg_params)
+        batch_size = self._exec_group.batch_size
+        if kvstore and "dist" in kvstore.type and \
+                "_sync" in kvstore.type:
+            batch_size *= kvstore.num_workers
+        rescale_grad = 1.0 / batch_size
+
+        idx2name = {}
+        if update_on_kvstore:
+            idx2name.update(enumerate(self._exec_group.param_names))
+        else:
+            for k in range(len(self._context)):
+                idx2name.update(
+                    {i * len(self._context) + k: n
+                     for i, n in enumerate(self._exec_group.param_names)})
+
+        if isinstance(optimizer, str):
+            optimizer_params = dict(optimizer_params) if not \
+                isinstance(optimizer_params, dict) else dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = rescale_grad
+            optimizer = opt_mod.create(optimizer,
+                                       param_idx2name=idx2name,
+                                       sym=self.symbol, **optimizer_params)
+        else:
+            if optimizer.rescale_grad != rescale_grad:
+                self.logger.warning(
+                    "Optimizer created manually outside Module but "
+                    "rescale_grad != 1.0/batch_size (%s vs %s)",
+                    optimizer.rescale_grad, rescale_grad)
+            optimizer.idx2name = idx2name.copy()
+
+        self._optimizer = optimizer
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+        if kvstore:
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
+            _initialize_kvstore(kvstore=kvstore,
+                                param_arrays=self._exec_group.param_arrays,
+                                arg_params=self._arg_params,
+                                param_names=self._exec_group.param_names,
+                                update_on_kvstore=update_on_kvstore)
+        if update_on_kvstore:
+            kvstore.set_optimizer(self._optimizer)
+        else:
+            self._updater = opt_mod.get_updater(optimizer)
+        self.optimizer_initialized = True
+        if hasattr(self, "_preload_opt_states"):
+            self.load_optimizer_states(self._preload_opt_states)
+            del self._preload_opt_states
+
+    def borrow_optimizer(self, shared_module):
+        """Share optimizer/kvstore/updater with another Module bound to
+        the same parameters (BucketingModule, reference `module.py:604`)."""
+        if not shared_module.optimizer_initialized:
+            raise MXNetError("shared module has no optimizer")
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
+    # -- execution ----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if not (self.binded and self.params_initialized):
+            raise MXNetError("bind() and init_params() first")
+        # re-bind on shape change (bucketing / last partial batch)
+        curr_shapes = [d.shape for d in self._exec_group.data_shapes]
+        new_shapes = [a.shape for a in data_batch.data]
+        if curr_shapes != new_shapes:
+            new_dshapes = [DataDesc(d.name, s) for d, s in
+                           zip(self._exec_group.data_shapes, new_shapes)]
+            new_lshapes = None
+            if getattr(data_batch, "label", None):
+                new_lshapes = [DataDesc(l.name, a.shape) for l, a in
+                               zip(self._exec_group.label_shapes,
+                                   data_batch.label)]
+            self.reshape(new_dshapes, new_lshapes)
+        self._exec_group.forward(data_batch, is_train)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        # pull the freshest device weights into the host dicts first —
+        # rebinding from stale host params would revert optimizer updates
+        self._sync_params_from_devices()
+        arg_p, aux_p = self._arg_params, self._aux_params
+        self.bind(data_shapes, label_shapes,
+                  for_training=self.for_training,
+                  inputs_need_grad=self.inputs_need_grad, force_rebind=True)
+        if arg_p is not None:
+            self._exec_group.set_params(arg_p, aux_p)
+
+    def backward(self, out_grads=None):
+        if not (self.binded and self.params_initialized):
+            raise MXNetError("bind() and init_params() first")
+        self._exec_group.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply optimizer using accumulated gradients (reference
+        `module.py:644-662`)."""
+        if not (self.binded and self.params_initialized and
+                self.optimizer_initialized):
+            raise MXNetError("init_optimizer() first")
+        self._params_dirty = True
+        if self._update_on_kvstore:
+            _update_params_on_kvstore(self._exec_group.param_arrays,
+                                      self._exec_group.grad_arrays,
+                                      self._kvstore,
+                                      self._exec_group.param_names)
+        else:
+            _update_params(self._exec_group.param_arrays,
+                           self._exec_group.grad_arrays,
+                           updater=self._updater,
+                           num_device=len(self._context),
+                           kvstore=self._kvstore,
+                           param_names=self._exec_group.param_names)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec_group.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self.inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True")
+        return self._exec_group.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._exec_group.update_metric(eval_metric, labels, pre_sliced)
+
+    def install_monitor(self, mon):
+        if not self.binded:
+            raise MXNetError("bind() first")
+        self._exec_group.install_monitor(mon)
+
+    # -- optimizer state ------------------------------------------------------
+    def save_optimizer_states(self, fname):
+        if not self.optimizer_initialized:
+            raise MXNetError("init_optimizer() first")
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if not self.optimizer_initialized:
+            raise MXNetError("init_optimizer() first")
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())

@@ -543,6 +543,20 @@ class NDArray(object):
 def imperative_invoke(op_name: str, *inputs, out=None,
                       _full_outputs: bool = False,
                       **attrs) -> Tuple[NDArray, ...]:
+    from .. import profiler as _prof
+
+    if _prof.is_recording("imperative"):
+        with _prof.span(op_name, "operator"):
+            return _imperative_invoke_impl(op_name, *inputs, out=out,
+                                           _full_outputs=_full_outputs,
+                                           **attrs)
+    return _imperative_invoke_impl(op_name, *inputs, out=out,
+                                   _full_outputs=_full_outputs, **attrs)
+
+
+def _imperative_invoke_impl(op_name: str, *inputs, out=None,
+                            _full_outputs: bool = False,
+                            **attrs) -> Tuple[NDArray, ...]:
     opdef = _reg.get_op(op_name)
 
     # drop None/_Null attrs so they don't pollute the jit cache key
@@ -610,6 +624,10 @@ def imperative_invoke(op_name: str, *inputs, out=None,
     if out is not None:
         outs_list = out if isinstance(out, (list, tuple)) else [out]
         for dst, src in zip(outs_list, results):
+            if dst.stype != "default":
+                raise MXNetError(
+                    "out= with %s storage is not supported for %s"
+                    % (dst.stype, op_name))
             dst._set_jax(src._data)
         return tuple(outs_list)
     return tuple(results)

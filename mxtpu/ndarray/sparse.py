@@ -304,6 +304,9 @@ def dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
                                      np.diff(indptr)))
         cols, vals = lhs._aux[1]._data, lhs._data
         d = rhs._data
+        vec_rhs = d.ndim == 1
+        if vec_rhs:
+            d = d[:, None]
         if not transpose_a:
             # out[m, k] = Σ_nnz vals * rhs[cols]  segment-summed by row
             gathered = jnp.take(d, cols, axis=0) * vals[:, None]
@@ -312,14 +315,21 @@ def dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
             # out[n, k] = Σ_nnz vals * rhs[rows]  scattered by col
             gathered = jnp.take(d, rows, axis=0) * vals[:, None]
             out = jax.ops.segment_sum(gathered, cols, num_segments=n)
+        if vec_rhs:
+            out = out[:, 0]
         return NDArray(out, ctx=rhs.ctx, _committed=True)
     if isinstance(lhs, NDArray) and not isinstance(lhs, BaseSparseNDArray) \
             and isinstance(rhs, CSRNDArray):
         # Dᵃ · Sᵇ = (Sᵇᵀ · Dᵃᵀ)ᵀ, with Dᵃᵀ = D when transpose_a else Dᵀ
-        inner = lhs if transpose_a else NDArray(lhs._data.T, ctx=lhs.ctx,
-                                                _committed=True)
+        vec_lhs = lhs._data.ndim == 1
+        ldata = lhs._data[None, :] if vec_lhs else lhs._data
+        inner = NDArray(ldata if transpose_a and not vec_lhs else ldata.T,
+                        ctx=lhs.ctx, _committed=True)
         out = dot(rhs, inner, transpose_a=not transpose_b)
-        return NDArray(out._data.T, ctx=lhs.ctx, _committed=True)
+        res = out._data.T
+        if vec_lhs:
+            res = res[0]
+        return NDArray(res, ctx=lhs.ctx, _committed=True)
     if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
         l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
         r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs

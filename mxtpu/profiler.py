@@ -1,0 +1,277 @@
+"""Profiler — chrome://tracing dump + aggregate stats.
+
+Reference: `src/profiler/profiler.h:256-304` (mode bitmask
+kSymbolic|kImperative|kAPI|kMemory, DumpProfile), python surface
+`python/mxnet/profiler.py:33-151` (set_config/set_state/pause/resume/
+dump/dumps), aggregate tables `src/profiler/aggregate_stats.cc`, and the
+engine's per-opr `ProfileOperator` wrap (`threaded_engine.h:336-347`).
+
+TPU notes: host-side spans measure dispatch + (for jitted whole-graph
+executors) device execution because the executor blocks on results it
+returns lazily; set MXTPU_PROFILER_SYNC=1 to block after every op for
+accurate per-op device times (the analog of the reference profiling
+`NaiveEngine` mode).  For kernel-level device timing use jax.profiler
+(XPlane) alongside — `start_xplane`/`stop_xplane` wrap it.
+
+Autostart: MXTPU_PROFILER_AUTOSTART=1 (reference
+MXNET_PROFILER_AUTOSTART, `docs/faq/env_var.md:156`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .base import MXNetError
+
+__all__ = ["set_config", "set_state", "state", "pause", "resume", "dump",
+           "dumps", "Domain", "Task", "Frame", "Counter", "Marker",
+           "start_xplane", "stop_xplane"]
+
+_lock = threading.Lock()
+_RUNNING = False
+_PAUSED = False
+_CONFIG = {
+    "filename": "profile.json",
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": True,
+    "aggregate_stats": False,
+    "continuous_dump": False,
+}
+_EVENTS: List[Dict[str, Any]] = []
+_AGG: Dict[str, List[float]] = {}
+_START_TS = time.perf_counter()
+_SYNC = os.environ.get("MXTPU_PROFILER_SYNC", "0") == "1"
+
+
+def _now_us() -> float:
+    return (time.perf_counter() - _START_TS) * 1e6
+
+
+def set_config(**kwargs):
+    """Configure (reference `profiler.py:33` set_config; accepts the
+    reference's kwargs incl. profile_all)."""
+    global _CONFIG
+    if kwargs.pop("profile_all", False):
+        for k in ("profile_symbolic", "profile_imperative",
+                  "profile_memory", "profile_api"):
+            _CONFIG[k] = True
+    for k, v in kwargs.items():
+        if k in _CONFIG:
+            _CONFIG[k] = v
+        elif k in ("profile_process", "aggregate_stats_filename"):
+            pass
+        else:
+            raise MXNetError("unknown profiler config %r" % k)
+
+
+def set_state(state_name: str = "stop"):
+    global _RUNNING, _PAUSED
+    if state_name not in ("run", "stop"):
+        raise MXNetError("state must be 'run' or 'stop'")
+    was = _RUNNING
+    _RUNNING = state_name == "run"
+    _PAUSED = False
+    if was and not _RUNNING and _CONFIG["continuous_dump"]:
+        dump()
+
+
+def state() -> str:
+    return "run" if _RUNNING else "stop"
+
+
+def pause():
+    global _PAUSED
+    _PAUSED = True
+
+
+def resume():
+    global _PAUSED
+    _PAUSED = False
+
+
+def is_recording(kind: str = "imperative") -> bool:
+    return _RUNNING and not _PAUSED and \
+        _CONFIG.get("profile_" + kind, True)
+
+
+def record_span(name: str, cat: str, ts_us: float, dur_us: float,
+                tid: int = 0, args: Optional[Dict] = None):
+    with _lock:
+        _EVENTS.append({"name": name, "cat": cat, "ph": "X",
+                        "ts": ts_us, "dur": dur_us, "pid": 0, "tid": tid,
+                        **({"args": args} if args else {})})
+        _AGG.setdefault(name, []).append(dur_us)
+
+
+def record_counter(name: str, value: float, ts_us: Optional[float] = None):
+    with _lock:
+        _EVENTS.append({"name": name, "ph": "C",
+                        "ts": ts_us if ts_us is not None else _now_us(),
+                        "pid": 0, "args": {name: value}})
+
+
+class _Span(object):
+    """Context manager measuring one span (engine ProfileOperator
+    analog)."""
+
+    __slots__ = ("name", "cat", "t0")
+
+    def __init__(self, name: str, cat: str):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self.t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if _SYNC:
+            try:
+                import jax
+
+                jax.effects_barrier()
+            except Exception:
+                pass
+        record_span(self.name, self.cat, self.t0, _now_us() - self.t0,
+                    tid=threading.get_ident() % 1000)
+        if _CONFIG["profile_memory"]:
+            _sample_memory()
+        return False
+
+
+_mem_counter = [0]
+
+
+def _sample_memory():
+    _mem_counter[0] += 1
+    if _mem_counter[0] % 64:
+        return
+    try:
+        import jax
+
+        nbytes = sum(a.nbytes for a in jax.live_arrays())
+        record_counter("device_mem_bytes", float(nbytes))
+    except Exception:
+        pass
+
+
+def span(name: str, cat: str = "operator") -> _Span:
+    return _Span(name, cat)
+
+
+# -- user-facing objects (reference profiler.py Domain/Task/Frame/...) ----
+
+class Domain(object):
+    def __init__(self, name: str):
+        self.name = name
+
+
+class _Timed(object):
+    def __init__(self, domain: Optional[Domain], name: str):
+        self.name = (domain.name + "::" if domain else "") + name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = _now_us()
+
+    def stop(self):
+        if self._t0 is None:
+            raise MXNetError("stop() before start()")
+        record_span(self.name, type(self).__name__.lower(), self._t0,
+                    _now_us() - self._t0)
+        self._t0 = None
+
+
+class Task(_Timed):
+    def __init__(self, domain: Optional[Domain] = None, name: str = "task"):
+        super().__init__(domain, name)
+
+
+class Frame(_Timed):
+    def __init__(self, domain: Optional[Domain] = None, name: str = "frame"):
+        super().__init__(domain, name)
+
+
+class Counter(object):
+    def __init__(self, domain: Optional[Domain] = None,
+                 name: str = "counter", value: float = 0):
+        self.name = (domain.name + "::" if domain else "") + name
+        self._value = value
+
+    def set_value(self, value):
+        self._value = value
+        record_counter(self.name, float(value))
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+
+class Marker(object):
+    def __init__(self, domain: Optional[Domain] = None, name: str = "marker"):
+        self.name = (domain.name + "::" if domain else "") + name
+
+    def mark(self, scope: str = "process"):
+        with _lock:
+            _EVENTS.append({"name": self.name, "ph": "i", "ts": _now_us(),
+                            "pid": 0, "tid": 0, "s": scope[0]})
+
+
+# -- dumping ---------------------------------------------------------------
+
+def dump(finished: bool = True, profile_process: str = "worker"):
+    """Write accumulated events as chrome://tracing JSON (reference
+    `DumpProfile`, `profiler.cc:166`)."""
+    with _lock:
+        payload = {"traceEvents": list(_EVENTS), "displayTimeUnit": "ms"}
+        if finished:
+            _EVENTS.clear()
+    with open(_CONFIG["filename"], "w") as f:
+        json.dump(payload, f)
+
+
+def dumps(reset: bool = False, format: str = "table") -> str:
+    """Aggregate stats table (reference MXAggregateProfileStatsPrint)."""
+    with _lock:
+        rows = []
+        for name, durs in sorted(_AGG.items()):
+            n = len(durs)
+            total = sum(durs)
+            rows.append((name, n, total, min(durs), max(durs), total / n))
+        if reset:
+            _AGG.clear()
+    if format == "json":
+        return json.dumps([{"name": r[0], "count": r[1], "total_us": r[2],
+                            "min_us": r[3], "max_us": r[4], "avg_us": r[5]}
+                           for r in rows])
+    lines = ["%-48s %8s %12s %12s %12s %12s" %
+             ("Name", "Calls", "Total(us)", "Min(us)", "Max(us)", "Avg(us)")]
+    for r in rows:
+        lines.append("%-48s %8d %12.1f %12.1f %12.1f %12.1f" % r)
+    return "\n".join(lines)
+
+
+# -- XPlane bridge (device-level traces via jax.profiler) ------------------
+
+def start_xplane(logdir: str = "/tmp/mxtpu_xplane"):
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def stop_xplane():
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+if os.environ.get("MXTPU_PROFILER_AUTOSTART",
+                  os.environ.get("MXNET_PROFILER_AUTOSTART", "0")) == "1":
+    set_state("run")

@@ -1,0 +1,111 @@
+"""Network visualization (reference: `python/mxnet/visualization.py`).
+
+`print_summary` walks the symbol graph and prints a layer table with
+output shapes and parameter counts; `plot_network` renders a graphviz
+digraph when graphviz is installed.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .base import MXNetError
+from .symbol.symbol import Symbol, _topo_order
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol: Symbol, shape: Optional[Dict] = None,
+                  line_length: int = 120, positions=(.44, .64, .74, 1.)):
+    """Layer-table summary (reference `visualization.py:print_summary`)."""
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("symbol must be a Symbol")
+    shape_dict = {}
+    if shape is not None:
+        internals = symbol.get_internals()
+        _, out_shapes, _ = internals.infer_shape(**shape)
+        shape_dict = dict(zip(internals.list_outputs(), out_shapes))
+
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    lines = []
+
+    def print_row(vals, pos):
+        line = ""
+        for i, v in enumerate(vals):
+            line += str(v)
+            line = line[:pos[i]]
+            line += " " * (pos[i] - len(line))
+        lines.append(line)
+
+    print_row(fields, positions)
+    lines.append("=" * line_length)
+
+    total_params = 0
+    nodes = _topo_order(symbol._outputs)
+    for node in nodes:
+        if node.is_variable and node.name in ("data",):
+            out_shape = shape.get(node.name) if shape else None
+            print_row([f"{node.name}(null)", out_shape or "", 0, ""],
+                      positions)
+            lines.append("_" * line_length)
+            continue
+        if node.is_variable:
+            continue
+        op_name = node.op.name
+        out_name = "%s_output" % node.name
+        out_shape = shape_dict.get(out_name, "")
+        # params = product of this node's variable-input shapes
+        cur_param = 0
+        pred = []
+        provided = set(shape or ())
+        for inode, _ in node.inputs:
+            if inode.is_variable and inode.name in provided:
+                pred.append(inode.name)
+            elif inode.is_variable and inode.name != "data":
+                vshape = shape_dict.get("%s_output" % inode.name)
+                if vshape is None and shape is not None:
+                    # variable outputs are listed under their own name
+                    vshape = shape_dict.get(inode.name)
+                if vshape:
+                    cur_param += int(np.prod(vshape))
+            elif not inode.is_variable:
+                pred.append(inode.name)
+            elif inode.name == "data":
+                pred.append(inode.name)
+        total_params += cur_param
+        print_row(["%s(%s)" % (node.name, op_name), out_shape, cur_param,
+                   ",".join(pred)], positions)
+        lines.append("_" * line_length)
+    lines.append("Total params: %d" % total_params)
+    lines.append("_" * line_length)
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+def plot_network(symbol: Symbol, title: str = "plot",
+                 save_format: str = "pdf", shape=None, dtype=None,
+                 node_attrs=None, hide_weights: bool = True):
+    """Graphviz digraph of the network (reference
+    `visualization.py:plot_network`); requires the `graphviz` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("plot_network requires graphviz")
+    dot = Digraph(name=title, format=save_format)
+    nodes = _topo_order(symbol._outputs)
+    for node in nodes:
+        if node.is_variable:
+            if not hide_weights or node.name == "data":
+                dot.node(str(id(node)), label=node.name, shape="oval")
+            continue
+        dot.node(str(id(node)), label="%s\n%s" % (node.name, node.op.name),
+                 shape="box")
+        for inode, _ in node.inputs:
+            if inode.is_variable and hide_weights and inode.name != "data":
+                continue
+            dot.edge(str(id(inode)), str(id(node)))
+    return dot

@@ -1,0 +1,207 @@
+"""Module API tests (reference: `tests/python/unittest/test_module.py`,
+`tests/python/train/test_mlp.py`)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, sym
+from mxtpu.io.io import DataBatch, DataDesc, NDArrayIter
+
+
+def _mlp_sym(num_hidden=32, num_classes=4):
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data=data, num_hidden=num_hidden, name="fc1")
+    act1 = sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(data=act1, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(data=fc2, label=sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def _blobs(n=256, d=16, classes=4, seed=0):
+    """Linearly separable blobs."""
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, d) * 3
+    y = rng.randint(0, classes, n)
+    x = centers[y] + rng.randn(n, d).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_module_fit_converges():
+    """`mod.fit` on separable blobs reaches high accuracy (reference
+    `tests/python/train/test_mlp.py` convergence assertion)."""
+    x, y = _blobs()
+    train = NDArrayIter(x, y, batch_size=32, shuffle=True,
+                        label_name="softmax_label")
+    val = NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=10, eval_metric="acc")
+    score = mod.score(val, "acc")[0][1]
+    assert score > 0.95, "accuracy %f too low" % score
+
+
+def test_module_predict_and_outputs():
+    x, y = _blobs(n=64)
+    it = NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (64, 4)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1),
+                               np.ones(64), rtol=1e-5)
+
+
+def test_module_multi_device():
+    """Batch sliced across two contexts; grads aggregated via kvstore."""
+    x, y = _blobs(n=128)
+    train = NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_sym(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1}, num_epoch=5,
+            kvstore="device", eval_metric="acc")
+    score = mod.score(train, "acc")[0][1]
+    assert score > 0.9, score
+
+
+def test_module_checkpoint_roundtrip():
+    x, y = _blobs(n=64)
+    it = NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    ref = mod.predict(it).asnumpy()
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "model")
+        mod.save_checkpoint(prefix, 1)
+        assert os.path.exists(prefix + "-symbol.json")
+        assert os.path.exists(prefix + "-0001.params")
+        mod2 = mx.mod.Module.load(prefix, 1, context=mx.cpu())
+        mod2.bind(data_shapes=it.provide_data,
+                  label_shapes=it.provide_label)
+        got = mod2.predict(it).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_module_input_grads():
+    x, y = _blobs(n=32)
+    it = NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True, inputs_need_grad=True)
+    mod.init_params()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    (dgrad,) = mod.get_input_grads()
+    assert dgrad.shape == (32, 16)
+    assert float(dgrad.abs().sum().asscalar()) > 0
+
+
+def test_bucketing_module():
+    """Variable-length 'sequence sum' model per bucket (reference
+    `tests/python/train/test_bucketing.py` shape)."""
+    def sym_gen(seq_len):
+        data = sym.Variable("data")          # (B, seq_len, 2)
+        pooled = sym.mean(data, axis=1)  # time-pooled: weights are
+        fc = sym.FullyConnected(data=pooled, num_hidden=4,  # bucket-invariant
+                                name="fc")
+        out = sym.SoftmaxOutput(data=fc,
+                                label=sym.Variable("softmax_label"),
+                                name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (4, 16, 2))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    for seq_len in (16, 8, 16, 8):
+        x = rng.randn(4, seq_len, 2).astype(np.float32)
+        y = rng.randint(0, 4, (4,)).astype(np.float32)
+        batch = DataBatch(data=[nd.array(x)], label=[nd.array(y)],
+                          bucket_key=seq_len,
+                          provide_data=[DataDesc("data", (4, seq_len, 2))],
+                          provide_label=[DataDesc("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets) == {8, 16}
+    # parameters are shared between buckets
+    w16 = mod._buckets[16]._exec_group.execs[0].arg_dict["fc_weight"]
+    w8 = mod._buckets[8]._exec_group.execs[0].arg_dict["fc_weight"]
+    assert w16 is w8
+
+
+def test_feedforward_legacy():
+    x, y = _blobs(n=64)
+    it = NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    ff = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=5,
+                              optimizer="sgd")
+    ff.fit(it, optimizer_params={"learning_rate": 0.1})
+    assert ff.score(it) > 0.8
+
+
+def test_reshape_preserves_updates():
+    """Partial-batch reshape must not revert optimizer updates (bug:
+    rebinding from stale host params)."""
+    x, y = _blobs(n=32)
+    it = NDArrayIter(x, y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    w_after = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy()
+    # forward a smaller batch -> triggers reshape
+    small = DataBatch(data=[batch.data[0][:2]], label=[batch.label[0][:2]])
+    mod.forward(small, is_train=False)
+    w_now = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy()
+    np.testing.assert_allclose(w_now, w_after, rtol=1e-6)
+
+
+def test_bucketing_nondefault_bucket_trains():
+    """Gradients on a non-default bucket must update the shared weights
+    (bug: orphaned grad_dict in shared-group binding)."""
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        pooled = sym.mean(data, axis=1)
+        fc = sym.FullyConnected(data=pooled, num_hidden=4, name="fc")
+        return (sym.SoftmaxOutput(data=fc,
+                                  label=sym.Variable("softmax_label"),
+                                  name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (4, 16, 2))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    w_before = mod._buckets[16]._exec_group.execs[0] \
+        .arg_dict["fc_weight"].asnumpy().copy()
+    rng = np.random.RandomState(0)
+    batch = DataBatch(data=[nd.array(rng.randn(4, 8, 2).astype(np.float32))],
+                      label=[nd.array(np.arange(4, dtype=np.float32))],
+                      bucket_key=8,
+                      provide_data=[DataDesc("data", (4, 8, 2))],
+                      provide_label=[DataDesc("softmax_label", (4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    w_after = mod._buckets[16]._exec_group.execs[0] \
+        .arg_dict["fc_weight"].asnumpy()
+    assert np.abs(w_after - w_before).max() > 1e-6, \
+        "non-default bucket update was a no-op"

@@ -1,0 +1,88 @@
+"""Profiler + monitor + visualization tests (reference:
+`tests/python/unittest/test_profiler.py`)."""
+import json
+import os
+import tempfile
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import nd, sym, profiler
+
+
+def test_profiler_chrome_trace_and_aggregate():
+    with tempfile.TemporaryDirectory() as td:
+        fname = os.path.join(td, "profile.json")
+        profiler.set_config(filename=fname, profile_all=True)
+        profiler.set_state("run")
+        a = nd.ones((8, 8))
+        for _ in range(3):
+            b = nd.dot(a, a)
+        b.wait_to_read()
+        profiler.set_state("stop")
+        profiler.dump()
+        with open(fname) as f:
+            trace = json.load(f)
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert "dot" in names
+        table = profiler.dumps(reset=True)
+        assert "dot" in table and "Calls" in table
+
+
+def test_profiler_pause_resume():
+    profiler.set_config(profile_all=True)
+    profiler.set_state("run")
+    profiler.pause()
+    x = nd.ones((4,)) * 2
+    x.wait_to_read()
+    profiler.resume()
+    y = nd.ones((4,)).exp()
+    y.wait_to_read()
+    profiler.set_state("stop")
+    table = profiler.dumps(reset=True)
+    assert "exp" in table
+    assert "_mul_scalar" not in table
+
+
+def test_profiler_task_counter_marker():
+    profiler.set_state("run")
+    d = profiler.Domain("unit")
+    t = profiler.Task(d, "work")
+    t.start()
+    t.stop()
+    c = profiler.Counter(d, "ctr", 0)
+    c.increment(5)
+    m = profiler.Marker(d, "mark")
+    m.mark()
+    profiler.set_state("stop")
+    assert "unit::work" in profiler.dumps(reset=True)
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    act = sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(data=act, num_hidden=3, name="fc2")
+    return sym.SoftmaxOutput(data=fc2, label=sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def test_monitor_collects_stats():
+    from mxtpu.monitor import Monitor
+
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 10), softmax_label=(4,))
+    mon = Monitor(interval=1)
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=False, data=mx.nd.ones((4, 10)))
+    res = mon.toc()
+    assert res and any("softmax_output" in k for _, k, _v in res)
+
+
+def test_print_summary():
+    out = mx.visualization.print_summary(
+        _mlp(), shape={"data": (4, 10), "softmax_label": (4,)})
+    assert "fc1" in out and "Total params" in out
+    # 10*8+8 + 8*3+3 = 115
+    assert "115" in out

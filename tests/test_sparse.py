@@ -143,3 +143,18 @@ def test_sparse_zeros():
     zr = sparse.zeros("row_sparse", (4, 5))
     assert zr.stype == "row_sparse"
     assert np.all(zr.asnumpy() == 0)
+
+
+def test_sparse_dot_vector():
+    """csr . 1-D vector and 1-D vector . csr (review regression)."""
+    a = np.array([[1.0, 0.0], [0.0, 2.0]], np.float32)
+    v = np.array([3.0, 4.0], np.float32)
+    csr = sparse.csr_matrix(mx.nd.array(a))
+    out = sparse.dot(csr, mx.nd.array(v))
+    assert out.shape == (2,)
+    np.testing.assert_allclose(out.asnumpy(), a @ v)
+    out2 = sparse.dot(mx.nd.array(v), csr)
+    assert out2.shape == (2,)
+    np.testing.assert_allclose(out2.asnumpy(), v @ a)
+    out3 = sparse.dot(csr, mx.nd.array(v), transpose_a=True)
+    np.testing.assert_allclose(out3.asnumpy(), a.T @ v)

@@ -72,7 +72,9 @@ def main(argv=None):
     rc = 0
     try:
         for w in workers:
-            rc |= w.wait()
+            code = w.wait()
+            if code != 0 and rc == 0:
+                rc = code if 0 < code < 256 else 1
     finally:
         for p in procs:
             if p.poll() is None:
