@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Gluon image-classification training (hybridized model zoo).
+
+The analog of the reference's `example/gluon/image_classification.py`
+(BASELINE.json config #2): a model-zoo network, `hybridize()` compiles
+the whole forward+backward to one XLA module, `Trainer` aggregates
+through the kvstore.  `--dataset dummy` runs on synthetic data (the
+reference's benchmark mode).
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.gluon.model_zoo import vision
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--dataset", default="dummy", choices=["dummy"])
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--iters-per-epoch", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--no-hybridize", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+
+    net = getattr(vision, args.model)(classes=args.classes)
+    net.initialize(ctx=ctx)
+    if not args.no_hybridize:
+        net.hybridize()
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+
+    rng = np.random.RandomState(0)
+    for epoch in range(args.epochs):
+        metric = mx.metric.Accuracy()
+        tic = time.time()
+        n_img = 0
+        for it in range(args.iters_per_epoch):
+            x = mx.nd.array(rng.rand(args.batch_size, *image_shape)
+                            .astype(np.float32), ctx=ctx)
+            y = mx.nd.array(rng.randint(0, args.classes, args.batch_size)
+                            .astype(np.float32), ctx=ctx)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([y], [out])
+            n_img += args.batch_size
+        mx.nd.waitall()
+        name, acc = metric.get()
+        logging.info("epoch %d: %s=%.4f, %.1f img/s", epoch, name, acc,
+                     n_img / (time.time() - tic))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

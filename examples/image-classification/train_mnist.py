@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Train an MLP or LeNet on MNIST with the Module API.
+
+The analog of the reference's `example/image-classification/train_mnist.py`
+(BASELINE.json config #1): `Module.fit` over a symbolic network, kvstore
+selectable (`--kv-store tpu` for the ICI allreduce path).
+
+With --dummy (or when no MNIST files are present) synthetic data is used
+so the script runs hermetically, like the reference's `--benchmark 1`.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import sym
+from mxtpu.io.io import NDArrayIter
+
+
+def mlp_symbol(num_classes=10):
+    data = sym.Variable("data")
+    data = sym.Flatten(data)
+    fc1 = sym.FullyConnected(data=data, num_hidden=128, name="fc1")
+    act1 = sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(data=act1, num_hidden=64, name="fc2")
+    act2 = sym.Activation(data=fc2, act_type="relu", name="relu2")
+    fc3 = sym.FullyConnected(data=act2, num_hidden=num_classes, name="fc3")
+    return sym.SoftmaxOutput(data=fc3, name="softmax",
+                             label=sym.Variable("softmax_label"))
+
+
+def lenet_symbol(num_classes=10):
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data=data, kernel=(5, 5), num_filter=20,
+                         name="conv1")
+    a1 = sym.Activation(data=c1, act_type="tanh", name="tanh1")
+    p1 = sym.Pooling(data=a1, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                     name="pool1")
+    c2 = sym.Convolution(data=p1, kernel=(5, 5), num_filter=50,
+                         name="conv2")
+    a2 = sym.Activation(data=c2, act_type="tanh", name="tanh2")
+    p2 = sym.Pooling(data=a2, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                     name="pool2")
+    f = sym.Flatten(p2)
+    fc1 = sym.FullyConnected(data=f, num_hidden=500, name="fc1")
+    a3 = sym.Activation(data=fc1, act_type="tanh", name="tanh3")
+    fc2 = sym.FullyConnected(data=a3, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(data=fc2, name="softmax",
+                             label=sym.Variable("softmax_label"))
+
+
+def get_iters(args, image_shape):
+    mnist_dir = args.data_dir
+    have_mnist = mnist_dir and os.path.exists(
+        os.path.join(mnist_dir, "train-images-idx3-ubyte"))
+    if args.dummy or not have_mnist:
+        logging.info("using synthetic data")
+        rng = np.random.RandomState(42)
+        n = args.num_examples
+        x = rng.rand(n, *image_shape).astype(np.float32)
+        y = rng.randint(0, 10, n).astype(np.float32)
+        split = int(n * 0.9)
+        train = NDArrayIter(x[:split], y[:split], args.batch_size,
+                            shuffle=True, label_name="softmax_label")
+        val = NDArrayIter(x[split:], y[split:], args.batch_size,
+                          label_name="softmax_label")
+        return train, val
+    from mxtpu.io.io import MNISTIter
+
+    train = MNISTIter(
+        image=os.path.join(mnist_dir, "train-images-idx3-ubyte"),
+        label=os.path.join(mnist_dir, "train-labels-idx1-ubyte"),
+        batch_size=args.batch_size, shuffle=True, flat=args.network == "mlp")
+    val = MNISTIter(
+        image=os.path.join(mnist_dir, "t10k-images-idx3-ubyte"),
+        label=os.path.join(mnist_dir, "t10k-labels-idx1-ubyte"),
+        batch_size=args.batch_size, flat=args.network == "mlp")
+    return train, val
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", choices=["mlp", "lenet"], default="mlp")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--num-examples", type=int, default=6000)
+    ap.add_argument("--data-dir", default=os.environ.get("MNIST_DIR", ""))
+    ap.add_argument("--dummy", action="store_true")
+    ap.add_argument("--gpus", default="")  # parity flag; contexts below
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    image_shape = (784,) if args.network == "mlp" else (1, 28, 28)
+    net = mlp_symbol() if args.network == "mlp" else lenet_symbol()
+    train, val = get_iters(args, image_shape)
+
+    ctx = [mx.tpu()] if mx.num_tpus() else [mx.cpu()]
+    mod = mx.mod.Module(net, context=ctx)
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            kvstore=args.kv_store, num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    acc = mod.score(val, "acc")[0][1]
+    logging.info("final validation accuracy: %.4f", acc)
+    return 0 if acc > (0.9 if not (args.dummy or not args.data_dir)
+                       else 0.0) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

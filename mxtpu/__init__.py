@@ -52,12 +52,14 @@ from . import kvstore
 from . import kvstore as kv
 from . import kvstore_server
 from . import model
+from . import operator
 from . import callback
 from . import profiler
 from . import monitor
 from . import visualization
 from . import module
 from . import module as mod
+from . import rnn
 from . import gluon
 
 

@@ -364,3 +364,80 @@ def _run_backward(heads, head_grads=None, retain_graph=False, extra_vars=None):
 
 def get_symbol(x):  # pragma: no cover - parity stub
     raise MXNetError("autograd.get_symbol is not supported; use hybridize()")
+
+
+class Function(object):
+    """Custom differentiable function (reference
+    `python/mxnet/autograd.py:365`): subclass with `forward(*inputs)` and
+    `backward(*output_grads)`, both over NDArrays; calling the instance
+    under `record()` tapes a node whose vjp runs your `backward`.
+
+        class Sigmoid(autograd.Function):
+            def forward(self, x):
+                y = 1 / (1 + (-x).exp())
+                self.save_for_backward(y)
+                return y
+            def backward(self, dy):
+                (y,) = self.saved_tensors
+                return dy * y * (1 - y)
+    """
+
+    def __init__(self):
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self.saved_tensors = tensors
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        ret_single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if ret_single else list(outputs)
+        for o in outs:
+            if not isinstance(o, NDArray):
+                raise MXNetError("Function.forward must return NDArrays")
+
+        if is_recording():
+            entries = []
+            tracked = False
+            for x in inputs:
+                ent = getattr(x, "_entry", None)
+                if ent is not None:
+                    entries.append(("node", ent[0], ent[1]))
+                    tracked = True
+                elif getattr(x, "_marked", False):
+                    entries.append(("leaf", x))
+                    tracked = True
+                else:
+                    entries.append(None)
+            if tracked:
+                ctx = outs[0].ctx
+                n_in = len(inputs)
+
+                def vjp_fn(cts):
+                    ct_nd = [NDArray(c, ctx=ctx, _committed=True)
+                             for c in cts]
+                    with pause():
+                        igrads = self.backward(*ct_nd)
+                    if not isinstance(igrads, (list, tuple)):
+                        igrads = [igrads]
+                    if len(igrads) != n_in:
+                        raise MXNetError(
+                            "Function.backward returned %d grads for %d "
+                            "inputs" % (len(igrads), n_in))
+                    return tuple(g._data if isinstance(g, NDArray) else g
+                                 for g in igrads)
+
+                node = TapeNode(type(self).__name__, vjp_fn, entries,
+                                [(o.shape, o._data.dtype) for o in outs])
+                for i, o in enumerate(outs):
+                    o._entry = (node, i)
+        return outputs if not ret_single else outs[0]

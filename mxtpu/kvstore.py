@@ -306,17 +306,26 @@ class KVStoreTPU(KVStoreDevice):
 
         mesh = self._mesh or current_mesh()
         n = len(vals)
+        # shard-assembly below assumes a 1-D mesh (one device per pushed
+        # value); multi-axis meshes fall back to the fused device merge
         if mesh is not None and n > 1 and self._axis in mesh.shape \
-                and mesh.shape[self._axis] == n:
+                and mesh.shape[self._axis] == n \
+                and len(mesh.devices.flat) == n:
             import jax
-            import jax.numpy as jnp
             from jax.sharding import NamedSharding, PartitionSpec
 
             from .parallel import collectives
 
-            stacked = jax.device_put(
-                jnp.stack([v._data for v in vals], axis=0),
-                NamedSharding(mesh, PartitionSpec(self._axis)))
+            # one shard per pushed value, placed on the mesh's dp-axis
+            # devices in order — no host round-trip, replica i's gradient
+            # stays on (or moves device-to-device to) mesh device i
+            sharding = NamedSharding(mesh, PartitionSpec(self._axis))
+            shape0 = vals[0].shape
+            mesh_devs = list(mesh.devices.flat)
+            shards = [jax.device_put(v._data.reshape((1,) + shape0), d)
+                      for v, d in zip(vals, mesh_devs)]
+            stacked = jax.make_array_from_single_device_arrays(
+                (n,) + shape0, sharding, shards)
             merged = collectives.all_reduce(stacked, axis=self._axis,
                                             mesh=mesh)[0]
             if self._compression is not None:

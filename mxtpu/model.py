@@ -38,11 +38,12 @@ def _create_kvstore(kvstore, num_device: int, arg_params):
         else:
             kv = kvs.create(kvstore)
             if kvstore == "local":
-                # auto-switch like the reference: big models update on
-                # the store, small ones per-device
+                # reference heuristic (`model.py:58-99`): models with a
+                # big (>16M-element) param update per-device, not on the
+                # single merge device
                 max_size = max(int(np.prod(p.shape)) for p in
                                arg_params.values()) if arg_params else 0
-                if max_size < 1024 * 1024 * 16:
+                if max_size > 1024 * 1024 * 16:
                     update_on_kvstore = False
     else:
         raise MXNetError("bad kvstore %r" % (kvstore,))

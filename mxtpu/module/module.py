@@ -36,6 +36,7 @@ class Module(BaseModule):
         if isinstance(context, Context):
             context = [context]
         self._context = list(context)
+        self._monitor = None
         self._work_load_list = work_load_list
         self._symbol = symbol
         self._data_names = list(data_names or [])
@@ -299,12 +300,20 @@ class Module(BaseModule):
         # pull the freshest device weights into the host dicts first —
         # rebinding from stale host params would revert optimizer updates
         self._sync_params_from_devices()
+        old_execs = set(map(id, self._exec_group.execs)) \
+            if self._exec_group else set()
         arg_p, aux_p = self._arg_params, self._aux_params
         self.bind(data_shapes, label_shapes,
                   for_training=self.for_training,
                   inputs_need_grad=self.inputs_need_grad, force_rebind=True)
         if arg_p is not None:
             self._exec_group.set_params(arg_p, aux_p)
+        if self._monitor is not None:
+            # drop the discarded executors from the monitor before
+            # installing the new group
+            self._monitor.exes = [e for e in self._monitor.exes
+                                  if id(e) not in old_execs]
+            self._exec_group.install_monitor(self._monitor)
 
     def backward(self, out_grads=None):
         if not (self.binded and self.params_initialized):
@@ -345,6 +354,7 @@ class Module(BaseModule):
     def install_monitor(self, mon):
         if not self.binded:
             raise MXNetError("bind() first")
+        self._monitor = mon
         self._exec_group.install_monitor(mon)
 
     # -- optimizer state ------------------------------------------------------

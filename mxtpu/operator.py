@@ -1,0 +1,117 @@
+"""Custom operator API (reference: `python/mxnet/operator.py`, 1,101 LoC
+CustomOp/CustomOpProp/register; C side `src/operator/custom/custom.cc`).
+
+Define an op in python, use it from nd/sym/gluon — including inside
+hybridized/compiled graphs (the forward/backward run as host callbacks
+via `jax.pure_callback`; see `mxtpu/ops/custom_op.py`).
+
+    @mx.operator.register("sigmoid2")
+    class Sigmoid2Prop(mx.operator.CustomOpProp):
+        def list_arguments(self): return ["data"]
+        def list_outputs(self): return ["output"]
+        def infer_shape(self, in_shape): return in_shape, [in_shape[0]], []
+        def create_operator(self, ctx, shapes, dtypes): return Sigmoid2()
+
+    class Sigmoid2(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], 1/(1+(-in_data[0]).exp()))
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            y = out_data[0]
+            self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+    y = mx.nd.Custom(x, op_type="sigmoid2")
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from .ops.custom_op import PROP_REGISTRY
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+
+class CustomOp(object):
+    """User-defined operator body (reference `operator.py:CustomOp`)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst: NDArray, req: str, src):
+        """Write `src` into `dst` honoring the write request (reference
+        CustomOp.assign)."""
+        if req in ("null", None):
+            return
+        if not isinstance(src, NDArray):
+            from .ndarray.ndarray import array
+
+            src = array(src)
+        if req in ("write", "inplace"):
+            src.copyto(dst)
+        elif req == "add":
+            dst._set_jax(dst._data + src._data)
+        else:
+            raise MXNetError("unknown req %r" % req)
+
+
+class CustomOpProp(object):
+    """Op metadata: arguments/outputs, shape/type inference, operator
+    factory (reference `operator.py:CustomOpProp`)."""
+
+    def __init__(self, need_top_grad: bool = True, **kwargs):
+        self.need_top_grad_ = need_top_grad
+        self._kwargs = kwargs
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        """Default: all outputs shaped like the first input."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def infer_storage_type(self, in_stype):
+        return in_stype, ["default"] * len(self.list_outputs()), []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+def register(reg_name: str):
+    """Class decorator registering a CustomOpProp under `op_type`
+    (reference `operator.py:register` → MXCustomOpRegister)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        PROP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return deco
+
+
+def get_all_registered_operators() -> List[str]:
+    return list(PROP_REGISTRY)

@@ -21,3 +21,4 @@ from . import contrib  # noqa: F401
 from . import image  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import quantization  # noqa: F401
+from . import custom_op  # noqa: F401

@@ -1,0 +1,118 @@
+"""The `Custom` operator — user python ops inside the XLA graph.
+
+Reference: `src/operator/custom/custom.cc:75-281` runs user callbacks on
+a dedicated worker thread inside the engine; `python/mxnet/operator.py`
+defines CustomOp/CustomOpProp.  TPU-native formulation: the user's
+forward/backward run as host callbacks embedded in the compiled graph
+via `jax.pure_callback`, with gradients wired through `jax.custom_vjp` —
+so a custom op works identically in the eager path, inside autograd, and
+inside a whole-graph (Symbol/CachedOp) XLA module.
+
+The CustomOpProp registry lives here so the `Custom` op is available to
+the op registry before the symbol wrappers are generated; the user-facing
+classes are in `mxtpu/operator.py`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from .registry import register
+
+PROP_REGISTRY: Dict[str, type] = {}
+
+
+def _get_prop(attrs: Dict[str, Any]):
+    op_type = attrs.get("op_type")
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    if op_type not in PROP_REGISTRY:
+        raise MXNetError("custom op %r not registered" % op_type)
+    kwargs = {k: v for k, v in attrs.items()
+              if k not in ("op_type", "is_train")}
+    return PROP_REGISTRY[op_type](**{k: str(v) for k, v in kwargs.items()})
+
+
+def _custom_num_outputs(attrs) -> int:
+    return len(_get_prop(attrs).list_outputs())
+
+
+@register("Custom", num_outputs=_custom_num_outputs, train_aware=True)
+def custom(*arrays, **attrs):
+    import jax
+
+    prop = _get_prop(attrs)
+    is_train = bool(attrs.get("is_train", False))
+    n_in = len(prop.list_arguments())
+    n_out = len(prop.list_outputs())
+    if len(arrays) != n_in:
+        raise MXNetError("Custom %r expects %d inputs, got %d"
+                         % (attrs.get("op_type"), n_in, len(arrays)))
+    in_shapes = [tuple(a.shape) for a in arrays]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    in_types = [np.dtype(a.dtype) for a in arrays]
+    try:
+        _, out_types, _ = prop.infer_type(in_types)
+    except NotImplementedError:
+        out_types = [in_types[0] if in_types else np.float32] * n_out
+    out_avals = tuple(jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+                      for s, t in zip(out_shapes, out_types))
+    in_avals = tuple(jax.ShapeDtypeStruct(s, t)
+                     for s, t in zip(in_shapes, in_types))
+
+    # one operator instance per graph node, shared by fwd/bwd callbacks
+    # (the reference creates one CustomOperator per executor node)
+    op = prop.create_operator(None, in_shapes, in_types)
+
+    def host_forward(*np_in):
+        from ..context import cpu
+        from ..ndarray import ndarray as nd_mod
+        from ..ndarray.ndarray import NDArray
+
+        in_nd = [NDArray(np.asarray(x), ctx=cpu()) for x in np_in]
+        out_nd = [nd_mod.zeros(s, dtype=t)
+                  for s, t in zip(out_shapes, out_types)]
+        op.forward(is_train=is_train, req=["write"] * n_out,
+                   in_data=in_nd, out_data=out_nd, aux=[])
+        return tuple(np.asarray(o.asnumpy(), dtype=t)
+                     for o, t in zip(out_nd, out_types))
+
+    def host_backward(*np_args):
+        from ..context import cpu
+        from ..ndarray import ndarray as nd_mod
+        from ..ndarray.ndarray import NDArray
+
+        ograds = np_args[:n_out]
+        np_in = np_args[n_out:n_out + n_in]
+        np_out = np_args[n_out + n_in:]
+        out_grad = [NDArray(np.asarray(g), ctx=cpu()) for g in ograds]
+        in_data = [NDArray(np.asarray(x), ctx=cpu()) for x in np_in]
+        out_data = [NDArray(np.asarray(x), ctx=cpu()) for x in np_out]
+        in_grad = [nd_mod.zeros(s, dtype=t)
+                   for s, t in zip(in_shapes, in_types)]
+        op.backward(req=["write"] * n_in, out_grad=out_grad,
+                    in_data=in_data, out_data=out_data, in_grad=in_grad,
+                    aux=[])
+        return tuple(np.asarray(g.asnumpy(), dtype=t)
+                     for g, t in zip(in_grad, in_types))
+
+    @jax.custom_vjp
+    def run(*xs):
+        return jax.pure_callback(host_forward, out_avals, *xs,
+                                 vmap_method="sequential")
+
+    def fwd(*xs):
+        outs = run(*xs)
+        return outs, (xs, outs)
+
+    def bwd(res, cts):
+        xs, outs = res
+        grads = jax.pure_callback(host_backward, in_avals, *cts, *xs,
+                                  *outs, vmap_method="sequential")
+        return tuple(grads)
+
+    run.defvjp(fwd, bwd)
+    outs = run(*arrays)
+    return outs if n_out > 1 else outs[0]

@@ -101,6 +101,8 @@ def is_recording(kind: str = "imperative") -> bool:
 
 def record_span(name: str, cat: str, ts_us: float, dur_us: float,
                 tid: int = 0, args: Optional[Dict] = None):
+    if not _RUNNING:
+        return
     with _lock:
         _EVENTS.append({"name": name, "cat": cat, "ph": "X",
                         "ts": ts_us, "dur": dur_us, "pid": 0, "tid": tid,
@@ -109,6 +111,8 @@ def record_span(name: str, cat: str, ts_us: float, dur_us: float,
 
 
 def record_counter(name: str, value: float, ts_us: Optional[float] = None):
+    if not _RUNNING:
+        return
     with _lock:
         _EVENTS.append({"name": name, "ph": "C",
                         "ts": ts_us if ts_us is not None else _now_us(),
@@ -219,6 +223,8 @@ class Marker(object):
         self.name = (domain.name + "::" if domain else "") + name
 
     def mark(self, scope: str = "process"):
+        if not _RUNNING:
+            return
         with _lock:
             _EVENTS.append({"name": self.name, "ph": "i", "ts": _now_us(),
                             "pid": 0, "tid": 0, "s": scope[0]})

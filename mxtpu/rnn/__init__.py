@@ -1,0 +1,9 @@
+"""`mx.rnn` — symbolic RNN cell API (reference: `python/mxnet/rnn/`)."""
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, DropoutCell,
+                       BidirectionalCell)
+from .io import BucketSentenceIter
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "DropoutCell",
+           "BidirectionalCell", "BucketSentenceIter"]

@@ -1,0 +1,103 @@
+"""BucketSentenceIter (reference: `python/mxnet/rnn/io.py`).
+
+Buckets variable-length token sequences into fixed-length padded
+batches; each DataBatch carries its `bucket_key` so BucketingModule can
+switch executors (one compiled XLA module per bucket length).
+"""
+from __future__ import annotations
+
+import random as pyrandom
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..io.io import DataBatch, DataDesc, DataIter
+from ..ndarray import ndarray as nd_mod
+
+__all__ = ["BucketSentenceIter"]
+
+
+class BucketSentenceIter(DataIter):
+    def __init__(self, sentences, batch_size, buckets=None,
+                 invalid_label=-1, data_name="data",
+                 label_name="softmax_label", dtype="float32",
+                 layout="NT"):
+        super().__init__(batch_size)
+        if not buckets:
+            lens = np.bincount([len(s) for s in sentences])
+            buckets = [i for i, n in enumerate(lens)
+                       if n >= batch_size]
+        buckets = sorted(buckets)
+        ndiscard = 0
+        self.data: List[List] = [[] for _ in buckets]
+        for sent in sentences:
+            buck = np.searchsorted(buckets, len(sent))
+            if buck == len(buckets):
+                ndiscard += 1
+                continue
+            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
+            buff[:len(sent)] = sent
+            self.data[buck].append(buff)
+        self.data = [np.asarray(x, dtype=dtype) for x in self.data]
+        if ndiscard:
+            import logging
+
+            logging.warning("discarded %d sentences longer than the "
+                            "largest bucket", ndiscard)
+
+        self.batch_size = batch_size
+        self.buckets = buckets
+        self.data_name = data_name
+        self.label_name = label_name
+        self.invalid_label = invalid_label
+        self.layout = layout
+        self.default_bucket_key = max(buckets)
+        self.idx = []
+        for i, buck in enumerate(self.data):
+            self.idx.extend([(i, j) for j in
+                             range(0, len(buck) - batch_size + 1,
+                                   batch_size)])
+        self.curr_idx = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size, self.default_bucket_key))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.default_bucket_key))]
+
+    def reset(self):
+        self.curr_idx = 0
+        pyrandom.shuffle(self.idx)
+        for buck in self.data:
+            np.random.shuffle(buck)
+        # label = input shifted one step left (next-token prediction)
+        self.ndlabel = []
+        self.nddata = []
+        for buck in self.data:
+            label = np.empty_like(buck)
+            label[:, :-1] = buck[:, 1:]
+            label[:, -1] = self.invalid_label
+            self.nddata.append(buck)
+            self.ndlabel.append(label)
+
+    def next(self) -> DataBatch:
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        i, j = self.idx[self.curr_idx]
+        self.curr_idx += 1
+        data = self.nddata[i][j:j + self.batch_size]
+        label = self.ndlabel[i][j:j + self.batch_size]
+        L = self.buckets[i]
+        return DataBatch(data=[nd_mod.array(data)],
+                         label=[nd_mod.array(label)],
+                         bucket_key=L,
+                         provide_data=[DataDesc(self.data_name,
+                                                (self.batch_size, L))],
+                         provide_label=[DataDesc(self.label_name,
+                                                 (self.batch_size, L))])

@@ -59,6 +59,12 @@ def _make_symbol_function(opdef):
             inputs = sym_args
         else:
             input_names = meta.input_names(attrs)
+            # the reference accepts `data=` for any op's first input
+            # (FListInputNames defaults to "data"); honor that here
+            if "data" in sym_kwargs and input_names \
+                    and "data" not in input_names and not sym_args \
+                    and input_names[0] not in sym_kwargs:
+                sym_kwargs[input_names[0]] = sym_kwargs.pop("data")
             inputs = []
             for i, in_name in enumerate(input_names):
                 if i < len(sym_args):

@@ -1,0 +1,128 @@
+"""Custom op + autograd.Function tests (reference:
+`tests/python/unittest/test_operator.py::test_custom_op`,
+`test_autograd.py` Function tests)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, nd, sym
+
+
+@mx.operator.register("sq2")
+class Square2Prop(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Square2()
+
+
+class Square2(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+
+
+def test_custom_op_forward():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = nd.Custom(x, op_type="sq2")
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() ** 2)
+
+
+def test_custom_op_backward():
+    x = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="sq2")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_custom_op_in_symbol_executor():
+    """Custom op inside a whole-graph compiled executor (host callback
+    embedded in the XLA module)."""
+    data = sym.Variable("data")
+    out = sym.Custom(data, op_type="sq2", name="sq")
+    ex = out.simple_bind(ctx=mx.cpu(), grad_req="write", data=(2, 2))
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    (y,) = ex.forward(is_train=False, data=mx.nd.array(x))
+    np.testing.assert_allclose(y.asnumpy(), x ** 2)
+
+
+def test_custom_op_multi_output():
+    @mx.operator.register("split2")
+    class Split2Prop(mx.operator.CustomOpProp):
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["a", "b"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0], in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Split2()
+
+    class Split2(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], in_data[0] * 2)
+            self.assign(out_data[1], req[1], in_data[0] * 3)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0],
+                        out_grad[0] * 2 + out_grad[1] * 3)
+
+    x = nd.ones((2, 2))
+    a, b = nd.Custom(x, op_type="split2")
+    np.testing.assert_allclose(a.asnumpy(), 2 * np.ones((2, 2)))
+    np.testing.assert_allclose(b.asnumpy(), 3 * np.ones((2, 2)))
+
+
+def test_autograd_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1 / (1 + (-x).exp())
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array(np.array([0.0, 1.0, -1.0], np.float32))
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), s, rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-6)
+
+
+def test_autograd_function_chained():
+    class Double(autograd.Function):
+        def forward(self, x):
+            return x * 2
+
+        def backward(self, dy):
+            return dy * 2
+
+    x = nd.ones((3,))
+    x.attach_grad()
+    with autograd.record():
+        y = Double()(x)      # custom
+        z = (y * y).sum()    # regular taped ops downstream
+    z.backward()
+    # z = 4x^2 -> dz/dx = 8x
+    np.testing.assert_allclose(x.grad.asnumpy(), 8 * np.ones(3), rtol=1e-6)
