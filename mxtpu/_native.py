@@ -1,0 +1,128 @@
+"""ctypes bindings for the native runtime (src/ → libmxtpu_runtime.so).
+
+The analog of the reference's ctypes library load (`python/mxnet/base.py`
+_load_lib → libmxnet.so).  The library is optional: `available()` is
+False when it hasn't been built (`make -C src`), and every consumer
+falls back to its pure-python path.  Search order: $MXTPU_NATIVE_LIB,
+then src/build/libmxtpu_runtime.so next to the package.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+AsyncFnType = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+ProducerFnType = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_void_p,
+    ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+    ctypes.POINTER(ctypes.c_uint64))
+
+
+def _lib_path() -> str:
+    env = os.environ.get("MXTPU_NATIVE_LIB")
+    if env:
+        return env
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(here, "src", "build", "libmxtpu_runtime.so")
+
+
+def build(quiet: bool = True) -> bool:
+    """Build the native library in place (`make -C src`)."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(here, "src")
+    if not os.path.exists(os.path.join(src, "Makefile")):
+        return False
+    res = subprocess.run(["make", "-C", src],
+                         capture_output=quiet, text=True)
+    global _TRIED
+    _TRIED = False  # allow re-probe
+    return res.returncode == 0
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _lib_path()
+    if not os.path.exists(path):
+        return None
+    lib = ctypes.CDLL(path)
+    # signatures
+    lib.MXTPUGetLastError.restype = ctypes.c_char_p
+    lib.MXTPUEngineCreate.restype = ctypes.c_void_p
+    lib.MXTPUEngineCreate.argtypes = [ctypes.c_int]
+    lib.MXTPUEngineFree.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineNewVar.restype = ctypes.c_uint64
+    lib.MXTPUEngineNewVar.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEnginePushAsync.restype = ctypes.c_int
+    lib.MXTPUEnginePushAsync.argtypes = [
+        ctypes.c_void_p, AsyncFnType, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_int]
+    lib.MXTPUEngineWaitForVar.restype = ctypes.c_int
+    lib.MXTPUEngineWaitForVar.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.MXTPUEngineWaitForAll.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineVarVersion.restype = ctypes.c_uint64
+    lib.MXTPUEngineVarVersion.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.MXTPUEngineNumOutstanding.restype = ctypes.c_int64
+    lib.MXTPUEngineNumOutstanding.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineDeleteVar.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+
+    lib.MXTPUStorageAlloc.restype = ctypes.c_void_p
+    lib.MXTPUStorageAlloc.argtypes = [ctypes.c_size_t]
+    lib.MXTPUStorageFree.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXTPUStorageDirectFree.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXTPUStorageReleaseAll.argtypes = []
+    lib.MXTPUStoragePooledBytes.restype = ctypes.c_size_t
+    lib.MXTPUStorageUsedBytes.restype = ctypes.c_size_t
+
+    lib.MXTPURecordWriterCreate.restype = ctypes.c_void_p
+    lib.MXTPURecordWriterCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTPURecordWriterWrite.restype = ctypes.c_int
+    lib.MXTPURecordWriterWrite.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_char_p,
+                                           ctypes.c_uint64]
+    lib.MXTPURecordWriterTell.restype = ctypes.c_int64
+    lib.MXTPURecordWriterTell.argtypes = [ctypes.c_void_p]
+    lib.MXTPURecordWriterClose.argtypes = [ctypes.c_void_p]
+    lib.MXTPURecordReaderCreate.restype = ctypes.c_void_p
+    lib.MXTPURecordReaderCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTPURecordReaderRead.restype = ctypes.c_int
+    lib.MXTPURecordReaderRead.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.MXTPURecordReaderSeek.restype = ctypes.c_int
+    lib.MXTPURecordReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.MXTPURecordReaderTell.restype = ctypes.c_int64
+    lib.MXTPURecordReaderTell.argtypes = [ctypes.c_void_p]
+    lib.MXTPURecordReaderClose.argtypes = [ctypes.c_void_p]
+    lib.MXTPUBufferFree.argtypes = [ctypes.POINTER(ctypes.c_char)]
+
+    lib.MXTPUPrefetcherCreate.restype = ctypes.c_void_p
+    lib.MXTPUPrefetcherCreate.argtypes = [ProducerFnType, ctypes.c_void_p,
+                                          ctypes.c_int]
+    lib.MXTPUPrefetcherNext.restype = ctypes.c_int
+    lib.MXTPUPrefetcherNext.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.MXTPUPrefetcherFree.argtypes = [ctypes.c_void_p]
+    lib.MXTPURecordPrefetcherCreate.restype = ctypes.c_void_p
+    lib.MXTPURecordPrefetcherCreate.argtypes = [ctypes.c_char_p,
+                                                ctypes.c_int]
+    lib.MXTPURecordPrefetcherFree.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return _LIB
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    return _load()
+
+
+def available() -> bool:
+    return _load() is not None

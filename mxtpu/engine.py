@@ -1,0 +1,224 @@
+"""Dependency engine — python surface over the native scheduler.
+
+Reference: `include/mxnet/engine.h:115` (PushAsync/NewVariable/
+WaitForVar/WaitForAll), `src/engine/threaded_engine_perdevice.cc`
+(default threaded engine), `src/engine/naive_engine.cc` (sync debug
+engine selected by MXNET_ENGINE_TYPE).
+
+On TPU the XLA/PJRT runtime orders device compute, so this engine
+schedules *host-side* work: IO, decode, checkpoint writes, host
+transfers.  Two implementations behind one API, chosen by
+MXTPU_ENGINE_TYPE (reference MXNET_ENGINE_TYPE):
+
+  * ``ThreadedEngine`` — the native C++ versioned-var scheduler
+    (src/engine.cc via ctypes); python callables run on native worker
+    threads (ctypes re-acquires the GIL per call; numpy/jax release it
+    during real work).
+  * ``NaiveEngine``    — synchronous in-process execution for
+    deterministic debugging, like the reference's NaiveEngine.
+
+Python exceptions raised by async fns are captured and re-raised at
+``wait_for_var`` — the reference's async error story
+(`threaded_engine.h:362-372`).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .base import MXNetError
+from . import _native
+
+__all__ = ["Engine", "NaiveEngine", "ThreadedEngine", "get_engine",
+           "set_engine"]
+
+
+class Var(object):
+    __slots__ = ("handle", "_engine")
+
+    def __init__(self, handle, engine):
+        self.handle = handle
+        self._engine = engine
+
+    @property
+    def version(self):
+        return self._engine.var_version(self)
+
+
+class Engine(object):
+    """Abstract engine API."""
+
+    def new_var(self) -> Var:
+        raise NotImplementedError
+
+    def push(self, fn: Callable[[], None], const_vars: Sequence[Var] = (),
+             mutable_vars: Sequence[Var] = (), priority: int = 0):
+        raise NotImplementedError
+
+    def wait_for_var(self, var: Var):
+        raise NotImplementedError
+
+    def wait_for_all(self):
+        raise NotImplementedError
+
+    def var_version(self, var: Var) -> int:
+        raise NotImplementedError
+
+    def delete_var(self, var: Var):
+        pass
+
+
+class NaiveEngine(Engine):
+    """Synchronous engine (reference `naive_engine.cc:50`): push
+    executes immediately; errors raise at the push site but are also
+    recorded for wait_for_var parity."""
+
+    def __init__(self):
+        self._versions: Dict[int, int] = {}
+        self._errors: Dict[int, BaseException] = {}
+        self._next = 1
+
+    def new_var(self) -> Var:
+        v = Var(self._next, self)
+        self._next += 1
+        self._versions[v.handle] = 0
+        return v
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        try:
+            fn()
+        except BaseException as e:
+            for v in mutable_vars:
+                self._errors[v.handle] = e
+            raise
+        finally:
+            for v in mutable_vars:
+                self._versions[v.handle] = \
+                    self._versions.get(v.handle, 0) + 1
+
+    def wait_for_var(self, var: Var):
+        err = self._errors.pop(var.handle, None)
+        if err is not None:
+            raise MXNetError(str(err)) from err
+
+    def wait_for_all(self):
+        pass
+
+    def var_version(self, var: Var) -> int:
+        return self._versions.get(var.handle, 0)
+
+
+class ThreadedEngine(Engine):
+    """Native threaded engine (src/engine.cc)."""
+
+    def __init__(self, num_threads: Optional[int] = None):
+        lib = _native.get_lib()
+        if lib is None:
+            raise MXNetError(
+                "native runtime not built: run `make -C src` (or set "
+                "MXTPU_NATIVE_LIB), or use MXTPU_ENGINE_TYPE=NaiveEngine")
+        if num_threads is None:
+            num_threads = int(os.environ.get(
+                "MXTPU_CPU_WORKER_NTHREADS",
+                os.environ.get("MXNET_CPU_WORKER_NTHREADS", "4")))
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.MXTPUEngineCreate(num_threads))
+        self._cb_lock = threading.Lock()
+        self._callbacks: Dict[int, tuple] = {}  # keep refs until done
+        self._errors: Dict[int, BaseException] = {}  # var handle -> exc
+        self._next_cb = 1
+
+        @_native.AsyncFnType
+        def trampoline(param):
+            key = int(param)
+            with self._cb_lock:
+                fn, mvars = self._callbacks.pop(key)
+            try:
+                fn()
+                return 0
+            except BaseException as e:  # captured, surfaced at wait
+                with self._cb_lock:
+                    for vh in mvars:
+                        self._errors[vh] = e
+                return -1
+
+        self._trampoline = trampoline  # keep alive
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.MXTPUEngineFree(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def new_var(self) -> Var:
+        return Var(self._lib.MXTPUEngineNewVar(self._h), self)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        with self._cb_lock:
+            key = self._next_cb
+            self._next_cb += 1
+            self._callbacks[key] = (fn, [v.handle for v in mutable_vars])
+        cvars = (ctypes.c_uint64 * max(1, len(const_vars)))(
+            *[v.handle for v in const_vars])
+        mvars = (ctypes.c_uint64 * max(1, len(mutable_vars)))(
+            *[v.handle for v in mutable_vars])
+        rc = self._lib.MXTPUEnginePushAsync(
+            self._h, self._trampoline, ctypes.c_void_p(key),
+            cvars, len(const_vars), mvars, len(mutable_vars), priority)
+        if rc != 0:
+            raise MXNetError("PushAsync failed: %s"
+                             % self._lib.MXTPUGetLastError().decode())
+
+    def wait_for_var(self, var: Var):
+        rc = self._lib.MXTPUEngineWaitForVar(self._h, var.handle)
+        with self._cb_lock:
+            err = self._errors.pop(var.handle, None)
+        if rc != 0 or err is not None:
+            raise MXNetError("async op failed: %s"
+                             % (err if err is not None else rc)) \
+                from err
+
+    def wait_for_all(self):
+        self._lib.MXTPUEngineWaitForAll(self._h)
+
+    def var_version(self, var: Var) -> int:
+        return int(self._lib.MXTPUEngineVarVersion(self._h, var.handle))
+
+    def delete_var(self, var: Var):
+        self._lib.MXTPUEngineDeleteVar(self._h, var.handle)
+
+    def num_outstanding(self) -> int:
+        return int(self._lib.MXTPUEngineNumOutstanding(self._h))
+
+
+_engine: Optional[Engine] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> Engine:
+    """Process engine singleton, selected by MXTPU_ENGINE_TYPE
+    (ThreadedEngine default when the native lib is built, else Naive)."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            kind = os.environ.get(
+                "MXTPU_ENGINE_TYPE",
+                os.environ.get("MXNET_ENGINE_TYPE", ""))
+            if kind == "NaiveEngine":
+                _engine = NaiveEngine()
+            elif kind == "ThreadedEngine":
+                _engine = ThreadedEngine()
+            else:
+                _engine = ThreadedEngine() if _native.available() \
+                    else NaiveEngine()
+        return _engine
+
+
+def set_engine(engine: Engine):
+    global _engine
+    with _engine_lock:
+        _engine = engine

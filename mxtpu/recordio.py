@@ -29,7 +29,11 @@ _LEN_MASK = (1 << _CFLAG_BITS) - 1
 
 
 class MXRecordIO(object):
-    """Sequential record reader/writer (reference `recordio.py:37`)."""
+    """Sequential record reader/writer (reference `recordio.py:37`).
+
+    Backed by the native C++ recordio (src/recordio.cc via ctypes) when
+    `make -C src` has been run — like the reference, where record IO is
+    always native; falls back to pure python otherwise."""
 
     def __init__(self, uri, flag):
         self.uri = uri
@@ -39,12 +43,31 @@ class MXRecordIO(object):
         self.open()
 
     def open(self):
-        self._f = open(self.uri, "rb" if self.flag == "r" else "wb")
+        from . import _native
+
+        self._lib = _native.get_lib()
+        self._nat = None
+        self._f = None
+        if self._lib is not None:
+            create = self._lib.MXTPURecordReaderCreate if self.flag == "r" \
+                else self._lib.MXTPURecordWriterCreate
+            self._nat = create(self.uri.encode())
+            if not self._nat and self.flag == "r":
+                raise MXNetError("cannot open %s" % self.uri)
+        if self._nat is None:
+            self._f = open(self.uri, "rb" if self.flag == "r" else "wb")
         self.is_open = True
 
     def close(self):
         if self.is_open:
-            self._f.close()
+            if self._nat is not None:
+                if self.flag == "r":
+                    self._lib.MXTPURecordReaderClose(self._nat)
+                else:
+                    self._lib.MXTPURecordWriterClose(self._nat)
+                self._nat = None
+            else:
+                self._f.close()
             self.is_open = False
 
     def reset(self):
@@ -64,11 +87,29 @@ class MXRecordIO(object):
         self.close()
 
     def tell(self):
+        if self._nat is not None:
+            fn = self._lib.MXTPURecordWriterTell if self.flag == "w" \
+                else self._lib.MXTPURecordReaderTell
+            return int(fn(self._nat))
         return self._f.tell()
+
+    def seek(self, pos):
+        if self.flag != "r":
+            raise MXNetError("seek is read-only")
+        if self._nat is not None:
+            if self._lib.MXTPURecordReaderSeek(self._nat, int(pos)) != 0:
+                raise MXNetError("seek failed")
+        else:
+            self._f.seek(pos)
 
     def write(self, buf: bytes):
         if self.flag != "w":
             raise MXNetError("not opened for writing")
+        if self._nat is not None:
+            if self._lib.MXTPURecordWriterWrite(self._nat, buf,
+                                                len(buf)) != 0:
+                raise MXNetError("native record write failed")
+            return
         length = len(buf)
         header = struct.pack("<II", _MAGIC, length & _LEN_MASK)
         self._f.write(header)
@@ -80,6 +121,18 @@ class MXRecordIO(object):
     def read(self) -> Optional[bytes]:
         if self.flag != "r":
             raise MXNetError("not opened for reading")
+        if self._nat is not None:
+            out = ctypes.POINTER(ctypes.c_char)()
+            length = ctypes.c_uint64()
+            rc = self._lib.MXTPURecordReaderRead(
+                self._nat, ctypes.byref(out), ctypes.byref(length))
+            if rc == 1:
+                return None
+            if rc != 0:
+                raise MXNetError("native record read failed (%d)" % rc)
+            buf = ctypes.string_at(out, length.value)
+            self._lib.MXTPUBufferFree(out)
+            return buf
         header = self._f.read(8)
         if len(header) < 8:
             return None
@@ -122,7 +175,7 @@ class MXIndexedRecordIO(MXRecordIO):
         super().close()
 
     def seek(self, idx):
-        self._f.seek(self.idx[idx])
+        MXRecordIO.seek(self, self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)

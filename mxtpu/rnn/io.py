@@ -39,7 +39,8 @@ class BucketSentenceIter(DataIter):
             buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
             buff[:len(sent)] = sent
             self.data[buck].append(buff)
-        self.data = [np.asarray(x, dtype=dtype) for x in self.data]
+        self.data = [np.asarray(x, dtype=dtype).reshape(-1, blen)
+                     for x, blen in zip(self.data, buckets)]
         if ndiscard:
             import logging
 

@@ -266,6 +266,10 @@ class FusedRNNCell(BaseRNNCell):
                       name="%srnn" % self._prefix)
         if layout == "NTC":
             out = sym.SwapAxis(out, dim1=0, dim2=1)
+        if merge_outputs is False:
+            axis = layout.find("T")
+            out = list(sym.SliceChannel(out, num_outputs=length,
+                                        axis=axis, squeeze_axis=True))
         return out, []
 
 
@@ -346,8 +350,8 @@ class BidirectionalCell(BaseRNNCell):
             begin_state = self.begin_state(batch_size=batch_size)
         nl = len(self._l_cell.state_info)
         l_out, l_states = self._l_cell.unroll(
-            length, inputs, begin_state[:nl], layout="TNC"
-            if False else layout, merge_outputs=False)
+            length, inputs, begin_state[:nl], layout=layout,
+            merge_outputs=False)
         r_out, r_states = self._r_cell.unroll(
             length, list(reversed(inputs)), begin_state[nl:],
             layout=layout, merge_outputs=False)

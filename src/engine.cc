@@ -1,0 +1,334 @@
+/*
+ * Threaded dependency engine.
+ *
+ * Re-design of the reference's ThreadedEngine
+ * (src/engine/threaded_engine.h:269, include/mxnet/engine.h:115): ops
+ * are pushed with read (const) and write (mutable) variable sets; a
+ * per-variable FIFO queue enforces sequential consistency per var
+ * (reads run concurrently, writes exclusively, program order preserved
+ * — the reference's VersionedVarBlock chain); ready ops dispatch to a
+ * priority thread pool.  Errors returned by op bodies are captured on
+ * the op's mutable vars and surfaced at WaitForVar, matching the
+ * reference's async exception propagation (threaded_engine.h:362-372).
+ *
+ * On TPU the XLA/PJRT runtime already orders device compute, so this
+ * engine schedules the *host* side: IO, decode, checkpoint writes,
+ * kvstore transfers — the lanes the reference ran through the same
+ * engine via FnProperty.
+ */
+#include "include/mxtpu_runtime.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+struct OprBlock {
+  MXTPUAsyncFn fn;
+  void* param;
+  std::vector<uint64_t> const_vars;
+  std::vector<uint64_t> mutable_vars;
+  int priority = 0;
+  std::atomic<int> wait{0};
+};
+
+struct PendingEntry {
+  OprBlock* opr;
+  bool is_write;
+};
+
+struct Var {
+  std::deque<PendingEntry> queue;  // ops not yet granted this var
+  int running_reads = 0;
+  bool running_write = false;
+  uint64_t version = 0;
+  int error_code = 0;
+  bool to_delete = false;
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_threads) {
+    if (num_threads <= 0) num_threads = 4;
+    for (int i = 0; i < num_threads; ++i) {
+      workers_.emplace_back([this] { WorkerLoop(); });
+    }
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::unique_lock<std::mutex> lk(pool_mu_);
+      shutdown_ = true;
+      pool_cv_.notify_all();
+    }
+    for (auto& t : workers_) t.join();
+    for (auto& kv : vars_) delete kv.second;
+  }
+
+  uint64_t NewVar() {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint64_t id = next_var_++;
+    vars_[id] = new Var();
+    return id;
+  }
+
+  void DeleteVar(uint64_t var) {
+    // dependency-ordered: deletion happens after all queued ops
+    struct DelCtx { Engine* eng; uint64_t var; };
+    auto* ctx = new DelCtx{this, var};
+    uint64_t v = var;
+    PushAsync(
+        [](void* p) -> int {
+          auto* c = static_cast<DelCtx*>(p);
+          c->eng->ReallyDelete(c->var);
+          delete c;
+          return 0;
+        },
+        ctx, nullptr, 0, &v, 1, 0, /*internal_delete=*/true);
+  }
+
+  int PushAsync(MXTPUAsyncFn fn, void* param, const uint64_t* cvars,
+                int nc, const uint64_t* mvars, int nm, int priority,
+                bool internal_delete = false) {
+    auto* opr = new OprBlock();
+    opr->fn = fn;
+    opr->param = param;
+    opr->priority = priority;
+    opr->const_vars.assign(cvars, cvars + nc);
+    opr->mutable_vars.assign(mvars, mvars + nm);
+    opr->wait.store(nc + nm + 1);  // +1 removed after registration
+
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      outstanding_++;
+      for (uint64_t v : opr->const_vars) {
+        Var* var = FindVar(v);
+        if (!var) { opr->wait.fetch_sub(1); continue; }
+        var->queue.push_back({opr, false});
+        TryGrant(var);
+      }
+      for (uint64_t v : opr->mutable_vars) {
+        Var* var = FindVar(v);
+        if (!var) { opr->wait.fetch_sub(1); continue; }
+        var->queue.push_back({opr, true});
+        TryGrant(var);
+      }
+    }
+    if (opr->wait.fetch_sub(1) == 1) Dispatch(opr);
+    (void)internal_delete;
+    return 0;
+  }
+
+  int WaitForVar(uint64_t var) {
+    // push a read op that signals completion, then wait on it
+    struct SyncCtx {
+      std::mutex mu;
+      std::condition_variable cv;
+      bool done = false;
+    } sync;
+    auto fn = [](void* p) -> int {
+      auto* s = static_cast<SyncCtx*>(p);
+      std::lock_guard<std::mutex> lk(s->mu);
+      s->done = true;
+      s->cv.notify_all();
+      return 0;
+    };
+    PushAsync(fn, &sync, &var, 1, nullptr, 0, /*priority=*/1 << 20);
+    std::unique_lock<std::mutex> lk(sync.mu);
+    sync.cv.wait(lk, [&] { return sync.done; });
+    std::lock_guard<std::mutex> elk(mu_);
+    Var* v = FindVar(var);
+    return v ? v->error_code : 0;
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    all_done_cv_.wait(lk, [&] { return outstanding_ == 0; });
+  }
+
+  uint64_t Version(uint64_t var) {
+    std::lock_guard<std::mutex> lk(mu_);
+    Var* v = FindVar(var);
+    return v ? v->version : 0;
+  }
+
+  int64_t Outstanding() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return outstanding_;
+  }
+
+ private:
+  Var* FindVar(uint64_t id) {
+    auto it = vars_.find(id);
+    return it == vars_.end() ? nullptr : it->second;
+  }
+
+  void ReallyDelete(uint64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = vars_.find(id);
+    if (it != vars_.end()) {
+      it->second->to_delete = true;  // reclaimed on completion sweep
+    }
+  }
+
+  /* grant queued entries at the var's queue head (caller holds mu_) */
+  void TryGrant(Var* var) {
+    while (!var->queue.empty()) {
+      PendingEntry& e = var->queue.front();
+      if (e.is_write) {
+        if (var->running_reads > 0 || var->running_write) break;
+        var->running_write = true;
+      } else {
+        if (var->running_write) break;
+        var->running_reads++;
+      }
+      OprBlock* opr = e.opr;
+      var->queue.pop_front();
+      if (opr->wait.fetch_sub(1) == 1) ready_local_.push_back(opr);
+    }
+  }
+
+  void Dispatch(OprBlock* opr) {
+    std::lock_guard<std::mutex> lk(pool_mu_);
+    pool_.push(opr);
+    pool_cv_.notify_one();
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      OprBlock* opr;
+      {
+        std::unique_lock<std::mutex> lk(pool_mu_);
+        pool_cv_.wait(lk, [&] { return shutdown_ || !pool_.empty(); });
+        if (shutdown_ && pool_.empty()) return;
+        opr = pool_.top();
+        pool_.pop();
+      }
+      int err = opr->fn(opr->param);
+      OnComplete(opr, err);
+    }
+  }
+
+  void OnComplete(OprBlock* opr, int err) {
+    std::vector<OprBlock*> now_ready;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      ready_local_.clear();
+      for (uint64_t vid : opr->const_vars) {
+        Var* var = FindVar(vid);
+        if (!var) continue;
+        var->running_reads--;
+        TryGrant(var);
+      }
+      for (uint64_t vid : opr->mutable_vars) {
+        Var* var = FindVar(vid);
+        if (!var) continue;
+        var->running_write = false;
+        var->version++;
+        if (err != 0) var->error_code = err;
+        TryGrant(var);
+      }
+      now_ready.swap(ready_local_);
+      // reclaim deletion-marked vars with no remaining work
+      for (auto it = vars_.begin(); it != vars_.end();) {
+        Var* v = it->second;
+        if (v->to_delete && v->queue.empty() && v->running_reads == 0 &&
+            !v->running_write) {
+          delete v;
+          it = vars_.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      outstanding_--;
+      if (outstanding_ == 0) all_done_cv_.notify_all();
+    }
+    delete opr;
+    for (OprBlock* o : now_ready) Dispatch(o);
+  }
+
+  struct PriorityLess {
+    bool operator()(const OprBlock* a, const OprBlock* b) const {
+      return a->priority < b->priority;
+    }
+  };
+
+  std::mutex mu_;  // guards vars_/outstanding_/ready_local_
+  std::unordered_map<uint64_t, Var*> vars_;
+  uint64_t next_var_ = 1;
+  int64_t outstanding_ = 0;
+  std::condition_variable_any all_done_cv_;
+  std::vector<OprBlock*> ready_local_;
+
+  std::mutex pool_mu_;
+  std::condition_variable pool_cv_;
+  std::priority_queue<OprBlock*, std::vector<OprBlock*>, PriorityLess> pool_;
+  std::vector<std::thread> workers_;
+  bool shutdown_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTPUGetLastError(void) { return g_last_error.c_str(); }
+
+void* MXTPUEngineCreate(int num_threads) {
+  return new Engine(num_threads);
+}
+
+void MXTPUEngineFree(void* handle) {
+  delete static_cast<Engine*>(handle);
+}
+
+uint64_t MXTPUEngineNewVar(void* handle) {
+  return static_cast<Engine*>(handle)->NewVar();
+}
+
+int MXTPUEnginePushAsync(void* handle, MXTPUAsyncFn fn, void* param,
+                         const uint64_t* const_vars, int n_const,
+                         const uint64_t* mutable_vars, int n_mutable,
+                         int priority) {
+  if (!fn) {
+    set_error("null fn");
+    return -1;
+  }
+  return static_cast<Engine*>(handle)->PushAsync(
+      fn, param, const_vars, n_const, mutable_vars, n_mutable, priority);
+}
+
+int MXTPUEngineWaitForVar(void* handle, uint64_t var) {
+  return static_cast<Engine*>(handle)->WaitForVar(var);
+}
+
+void MXTPUEngineWaitForAll(void* handle) {
+  static_cast<Engine*>(handle)->WaitForAll();
+}
+
+uint64_t MXTPUEngineVarVersion(void* handle, uint64_t var) {
+  return static_cast<Engine*>(handle)->Version(var);
+}
+
+int64_t MXTPUEngineNumOutstanding(void* handle) {
+  return static_cast<Engine*>(handle)->Outstanding();
+}
+
+void MXTPUEngineDeleteVar(void* handle, uint64_t var) {
+  static_cast<Engine*>(handle)->DeleteVar(var);
+}
+
+}  // extern "C"

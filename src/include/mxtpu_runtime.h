@@ -1,0 +1,104 @@
+/*
+ * mxtpu native runtime — C ABI.
+ *
+ * TPU-native re-implementation of the reference's native runtime
+ * components (engine: include/mxnet/engine.h:115, src/engine/
+ * threaded_engine.h; storage: include/mxnet/storage.h:36,
+ * src/storage/pooled_storage_manager.h:52; recordio: dmlc recordio +
+ * src/io/image_recordio.h; prefetcher: dmlc/threadediter.h used by
+ * src/io/iter_prefetcher.h).
+ *
+ * Consumed from python via ctypes (mxtpu/_native.py) — the analog of the
+ * reference's flat C API (include/mxnet/c_api.h).  All functions return
+ * 0 on success and a negative errno-style code on failure unless noted;
+ * MXTPUGetLastError() returns a thread-local message.
+ */
+#ifndef MXTPU_RUNTIME_H_
+#define MXTPU_RUNTIME_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* thread-local error string (reference: MXGetLastError) */
+const char* MXTPUGetLastError(void);
+
+/* ---------------- dependency engine ---------------- */
+
+/* async op body: returns 0 on success, nonzero error code captured on
+ * the op's mutable vars and rethrown at WaitForVar (reference:
+ * threaded_engine.h:362-372 exception capture). */
+typedef int (*MXTPUAsyncFn)(void* param);
+
+void*    MXTPUEngineCreate(int num_threads);
+void     MXTPUEngineFree(void* handle);
+uint64_t MXTPUEngineNewVar(void* handle);
+int      MXTPUEnginePushAsync(void* handle, MXTPUAsyncFn fn, void* param,
+                              const uint64_t* const_vars, int n_const,
+                              const uint64_t* mutable_vars, int n_mutable,
+                              int priority);
+/* blocks until every op touching `var` (pushed before this call) is
+ * done; returns the var's captured error code (0 = none) */
+int      MXTPUEngineWaitForVar(void* handle, uint64_t var);
+void     MXTPUEngineWaitForAll(void* handle);
+uint64_t MXTPUEngineVarVersion(void* handle, uint64_t var);
+int64_t  MXTPUEngineNumOutstanding(void* handle);
+/* var deletion is dependency-ordered, like Engine::DeleteVariable */
+void     MXTPUEngineDeleteVar(void* handle, uint64_t var);
+
+/* ---------------- pooled host storage ---------------- */
+
+/* size-bucketed pooled allocator (reference GPUPooledStorageManager
+ * applied to host memory; buckets = next pow2, large allocs exact) */
+void*  MXTPUStorageAlloc(size_t size);
+void   MXTPUStorageFree(void* ptr, size_t size);      /* return to pool */
+void   MXTPUStorageDirectFree(void* ptr, size_t size);/* bypass pool    */
+void   MXTPUStorageReleaseAll(void);                  /* drop free lists */
+size_t MXTPUStoragePooledBytes(void);                 /* bytes in pool  */
+size_t MXTPUStorageUsedBytes(void);                   /* live allocs    */
+
+/* ---------------- recordio ---------------- */
+
+void*    MXTPURecordWriterCreate(const char* path);
+int      MXTPURecordWriterWrite(void* handle, const char* buf,
+                                uint64_t len);
+int64_t  MXTPURecordWriterTell(void* handle);
+void     MXTPURecordWriterClose(void* handle);
+
+void*    MXTPURecordReaderCreate(const char* path);
+/* returns 0 = record read, 1 = eof, <0 = error; *out must be released
+ * with MXTPUBufferFree */
+int      MXTPURecordReaderRead(void* handle, char** out, uint64_t* len);
+int      MXTPURecordReaderSeek(void* handle, uint64_t pos);
+int64_t  MXTPURecordReaderTell(void* handle);
+void     MXTPURecordReaderClose(void* handle);
+void     MXTPUBufferFree(char* buf);
+
+/* ---------------- threaded prefetcher ---------------- */
+
+/* producer: fills out/len (buffer ownership passes to the prefetcher,
+ * allocated with malloc); returns 0 = produced, 1 = end, <0 = error */
+typedef int (*MXTPUProducerFn)(void* param, char** out, uint64_t* len);
+
+/* generic producer/consumer bounded queue running the producer on a
+ * native thread (dmlc::ThreadedIter analog) */
+void* MXTPUPrefetcherCreate(MXTPUProducerFn producer, void* param,
+                            int capacity);
+/* 0 = item, 1 = end, <0 = producer error */
+int   MXTPUPrefetcherNext(void* handle, char** out, uint64_t* len);
+void  MXTPUPrefetcherFree(void* handle);
+
+/* fully-native record prefetcher: background thread reads records from
+ * a recordio file into the bounded queue (no python in the hot path);
+ * release with MXTPURecordPrefetcherFree (closes the inner reader) */
+void* MXTPURecordPrefetcherCreate(const char* path, int capacity);
+void  MXTPURecordPrefetcherFree(void* handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_RUNTIME_H_ */

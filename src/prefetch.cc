@@ -1,0 +1,165 @@
+/*
+ * Threaded prefetch pipeline.
+ *
+ * Re-design of dmlc::ThreadedIter as used by the reference's IO
+ * prefetcher (src/io/iter_prefetcher.h, dmlc/threadediter.h): a
+ * producer runs on a dedicated native thread filling a bounded queue;
+ * the consumer pops.  Two producers are provided: a generic C-callback
+ * producer (python callbacks via ctypes release/reacquire the GIL, so
+ * decode work overlaps the training step), and a fully-native recordio
+ * producer with no python in the hot path.
+ */
+#include "include/mxtpu_runtime.h"
+
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+
+namespace {
+
+struct Item {
+  char* buf;
+  uint64_t len;
+};
+
+class Prefetcher {
+ public:
+  Prefetcher(MXTPUProducerFn producer, void* param, int capacity)
+      : producer_(producer), param_(param),
+        capacity_(capacity > 0 ? capacity : 4) {
+    thread_ = std::thread([this] { Loop(); });
+  }
+
+  ~Prefetcher() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+      cv_not_full_.notify_all();
+      cv_not_empty_.notify_all();
+    }
+    thread_.join();
+    for (auto& it : queue_) free(it.buf);
+  }
+
+  int Next(char** out, uint64_t* len) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_not_empty_.wait(lk, [&] {
+      return !queue_.empty() || done_ || error_ != 0;
+    });
+    if (!queue_.empty()) {
+      Item it = queue_.front();
+      queue_.pop_front();
+      cv_not_full_.notify_one();
+      *out = it.buf;
+      *len = it.len;
+      return 0;
+    }
+    return error_ != 0 ? error_ : 1;
+  }
+
+ private:
+  void Loop() {
+    for (;;) {
+      char* buf = nullptr;
+      uint64_t len = 0;
+      int rc = producer_(param_, &buf, &len);
+      std::unique_lock<std::mutex> lk(mu_);
+      if (rc == 0) {
+        cv_not_full_.wait(lk, [&] {
+          return static_cast<int>(queue_.size()) < capacity_ || stop_;
+        });
+        if (stop_) {
+          free(buf);
+          return;
+        }
+        queue_.push_back({buf, len});
+        cv_not_empty_.notify_one();
+      } else {
+        if (rc == 1) {
+          done_ = true;
+        } else {
+          error_ = rc;
+        }
+        cv_not_empty_.notify_all();
+        return;
+      }
+      if (stop_) return;
+    }
+  }
+
+  MXTPUProducerFn producer_;
+  void* param_;
+  int capacity_;
+  std::mutex mu_;
+  std::condition_variable cv_not_full_, cv_not_empty_;
+  std::deque<Item> queue_;
+  bool stop_ = false;
+  bool done_ = false;
+  int error_ = 0;
+  std::thread thread_;
+};
+
+/* native recordio producer: param is the reader handle */
+int record_producer(void* param, char** out, uint64_t* len) {
+  return MXTPURecordReaderRead(param, out, len);
+}
+
+}  // namespace
+
+void mxtpu_register_record_reader(void* pf, void* reader);
+
+extern "C" {
+
+void* MXTPUPrefetcherCreate(MXTPUProducerFn producer, void* param,
+                            int capacity) {
+  return new Prefetcher(producer, param, capacity);
+}
+
+int MXTPUPrefetcherNext(void* handle, char** out, uint64_t* len) {
+  return static_cast<Prefetcher*>(handle)->Next(out, len);
+}
+
+void MXTPUPrefetcherFree(void* handle) {
+  delete static_cast<Prefetcher*>(handle);
+}
+
+void* MXTPURecordPrefetcherCreate(const char* path, int capacity) {
+  void* reader = MXTPURecordReaderCreate(path);
+  if (!reader) return nullptr;
+  Prefetcher* pf = new Prefetcher(record_producer, reader, capacity);
+  mxtpu_register_record_reader(pf, reader);
+  return pf;
+}
+
+}  // extern "C"
+
+/* registry tying record readers to their prefetcher for cleanup */
+#include <unordered_map>
+
+namespace {
+std::mutex g_reg_mu;
+std::unordered_map<void*, void*> g_reader_of;
+}  // namespace
+
+void mxtpu_register_record_reader(void* pf, void* reader) {
+  std::lock_guard<std::mutex> lk(g_reg_mu);
+  g_reader_of[pf] = reader;
+}
+
+extern "C" void MXTPURecordPrefetcherFree(void* handle) {
+  void* reader = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_reg_mu);
+    auto it = g_reader_of.find(handle);
+    if (it != g_reader_of.end()) {
+      reader = it->second;
+      g_reader_of.erase(it);
+    }
+  }
+  MXTPUPrefetcherFree(handle);
+  if (reader) MXTPURecordReaderClose(reader);
+}

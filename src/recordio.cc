@@ -1,0 +1,111 @@
+/*
+ * RecordIO reader/writer.
+ *
+ * Wire-compatible with the reference's dmlc recordio format
+ * (src/io/image_recordio.h; python python/mxnet/recordio.py:37-378 and
+ * mxtpu/recordio.py): records framed by magic 0xced7230a, a 32-bit
+ * length word whose upper 3 bits carry the continuation flag, payload,
+ * then padding to 4-byte alignment.  Buffered stdio IO; the reader is
+ * used directly and by the native record prefetcher (prefetch.cc).
+ */
+#include "include/mxtpu_runtime.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Writer {
+  FILE* f;
+};
+
+struct Reader {
+  FILE* f;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* MXTPURecordWriterCreate(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  return new Writer{f};
+}
+
+int MXTPURecordWriterWrite(void* handle, const char* buf, uint64_t len) {
+  auto* w = static_cast<Writer*>(handle);
+  uint32_t header[2] = {kMagic, static_cast<uint32_t>(len & kLenMask)};
+  if (fwrite(header, sizeof(header), 1, w->f) != 1) return -1;
+  if (len && fwrite(buf, 1, len, w->f) != len) return -1;
+  static const char pad_bytes[4] = {0, 0, 0, 0};
+  size_t pad = (4 - (len % 4)) % 4;
+  if (pad && fwrite(pad_bytes, 1, pad, w->f) != pad) return -1;
+  return 0;
+}
+
+int64_t MXTPURecordWriterTell(void* handle) {
+  return ftell(static_cast<Writer*>(handle)->f);
+}
+
+void MXTPURecordWriterClose(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  if (w) {
+    fclose(w->f);
+    delete w;
+  }
+}
+
+void* MXTPURecordReaderCreate(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  return new Reader{f};
+}
+
+int MXTPURecordReaderRead(void* handle, char** out, uint64_t* len) {
+  auto* r = static_cast<Reader*>(handle);
+  uint32_t header[2];
+  size_t n = fread(header, sizeof(uint32_t), 2, r->f);
+  if (n == 0) return 1;  // eof
+  if (n != 2 || header[0] != kMagic) return -2;
+  uint64_t length = header[1] & kLenMask;
+  char* buf = static_cast<char*>(malloc(length ? length : 1));
+  if (!buf) return -3;
+  if (length && fread(buf, 1, length, r->f) != length) {
+    free(buf);
+    return -2;
+  }
+  size_t pad = (4 - (length % 4)) % 4;
+  if (pad) {
+    char padbuf[4];
+    if (fread(padbuf, 1, pad, r->f) != pad) { /* trailing eof ok */ }
+  }
+  *out = buf;
+  *len = length;
+  return 0;
+}
+
+int64_t MXTPURecordReaderTell(void* handle) {
+  return ftell(static_cast<Reader*>(handle)->f);
+}
+
+int MXTPURecordReaderSeek(void* handle, uint64_t pos) {
+  return fseek(static_cast<Reader*>(handle)->f,
+               static_cast<long>(pos), SEEK_SET) == 0 ? 0 : -1;
+}
+
+void MXTPURecordReaderClose(void* handle) {
+  auto* r = static_cast<Reader*>(handle);
+  if (r) {
+    fclose(r->f);
+    delete r;
+  }
+}
+
+void MXTPUBufferFree(char* buf) { free(buf); }
+
+}  // extern "C"

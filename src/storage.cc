@@ -1,0 +1,94 @@
+/*
+ * Pooled host storage manager.
+ *
+ * Re-design of the reference's pooled storage
+ * (src/storage/pooled_storage_manager.h:52-128: size-bucketed free
+ * lists, MXNET_GPU_MEM_POOL_* knobs) applied to host memory: on TPU the
+ * device heap (HBM) is owned by PJRT/XLA buffer assignment, so the pool
+ * serves the host side — staging buffers for IO/decode pipelines and
+ * checkpoint serialization.  Allocations are 64-byte aligned (cache
+ * line / DMA friendly); sizes round up to the next power of two below
+ * 4 MiB (bucketed free lists) and are exact above it.
+ */
+#include "include/mxtpu_runtime.h"
+
+#include <atomic>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr size_t kAlign = 64;
+constexpr size_t kBigAlloc = 4u << 20;  // no rounding above this
+
+std::mutex g_mu;
+std::unordered_map<size_t, std::vector<void*>> g_pool;
+size_t g_pool_bytes = 0;
+std::atomic<size_t> g_used_bytes{0};
+
+size_t round_size(size_t size) {
+  if (size == 0) return kAlign;
+  if (size >= kBigAlloc) return (size + kAlign - 1) & ~(kAlign - 1);
+  size_t r = kAlign;
+  while (r < size) r <<= 1;
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* MXTPUStorageAlloc(size_t size) {
+  size_t r = round_size(size);
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_pool.find(r);
+    if (it != g_pool.end() && !it->second.empty()) {
+      void* p = it->second.back();
+      it->second.pop_back();
+      g_pool_bytes -= r;
+      g_used_bytes += r;
+      return p;
+    }
+  }
+  void* p = nullptr;
+  if (posix_memalign(&p, kAlign, r) != 0) return nullptr;
+  g_used_bytes += r;
+  return p;
+}
+
+void MXTPUStorageFree(void* ptr, size_t size) {
+  if (!ptr) return;
+  size_t r = round_size(size);
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_pool[r].push_back(ptr);
+  g_pool_bytes += r;
+  g_used_bytes -= r;
+}
+
+void MXTPUStorageDirectFree(void* ptr, size_t size) {
+  if (!ptr) return;
+  g_used_bytes -= round_size(size);
+  free(ptr);
+}
+
+void MXTPUStorageReleaseAll(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (auto& kv : g_pool) {
+    for (void* p : kv.second) free(p);
+  }
+  g_pool.clear();
+  g_pool_bytes = 0;
+}
+
+size_t MXTPUStoragePooledBytes(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return g_pool_bytes;
+}
+
+size_t MXTPUStorageUsedBytes(void) { return g_used_bytes.load(); }
+
+}  // extern "C"
