@@ -2,67 +2,85 @@
 
 Matches the reference's headline number (BASELINE.md: ResNet-50
 training, bs=32, fp32 — 298.51 img/s on 1xV100,
-`docs/faq/perf.md:208-217`; measured by
+`docs/faq/perf.md:208-217`, measured via the Module path of
 `example/image-classification/train_imagenet.py` with synthetic data).
 
+Same methodology here: the gluon model-zoo ResNet-50 is traced to a
+Symbol, bound through Module/GraphExecutor — forward+backward compile to
+ONE fused XLA module, the optimizer applies as ONE fused whole-tree
+update — and timed over synthetic data.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs: MXTPU_BENCH_BATCH/WARMUP/ITERS (fp32 throughout — the
+apples-to-apples comparison against the fp32 baseline).
 """
 import json
+import os
 import time
 
 BASELINE_TRAIN_IMGS_PER_SEC = 298.51  # 1xV100 fp32 bs=32
-BATCH = 32
-WARMUP = 3
-ITERS = 20
+BATCH = int(os.environ.get("MXTPU_BENCH_BATCH", "32"))
+WARMUP = int(os.environ.get("MXTPU_BENCH_WARMUP", "3"))
+ITERS = int(os.environ.get("MXTPU_BENCH_ITERS", "20"))
 
 
 def main():
     import numpy as np
 
     import mxtpu as mx
-    from mxtpu import autograd
-    from mxtpu.gluon import Trainer
-    from mxtpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxtpu import sym
     from mxtpu.gluon.model_zoo import vision
+    from mxtpu.io.io import DataBatch
 
     ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+
+    # trace the gluon ResNet-50 into a Symbol, add the softmax head
     net = vision.resnet50_v1(classes=1000)
     net.initialize(ctx=ctx)
-    net.hybridize()
+    x_trace = mx.nd.zeros((BATCH, 3, 224, 224), ctx=ctx)
+    out_sym, _, _ = net._trace_symbol(x_trace)
+    softmax = sym.SoftmaxOutput(data=out_sym,
+                                label=sym.Variable("softmax_label"),
+                                name="softmax")
+
+    mod = mx.mod.Module(softmax, data_names=("data0",),
+                        label_names=("softmax_label",), context=ctx)
+    mod.bind(data_shapes=[("data0", (BATCH, 3, 224, 224))],
+             label_shapes=[("softmax_label", (BATCH,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01,
+                                         "momentum": 0.9})
 
     rng = np.random.RandomState(0)
     data = mx.nd.array(rng.rand(BATCH, 3, 224, 224).astype("float32"),
                        ctx=ctx)
     label = mx.nd.array(rng.randint(0, 1000, (BATCH,)).astype("float32"),
                         ctx=ctx)
-    loss_fn = SoftmaxCrossEntropyLoss()
-    trainer = Trainer(net.collect_params(), "sgd",
-                      {"learning_rate": 0.01, "momentum": 0.9})
+    batch = DataBatch(data=[data], label=[label])
 
     def step():
-        with autograd.record():
-            out = net(data)
-            loss = loss_fn(out, label)
-        loss.backward()
-        trainer.step(BATCH)
-        return loss
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
 
     for _ in range(WARMUP):
-        step().wait_to_read()
+        step()
+    mx.nd.waitall()
 
     t0 = time.perf_counter()
     for _ in range(ITERS):
-        loss = step()
-    loss.wait_to_read()
+        step()
+    mx.nd.waitall()
     dt = time.perf_counter() - t0
 
     imgs_per_sec = BATCH * ITERS / dt
     print(json.dumps({
-        "metric": "resnet50_train_imgs_per_sec_bs32",
+        "metric": "resnet50_train_imgs_per_sec_bs%d" % BATCH,
         "value": round(imgs_per_sec, 2),
         "unit": "images/sec",
-        "vs_baseline": round(imgs_per_sec / BASELINE_TRAIN_IMGS_PER_SEC,
-                             4),
+        "vs_baseline": round(imgs_per_sec / BASELINE_TRAIN_IMGS_PER_SEC, 3),
     }))
 
 

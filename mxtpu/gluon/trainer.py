@@ -140,6 +140,7 @@ class Trainer(object):
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        pending: Dict[int, list] = {}
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -158,10 +159,14 @@ class Trainer(object):
             while len(self._updaters) < n_dev:
                 self._updaters.append(
                     opt_mod.get_updater(self._optimizer))
-            for upd, arr, grad in zip(self._updaters,
-                                      param.list_data(),
-                                      param.list_grad()):
-                upd(i, grad, arr)
+            for k, (arr, grad) in enumerate(zip(param.list_data(),
+                                                param.list_grad())):
+                pending.setdefault(k, []).append((i, grad, arr))
+        # apply queued updates, one fused call per device replica
+        # (whole-tree update: a single XLA executable updates every
+        # weight/state — the TPU answer to per-param kernel dispatch)
+        for k, triples in pending.items():
+            self._updaters[k].update_multi(triples)
 
     def save_states(self, fname):
         if not self._kv_initialized:

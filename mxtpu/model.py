@@ -91,8 +91,11 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
         for k, (w, g) in enumerate(zip(arg_list, grad_list)):
             updates[k].append((index * num_device + k, g, w))
     for dev_updates in updates:
-        for idx, g, w in dev_updates:
-            updater(idx, g, w)
+        if hasattr(updater, "update_multi"):
+            updater.update_multi(dev_updates)  # one fused XLA call
+        else:
+            for idx, g, w in dev_updates:
+                updater(idx, g, w)
 
 
 def save_checkpoint(prefix: str, epoch: int, symbol, arg_params,

@@ -720,14 +720,22 @@ def moveaxis(data, source, destination) -> NDArray:
 
 def waitall():
     """Block until all async work completes (reference:
-    `python/mxnet/ndarray/ndarray.py:156` → Engine WaitForAll; here we ask
-    the PJRT client to drain via blocking on a trivial transfer)."""
+    `python/mxnet/ndarray/ndarray.py:156` → Engine WaitForAll).
+
+    The TPU runtime executes programs in enqueue order per device, so a
+    sentinel computation enqueued last completes last — blocking on one
+    sentinel per device drains each device without touching the
+    (possibly thousands of) live arrays individually, which over a
+    remote-tunnel PJRT client costs an RPC apiece."""
     import jax
+    import jax.numpy as jnp
 
     try:
-        (jax.device_put(0.0) + 0).block_until_ready()
-        for d in jax.live_arrays():
-            d.block_until_ready()
+        jax.effects_barrier()
+        devs = {d for arr in jax.live_arrays() for d in arr.devices()}
+        sentinels = [jax.device_put(jnp.zeros(()), d) + 0 for d in devs]
+        for s in sentinels:
+            s.block_until_ready()
     except Exception as e:
         raise MXNetError(str(e)) from e
 

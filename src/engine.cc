@@ -152,7 +152,12 @@ class Engine {
     sync.cv.wait(lk, [&] { return sync.done; });
     std::lock_guard<std::mutex> elk(mu_);
     Var* v = FindVar(var);
-    return v ? v->error_code : 0;
+    if (!v) return 0;
+    // error is rethrown once, like the reference clearing the captured
+    // exception after WaitToRead rethrows it
+    int err = v->error_code;
+    v->error_code = 0;
+    return err;
   }
 
   void WaitForAll() {
