@@ -60,6 +60,7 @@ from . import visualization
 from . import module
 from . import module as mod
 from . import rnn
+from . import image
 from . import gluon
 
 

@@ -1,0 +1,5 @@
+"""`mx.image` — image IO/augmentation (reference: `python/mxnet/image/`)."""
+from .image import *  # noqa: F401,F403
+from .detection import (DetAugmenter, DetHorizontalFlipAug, DetBorrowAug,
+                        DetRandomSelectAug, CreateDetAugmenter,
+                        ImageDetIter)

@@ -1,0 +1,164 @@
+"""Detection image iterator (reference: `python/mxnet/image/detection.py`).
+
+Labels are per-object rows `[class, xmin, ymin, xmax, ymax, ...]` with a
+2-element header (objects start after `label[0]` header words), padded to
+a fixed number of objects per image — the reference's det-recordio
+convention.  Geometric augmenters transform boxes together with pixels.
+"""
+from __future__ import annotations
+
+import random as pyrandom
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..io.io import DataBatch, DataDesc
+from ..ndarray import ndarray as nd_mod
+from . import image as img_mod
+
+__all__ = ["DetAugmenter", "DetHorizontalFlipAug", "DetBorrowAug",
+           "DetRandomSelectAug", "CreateDetAugmenter", "ImageDetIter"]
+
+
+class DetAugmenter(object):
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap a pixel-only augmenter (no geometry change)."""
+
+    def __init__(self, augmenter):
+        super().__init__()
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            arr = img_mod._to_np(src)[:, ::-1].copy()
+            label = label.copy()
+            valid = label[:, 0] >= 0
+            xmin = label[valid, 1].copy()
+            label[valid, 1] = 1.0 - label[valid, 3]
+            label[valid, 3] = 1.0 - xmin
+            return img_mod._to_nd(arr), label
+        return src, label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if pyrandom.random() >= self.skip_prob and self.aug_list:
+            return pyrandom.choice(self.aug_list)(src, label)
+        return src, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_mirror=False, mean=None,
+                       std=None, brightness=0, contrast=0, saturation=0,
+                       inter_method=2, **kwargs):
+    auglist: List[DetAugmenter] = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(img_mod.ResizeAug(resize, inter_method)))
+    auglist.append(DetBorrowAug(img_mod.ForceResizeAug(
+        (data_shape[2], data_shape[1]), inter_method)))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetBorrowAug(img_mod.CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(img_mod.ColorJitterAug(
+            brightness, contrast, saturation)))
+    if mean is not None or std is not None:
+        if mean is True:
+            mean = np.array([123.68, 116.28, 103.53])
+        if std is True:
+            std = np.array([58.395, 57.12, 57.375])
+        auglist.append(DetBorrowAug(img_mod.ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(img_mod.ImageIter):
+    """Detection iterator (reference `detection.py:ImageDetIter`)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", aug_list=None,
+                 imglist=None, data_name="data", label_name="label",
+                 last_batch_handle="pad", **kwargs):
+        aug = aug_list if aug_list is not None else \
+            CreateDetAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_mirror", "mean", "std",
+                         "brightness", "contrast", "saturation",
+                         "inter_method")})
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, aug_list=[], imglist=imglist,
+                         data_name=data_name, label_name=label_name,
+                         **{k: v for k, v in kwargs.items()
+                            if k in ("shuffle", "part_index", "num_parts",
+                                     "path_imgidx", "dtype")})
+        self.det_auglist = aug
+        self.max_objects = int(kwargs.get("max_objects", 13))
+        self.label_shape = (self.max_objects, 5)
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size,) + self.label_shape)]
+
+    def _parse_label(self, label) -> np.ndarray:
+        """Flat det label -> [N,5] object rows (reference
+        `detection.py:_parse_label`)."""
+        raw = np.asarray(label, np.float32).ravel()
+        if raw.size < 7:
+            raise MXNetError("label too short for detection: %d" % raw.size)
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        body = raw[header_width:]
+        n = body.size // obj_width
+        return body[:n * obj_width].reshape(n, obj_width)[:, :5]
+
+    def next(self) -> DataBatch:
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, c, h, w), np.float32)
+        batch_label = np.full((self.batch_size,) + self.label_shape, -1.0,
+                              np.float32)
+        i = 0
+        while i < self.batch_size:
+            try:
+                label, s = self.next_sample()
+            except StopIteration:
+                if i == 0:
+                    raise
+                break
+            img = img_mod.imdecode(s, flag=1 if c == 3 else 0)
+            objs = self._parse_label(label)
+            for aug in self.det_auglist:
+                img, objs = aug(img, objs)
+            arr = img_mod._to_np(img).astype(np.float32)
+            if arr.shape[:2] != (h, w):
+                arr = img_mod._to_np(img_mod.imresize(arr, w, h))
+            batch_data[i] = arr.transpose(2, 0, 1)
+            n = min(len(objs), self.max_objects)
+            batch_label[i, :n] = objs[:n]
+            i += 1
+        return DataBatch(data=[nd_mod.array(batch_data)],
+                        label=[nd_mod.array(batch_label)],
+                        pad=self.batch_size - i,
+                        provide_data=self.provide_data,
+                        provide_label=self.provide_label)

@@ -1,0 +1,574 @@
+"""Image processing + ImageIter (reference: `python/mxnet/image/image.py`).
+
+The reference backs these with OpenCV ops (`_cvimread`/`_cvimresize`...);
+here decode/resize run on host numpy/PIL (IO-side work stays on host —
+the TPU consumes the decoded batch), and tensor-valued augmenters operate
+on NDArrays so they fuse into the device pipeline when applied there.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import random as pyrandom
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import cpu
+from ..io.io import DataBatch, DataDesc, DataIter
+from ..ndarray import ndarray as nd_mod
+from ..ndarray.ndarray import NDArray
+from .. import recordio
+
+__all__ = ["imread", "imdecode", "imresize", "scale_down", "resize_short",
+           "copyMakeBorder", "fixed_crop", "random_crop", "center_crop",
+           "color_normalize", "random_size_crop", "Augmenter",
+           "SequentialAug", "ResizeAug", "ForceResizeAug", "RandomCropAug",
+           "RandomSizedCropAug", "CenterCropAug", "RandomOrderAug",
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "HueJitterAug", "ColorJitterAug", "LightingAug",
+           "ColorNormalizeAug", "RandomGrayAug", "HorizontalFlipAug",
+           "CastAug", "CreateAugmenter", "ImageIter"]
+
+
+def _to_np(src) -> np.ndarray:
+    return src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+
+
+def _to_nd(arr: np.ndarray) -> NDArray:
+    return nd_mod.array(arr, dtype=arr.dtype)
+
+
+def imdecode(buf, to_rgb=1, flag=1, **kwargs) -> NDArray:
+    """Decode an image buffer to HWC uint8 (reference `image.py:143`,
+    backed by `_cvimdecode`)."""
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    try:
+        from PIL import Image
+
+        img = Image.open(_io.BytesIO(buf))
+        img = img.convert("RGB" if flag else "L")
+        arr = np.asarray(img, dtype=np.uint8)
+        if not flag:
+            arr = arr[:, :, None]
+    except ImportError:
+        arr = np.load(_io.BytesIO(buf), allow_pickle=False)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return _to_nd(arr)
+
+
+def imread(filename, flag=1, to_rgb=1, **kwargs) -> NDArray:
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), to_rgb=to_rgb, flag=flag)
+
+
+def imresize(src, w, h, interp=1) -> NDArray:
+    arr = _to_np(src)
+    try:
+        from PIL import Image
+
+        modes = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+                 3: Image.NEAREST, 4: Image.LANCZOS}
+        out = np.asarray(Image.fromarray(arr.astype(np.uint8)
+                                         if arr.ndim == 3 and
+                                         arr.shape[2] == 3 else
+                                         arr.squeeze().astype(np.uint8))
+                         .resize((w, h), modes.get(interp, Image.BILINEAR)))
+        if out.ndim == 2:
+            out = out[:, :, None]
+    except ImportError:
+        hh, ww = arr.shape[:2]
+        ri = (np.arange(h) * hh // h).clip(0, hh - 1)
+        ci = (np.arange(w) * ww // w).clip(0, ww - 1)
+        out = arr[ri][:, ci]
+    return _to_nd(out.astype(arr.dtype))
+
+
+def scale_down(src_size, size):
+    """Scale `size` down to fit in `src_size` keeping aspect (reference
+    `image.py:201`)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2) -> NDArray:
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(arr, new_w, new_h, interp=interp)
+
+
+def copyMakeBorder(src, top, bot, left, right, type=0, values=0) -> NDArray:
+    arr = _to_np(src)
+    out = np.pad(arr, ((top, bot), (left, right), (0, 0)),
+                 mode="constant", constant_values=values)
+    return _to_nd(out)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2) -> NDArray:
+    arr = _to_np(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(arr, size[0], size[1], interp=interp)
+    return _to_nd(arr)
+
+
+def random_crop(src, size, interp=2):
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(arr, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(arr, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src if isinstance(src, NDArray) else _to_nd(_to_np(src))
+    out = src.astype(np.float32) - nd_mod.array(np.asarray(mean,
+                                                           np.float32))
+    if std is not None:
+        out = out / nd_mod.array(np.asarray(std, np.float32))
+    return out
+
+
+def random_size_crop(src, size, area, ratio, interp=2, **kwargs):
+    """Random area+aspect crop (inception-style, reference
+    `image.py:550`)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(arr, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(arr, size, interp)
+
+
+# ---------------------------------------------------------------------------
+# Augmenters (reference `image.py:607-1015`)
+# ---------------------------------------------------------------------------
+
+class Augmenter(object):
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2, **kwargs):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = \
+            size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        src = src if isinstance(src, NDArray) else _to_nd(_to_np(src))
+        return src.astype(np.float32) * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        arr = _to_np(src).astype(np.float32)
+        gray = (arr * self._coef).sum()
+        gray = (3.0 * (1.0 - alpha) / arr.size) * gray
+        return _to_nd(arr * alpha + gray)
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        arr = _to_np(src).astype(np.float32)
+        gray = (arr * self._coef).sum(axis=2, keepdims=True) * (1.0 - alpha)
+        return _to_nd(arr * alpha + gray)
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]], np.float32)
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]], np.float32)
+
+    def __call__(self, src):
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                      np.float32)
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        arr = _to_np(src).astype(np.float32)
+        return _to_nd(np.dot(arr, t))
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (reference `image.py:918`)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        arr = _to_np(src).astype(np.float32) + rgb
+        return _to_nd(arr)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = np.full((3, 3), 1.0 / 3.0, np.float32)
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            arr = _to_np(src).astype(np.float32)
+            return _to_nd(np.dot(arr, self.mat))
+        return src if isinstance(src, NDArray) else _to_nd(_to_np(src))
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return _to_nd(_to_np(src)[:, ::-1].copy())
+        return src if isinstance(src, NDArray) else _to_nd(_to_np(src))
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        src = src if isinstance(src, NDArray) else _to_nd(_to_np(src))
+        return src.astype(self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Standard augmenter pipeline factory (reference `image.py:1017`)."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and len(np.atleast_1d(mean)):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Python-side image iterator over recordio or an image list
+    (reference `image.py:1131`)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        if len(data_shape) != 3 or data_shape[0] not in (1, 3):
+            raise MXNetError("data_shape must be (C,H,W)")
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.dtype = dtype
+        self.data_name = data_name
+        self.label_name = label_name
+
+        self.imgrec = None
+        self.seq: Optional[List] = None
+        self.imglist = {}
+        if path_imgrec:
+            idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + \
+                ".idx"
+            if os.path.exists(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(idx_path,
+                                                         path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.seq = None
+        elif path_imglist or imglist is not None:
+            if path_imglist:
+                with open(path_imglist) as fin:
+                    imglist = []
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        imglist.append([float(parts[0])] +
+                                       [float(x) for x in parts[1:-1]] +
+                                       [parts[-1]])
+                        imglist[-1][0] = int(imglist[-1][0])
+            self.seq = []
+            for entry in imglist:
+                key = int(entry[0]) if len(entry) > 2 or isinstance(
+                    entry[0], (int, float)) else entry[0]
+                label = np.asarray(entry[1:-1] if len(entry) > 2
+                                   else entry[1:2], np.float32)
+                self.imglist[key] = (label, entry[-1])
+                self.seq.append(key)
+        else:
+            raise MXNetError("need path_imgrec, path_imglist or imglist")
+        self.path_root = path_root
+        if num_parts > 1 and self.seq is not None:
+            self.seq = self.seq[part_index::num_parts]
+        self.shuffle = shuffle
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **{k: v for k, v in kwargs.items()
+                                           if k in ("resize", "rand_crop",
+                                                    "rand_resize",
+                                                    "rand_mirror", "mean",
+                                                    "std", "brightness",
+                                                    "contrast", "saturation",
+                                                    "hue", "pca_noise",
+                                                    "rand_gray",
+                                                    "inter_method")})
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size,) +
+                         self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                return header.label, img
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root, fname), "rb") as f:
+                return label, f.read()
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def next(self) -> DataBatch:
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, c, h, w), self.dtype)
+        batch_label = np.zeros((self.batch_size, self.label_width),
+                               np.float32)
+        i = 0
+        while i < self.batch_size:
+            try:
+                label, s = self.next_sample()
+            except StopIteration:
+                if i == 0:
+                    raise
+                break
+            img = imdecode(s, flag=1 if c == 3 else 0)
+            for aug in self.auglist:
+                img = aug(img)
+            arr = _to_np(img).astype(self.dtype)
+            if arr.shape[:2] != (h, w):
+                arr = _to_np(imresize(arr, w, h))
+            batch_data[i] = arr.transpose(2, 0, 1)
+            batch_label[i] = np.atleast_1d(np.asarray(label,
+                                                      np.float32))[
+                :self.label_width]
+            i += 1
+        pad = self.batch_size - i
+        label_out = batch_label[:, 0] if self.label_width == 1 \
+            else batch_label
+        return DataBatch(data=[nd_mod.array(batch_data)],
+                         label=[nd_mod.array(label_out)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -1,0 +1,113 @@
+"""mx.image tests (reference: `tests/python/unittest/test_image.py`)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import image as img
+from mxtpu import recordio
+
+
+def _rand_img(h=32, w=48, seed=0):
+    return (np.random.RandomState(seed).rand(h, w, 3) * 255).astype(np.uint8)
+
+
+def _encode(arr):
+    import io
+
+    try:
+        from PIL import Image
+
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        return buf.getvalue()
+    except ImportError:
+        buf = io.BytesIO()
+        np.save(buf, arr)
+        return buf.getvalue()
+
+
+def test_imdecode_roundtrip():
+    arr = _rand_img()
+    out = img.imdecode(_encode(arr))
+    np.testing.assert_array_equal(out.asnumpy(), arr)
+
+
+def test_resize_and_crops():
+    arr = _rand_img(40, 60)
+    r = img.resize_short(arr, 32)
+    assert min(r.shape[:2]) == 32
+    c, _ = img.center_crop(arr, (24, 24))
+    assert c.shape[:2] == (24, 24)
+    rc, rect = img.random_crop(arr, (16, 16))
+    assert rc.shape[:2] == (16, 16)
+    f = img.fixed_crop(arr, 2, 3, 10, 12)
+    np.testing.assert_array_equal(f.asnumpy(), arr[3:15, 2:12])
+
+
+def test_color_normalize():
+    arr = _rand_img(8, 8)
+    mean = np.array([1.0, 2.0, 3.0], np.float32)
+    std = np.array([2.0, 2.0, 2.0], np.float32)
+    out = img.color_normalize(arr, mean, std)
+    np.testing.assert_allclose(out.asnumpy(),
+                               (arr.astype(np.float32) - mean) / std,
+                               rtol=1e-6)
+
+
+def test_augmenter_pipeline():
+    augs = img.CreateAugmenter((3, 24, 24), resize=26, rand_crop=True,
+                               rand_mirror=True, brightness=0.1,
+                               mean=True, std=True)
+    out = _rand_img(40, 50)
+    for a in augs:
+        out = a(out)
+    arr = out.asnumpy() if hasattr(out, "asnumpy") else out
+    assert arr.shape == (24, 24, 3)
+    assert arr.dtype == np.float32
+
+
+def test_image_iter_from_recordio(tmp_path):
+    frec = str(tmp_path / "imgs.rec")
+    fidx = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    for i in range(10):
+        hdr = recordio.IRHeader(0, float(i % 3), i, 0)
+        w.write_idx(i, recordio.pack(hdr, _encode(_rand_img(seed=i))))
+    w.close()
+
+    it = img.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                       path_imgrec=frec, path_imgidx=fidx, shuffle=True)
+    labels = []
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 24, 24)
+        labels.extend(batch.label[0].asnumpy()[:4 - batch.pad].tolist())
+        n += 1
+    assert n == 3  # 10 images / bs 4 -> 2 full + 1 padded
+    assert sorted(labels) == sorted([i % 3 for i in range(10)])
+
+
+def test_image_det_iter(tmp_path):
+    frec = str(tmp_path / "det.rec")
+    fidx = str(tmp_path / "det.idx")
+    w = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    for i in range(6):
+        # det label: header_width=2, obj_width=5, then one object row
+        label = np.array([2, 5, float(i % 2), 0.1, 0.2, 0.6, 0.7],
+                         np.float32)
+        hdr = recordio.IRHeader(0, label, i, 0)
+        w.write_idx(i, recordio.pack(hdr, _encode(_rand_img(seed=i))))
+    w.close()
+
+    it = img.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                          path_imgrec=frec, path_imgidx=fidx)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3, 24, 24)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (2, 13, 5)
+    assert lab[0, 0, 0] in (0.0, 1.0)  # class id of first object
+    np.testing.assert_allclose(lab[0, 0, 1:], [0.1, 0.2, 0.6, 0.7],
+                               rtol=1e-5)
+    assert np.all(lab[0, 1:, 0] == -1)  # padding rows
