@@ -3,7 +3,14 @@
 This replaces the reference's vendored ps-lite (ZMQ TCP; consumed in
 `src/kvstore/kvstore_dist.h:50,738` via `ps::KVWorker<char>::ZPush/ZPull`
 and `src/kvstore/kvstore_dist_server.h:155`) with a small native TCP
-protocol: length-prefixed pickled messages over persistent sockets.
+protocol: length-prefixed frames of a *restricted* wire format — JSON
+metadata + raw numpy buffers (like ps-lite's fixed binary protocol, no
+arbitrary object deserialization).  ``pickle`` is accepted ONLY for the
+explicitly trusted ``set_optimizer`` command body, and only when the
+socket is loopback-bound or frames are HMAC-authenticated via a shared
+secret (``MXTPU_PS_SECRET``).  Sockets bind to 127.0.0.1 whenever the
+root URI is local; set ``MXTPU_PS_BIND_ALL=1`` to listen on all
+interfaces for true multi-host runs.
 
 Roles mirror the reference (`include/mxnet/kvstore.h:282-326`):
   * scheduler — rendezvous + rank assignment + barrier service
@@ -27,6 +34,9 @@ multi-process local tests (`tools/launch.py`).
 """
 from __future__ import annotations
 
+import hashlib
+import hmac as hmac_mod
+import json
 import os
 import pickle
 import socket
@@ -42,6 +52,8 @@ __all__ = ["Scheduler", "Server", "Worker", "role_from_env",
            "run_scheduler", "run_server"]
 
 _LEN = struct.Struct("!Q")
+_HDR = struct.Struct("!I")
+_DIGEST_SIZE = hashlib.sha256().digest_size
 
 
 def _env(*names, default=None):
@@ -76,12 +88,109 @@ def _bigarray_bound() -> int:
                     "MXNET_KVSTORE_BIGARRAY_BOUND", default="1000000"))
 
 
+def _secret() -> Optional[bytes]:
+    s = _env("MXTPU_PS_SECRET", "DMLC_PS_SECRET")
+    return s.encode() if s else None
+
+
+def _bind_host() -> str:
+    """Loopback by default when the root URI is local (the common
+    single-host / test topology); all interfaces only on request or when
+    the root URI is a real remote host."""
+    if _env("MXTPU_PS_BIND_ALL", "DMLC_PS_BIND_ALL", default="0") == "1":
+        return "0.0.0.0"
+    root = _root_addr()[0]
+    if root in ("127.0.0.1", "localhost", "::1"):
+        return "127.0.0.1"
+    return "0.0.0.0"
+
+
 # ---------------------------------------------------------------------------
-# Framed pickled messages over a socket
+# Wire format: length-prefixed frames of [JSON header | raw numpy buffers],
+# optionally HMAC-SHA256 authenticated.  No pickle on the data path.
 # ---------------------------------------------------------------------------
 
+def _encode(obj) -> bytes:
+    """Restricted serializer: JSON-safe scalars/lists/dicts + tagged
+    tuples, bytes, and numpy arrays (raw buffers appended after the JSON
+    header)."""
+    bufs: List[bytes] = []
+
+    def enc(o):
+        if o is None or isinstance(o, (bool, int, float, str)):
+            return o
+        if isinstance(o, (np.integer, np.floating, np.bool_)):
+            return o.item()
+        if isinstance(o, np.ndarray):
+            a = np.ascontiguousarray(o)
+            # custom dtypes (bfloat16 etc. from ml_dtypes) stringify as
+            # void ('<V2') via .str — their .name roundtrips instead
+            dt = a.dtype.name if a.dtype.kind == "V" else a.dtype.str
+            try:
+                if np.dtype(dt) != a.dtype:
+                    raise TypeError
+            except TypeError:
+                raise TypeError("unsupported array dtype %r" % (a.dtype,))
+            bufs.append(a.tobytes())
+            return {"__nd__": len(bufs) - 1, "dtype": dt,
+                    "shape": list(a.shape)}
+        if isinstance(o, (bytes, bytearray, memoryview)):
+            bufs.append(bytes(o))
+            return {"__bytes__": len(bufs) - 1}
+        if isinstance(o, tuple):
+            return {"__tuple__": [enc(x) for x in o]}
+        if isinstance(o, list):
+            return [enc(x) for x in o]
+        if isinstance(o, dict):
+            out = {}
+            for k, v in o.items():
+                if not isinstance(k, str):
+                    raise TypeError("non-str dict key %r" % (k,))
+                if k.startswith("__") and k.endswith("__"):
+                    raise TypeError("reserved dict key %r" % (k,))
+                out[k] = enc(v)
+            return out
+        raise TypeError("unsupported wire type %s" % type(o).__name__)
+
+    header = json.dumps(
+        {"msg": enc(obj), "bufs": [len(b) for b in bufs]},
+        separators=(",", ":")).encode()
+    return _HDR.pack(len(header)) + header + b"".join(bufs)
+
+
+def _decode(payload: bytes):
+    (hlen,) = _HDR.unpack_from(payload)
+    header = json.loads(payload[_HDR.size:_HDR.size + hlen])
+    bufs: List[bytes] = []
+    off = _HDR.size + hlen
+    for n in header["bufs"]:
+        bufs.append(payload[off:off + n])
+        off += n
+
+    def dec(o):
+        if isinstance(o, dict):
+            if "__nd__" in o:
+                return np.frombuffer(
+                    bufs[o["__nd__"]],
+                    dtype=np.dtype(o["dtype"])).reshape(o["shape"]).copy()
+            if "__bytes__" in o:
+                return bufs[o["__bytes__"]]
+            if "__tuple__" in o:
+                return tuple(dec(x) for x in o["__tuple__"])
+            return {k: dec(v) for k, v in o.items()}
+        if isinstance(o, list):
+            return [dec(x) for x in o]
+        return o
+
+    return dec(header["msg"])
+
+
 def _send_msg(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = _encode(obj)
+    secret = _secret()
+    if secret is not None:
+        mac = hmac_mod.new(secret, payload, hashlib.sha256).digest()
+        payload = mac + payload
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
@@ -97,7 +206,16 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def _recv_msg(sock: socket.socket):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, n))
+    payload = _recv_exact(sock, n)
+    secret = _secret()
+    if secret is not None:
+        if n < _DIGEST_SIZE:
+            raise ConnectionError("frame too short for HMAC")
+        mac, payload = payload[:_DIGEST_SIZE], payload[_DIGEST_SIZE:]
+        want = hmac_mod.new(secret, payload, hashlib.sha256).digest()
+        if not hmac_mod.compare_digest(mac, want):
+            raise ConnectionError("HMAC verification failed")
+    return _decode(payload)
 
 
 class _Client(object):
@@ -144,7 +262,8 @@ class Scheduler(object):
         self._ns = _num_servers()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(("0.0.0.0", port if port is not None else root_port))
+        self._sock.bind((_bind_host(),
+                         port if port is not None else root_port))
         self._sock.listen(128)
         self._port = self._sock.getsockname()[1]
         self._stop = False
@@ -261,7 +380,9 @@ class Server(object):
         self._nw = _num_workers()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(("0.0.0.0", 0))
+        bind_host = _bind_host()
+        self._local_only = bind_host == "127.0.0.1"
+        self._sock.bind((bind_host, 0))
         self._sock.listen(128)
         self._addr = (socket.gethostbyname(socket.gethostname())
                       if _root_addr()[0] not in ("127.0.0.1", "localhost")
@@ -310,8 +431,7 @@ class Server(object):
                 elif op == "pull":
                     _send_msg(conn, self._pull(msg))
                 elif op == "command":
-                    self._command(msg)
-                    _send_msg(conn, {"ok": True})
+                    _send_msg(conn, self._command(msg))
                 elif op == "shutdown":
                     with self._cv:
                         self._shutdown = True
@@ -400,11 +520,19 @@ class Server(object):
     def _command(self, msg):
         head, body = msg["head"], msg["body"]
         if head == "set_optimizer":
+            # the ONLY pickle.loads on the wire, and only when the
+            # transport is trusted: loopback-bound or HMAC-authenticated
+            # (verified in _recv_msg before we ever get here).
+            if not (self._local_only or _secret() is not None):
+                return {"error":
+                        "refusing pickled set_optimizer on a non-loopback "
+                        "socket without MXTPU_PS_SECRET"}
             from . import optimizer as opt_mod
 
             optimizer = pickle.loads(body)
             with self._lock:
                 self._updater = opt_mod.get_updater(optimizer)
+        return {"ok": True}
 
 
 # ---------------------------------------------------------------------------
@@ -497,7 +625,10 @@ class Worker(object):
 
     def send_command(self, head: str, body):
         for s in self._servers:
-            s.request({"op": "command", "head": head, "body": body})
+            rep = s.request({"op": "command", "head": head, "body": body})
+            if rep.get("error"):
+                raise ConnectionError("command %r rejected: %s"
+                                      % (head, rep["error"]))
 
     def close(self):
         try:

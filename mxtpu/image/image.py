@@ -72,14 +72,26 @@ def imresize(src, w, h, interp=1) -> NDArray:
 
         modes = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
                  3: Image.NEAREST, 4: Image.LANCZOS}
-        out = np.asarray(Image.fromarray(arr.astype(np.uint8)
-                                         if arr.ndim == 3 and
-                                         arr.shape[2] == 3 else
-                                         arr.squeeze().astype(np.uint8))
-                         .resize((w, h), modes.get(interp, Image.BILINEAR)))
-        if out.ndim == 2:
-            out = out[:, :, None]
+        mode = modes.get(interp, Image.BILINEAR)
+        if arr.dtype == np.uint8:
+            out = np.asarray(Image.fromarray(
+                arr if arr.ndim == 3 and arr.shape[2] == 3
+                else arr.squeeze()).resize((w, h), mode))
+            if out.ndim == 2:
+                out = out[:, :, None]
+        else:
+            # float (post-augmenter) data can be negative or >255 — a
+            # uint8 round-trip would clip/wrap it.  Resize each channel
+            # in PIL float mode instead.
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+            chans = [np.asarray(Image.fromarray(
+                arr[:, :, c].astype(np.float32), mode="F")
+                .resize((w, h), mode)) for c in range(arr.shape[2])]
+            out = np.stack(chans, axis=2)
     except ImportError:
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
         hh, ww = arr.shape[:2]
         ri = (np.arange(h) * hh // h).clip(0, hh - 1)
         ci = (np.arange(w) * ww // w).clip(0, ww - 1)

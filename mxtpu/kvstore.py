@@ -260,14 +260,23 @@ class KVStore(object):
         pass
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
-        if self._optimizer is None:
-            raise MXNetError("no optimizer set")
+        """Persist the active updater's state buffers (momentum/Adam
+        moments, update counters) — reference `python/mxnet/kvstore.py`
+        saves `self._updater.get_states()`, not the optimizer object."""
+        if self._updater is None:
+            raise MXNetError(
+                "load/save optimizer states is only supported when an "
+                "updater is set (update_on_kvstore)")
         with open(fname, "wb") as f:
-            f.write(pickle.dumps(self._optimizer))
+            f.write(self._updater.get_states(dump_optimizer=dump_optimizer))
 
     def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError(
+                "load/save optimizer states is only supported when an "
+                "updater is set (update_on_kvstore)")
         with open(fname, "rb") as f:
-            self._optimizer = pickle.loads(f.read())
+            self._updater.set_states(f.read())
 
     def close(self):
         pass

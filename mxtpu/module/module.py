@@ -285,14 +285,31 @@ class Module(BaseModule):
         # re-bind on shape change (bucketing / last partial batch)
         curr_shapes = [d.shape for d in self._exec_group.data_shapes]
         new_shapes = [a.shape for a in data_batch.data]
-        if curr_shapes != new_shapes:
+        has_label = bool(getattr(data_batch, "label", None))
+        # a labeled batch arriving while the bound exec group has no
+        # label slots (e.g. after an unlabeled-batch rebind) must force
+        # a rebind, or labels would silently never be copied in
+        needs_label_rebind = (has_label and self.for_training
+                              and not self._exec_group.label_shapes)
+        if curr_shapes != new_shapes or needs_label_rebind:
             new_dshapes = [DataDesc(d.name, s) for d, s in
                            zip(self._exec_group.data_shapes, new_shapes)]
             new_lshapes = None
-            if getattr(data_batch, "label", None):
-                new_lshapes = [DataDesc(l.name, a.shape) for l, a in
-                               zip(self._exec_group.label_shapes,
-                                   data_batch.label)]
+            if has_label:
+                if self._exec_group.label_shapes:
+                    new_lshapes = [DataDesc(l.name, a.shape) for l, a in
+                                   zip(self._exec_group.label_shapes,
+                                       data_batch.label)]
+                else:
+                    new_lshapes = [DataDesc(n, a.shape) for n, a in
+                                   zip(self._label_names, data_batch.label)]
+            elif self.for_training and self._exec_group.label_shapes:
+                # unlabeled batch on a training module: keep the label
+                # slots, scaled to the new batch size, so a later
+                # labeled batch of this shape trains against fresh labels
+                bs = new_shapes[0][0]
+                new_lshapes = [DataDesc(l.name, (bs,) + tuple(l.shape[1:]))
+                               for l in self._exec_group.label_shapes]
             self.reshape(new_dshapes, new_lshapes)
         self._exec_group.forward(data_batch, is_train)
 

@@ -513,41 +513,60 @@ def make_forward(cfg: TransformerConfig, mesh):
 # driver entry
 
 
+def _dryrun_axis_configs(n_devices: int):
+    """Axis-assignment rotation for `dryrun`: between them the configs
+    exercise EVERY parallel axis (dp, pp, tp, sp, ep) at >=2 when the
+    device count allows, instead of a single greedy split that leaves
+    ep at 1."""
+    def greedy(order, n):
+        remaining = n
+        out = {AXIS_DP: 1, AXIS_PP: 1, AXIS_TP: 1, AXIS_SP: 1, AXIS_EP: 1}
+        for ax in order:
+            if remaining % 2 == 0 and remaining >= 2:
+                out[ax] = 2
+                remaining //= 2
+        out[AXIS_DP] *= remaining
+        return out
+
+    if n_devices == 1:
+        return [greedy((), 1)]
+    # config A: pipeline/tensor/sequence focus; config B: expert focus
+    cfgs = [greedy((AXIS_PP, AXIS_TP, AXIS_SP), n_devices),
+            greedy((AXIS_EP, AXIS_TP, AXIS_PP), n_devices)]
+    if cfgs[1] == cfgs[0]:   # odd device counts: both collapse to pure dp
+        cfgs.pop()
+    return cfgs
+
+
 def dryrun(n_devices: int, devices=None) -> None:
-    """Compile + run ONE sharded train step on tiny shapes over an
-    n_devices mesh exercising every parallel axis that fits.  Used by
+    """Compile + run ONE sharded train step on tiny shapes per axis
+    config, rotating so every parallel axis (incl. ep) is exercised at
+    >=2 where the device count allows.  Used by
     __graft_entry__.dryrun_multichip."""
     import numpy as np
     import jax
 
-    # greedy axis assignment: pp, tp, sp (each 2 if it fits), dp rest
-    remaining = n_devices
-    def take(k):
-        nonlocal remaining
-        if remaining % k == 0 and remaining >= k and k > 1:
-            remaining //= k
-            return k
-        return 1
-    pp = take(2)
-    tp = take(2)
-    sp = take(2)
-    dp = remaining
-    mesh = create_mesh({AXIS_DP: dp, AXIS_PP: pp, AXIS_TP: tp,
-                        AXIS_SP: sp, AXIS_EP: 1}, devices=devices)
-    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4,
-                            n_layers=2 * pp, d_ff=64, n_experts=2,
-                            max_len=16, dtype="float32")
-    params = init_params(cfg, mesh, seed=0)
-    step, sh = make_train_step(cfg, mesh, n_micro=2, lr=1e-2)
-    B = 4 * dp
-    T = 8 * sp
-    rng = np.random.RandomState(0)
-    tokens = jax.device_put(
-        rng.randint(0, cfg.vocab, (B, T)).astype(np.int32), sh["data"])
-    labels = jax.device_put(
-        rng.randint(0, cfg.vocab, (B, T)).astype(np.int32), sh["data"])
-    params, loss = step(params, tokens, labels)
-    loss_val = float(jax.device_get(loss))
-    if not np.isfinite(loss_val):
-        raise MXNetError("dryrun produced non-finite loss")
-    jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+    for axes in _dryrun_axis_configs(n_devices):
+        dp, pp, tp, sp, ep = (axes[AXIS_DP], axes[AXIS_PP], axes[AXIS_TP],
+                              axes[AXIS_SP], axes[AXIS_EP])
+        mesh = create_mesh(axes, devices=devices)
+        cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                n_layers=2 * pp, d_ff=64, n_experts=2,
+                                max_len=16, dtype="float32")
+        params = init_params(cfg, mesh, seed=0)
+        step, sh = make_train_step(cfg, mesh, n_micro=2, lr=1e-2)
+        B = 4 * dp
+        T = 8 * sp
+        rng = np.random.RandomState(0)
+        tokens = jax.device_put(
+            rng.randint(0, cfg.vocab, (B, T)).astype(np.int32),
+            sh["data"])
+        labels = jax.device_put(
+            rng.randint(0, cfg.vocab, (B, T)).astype(np.int32),
+            sh["data"])
+        params, loss = step(params, tokens, labels)
+        loss_val = float(jax.device_get(loss))
+        if not np.isfinite(loss_val):
+            raise MXNetError(
+                "dryrun produced non-finite loss (axes=%r)" % (axes,))
+        jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])

@@ -18,6 +18,7 @@
  */
 #include "include/mxtpu_runtime.h"
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstring>
@@ -110,7 +111,25 @@ class Engine {
     opr->priority = priority;
     opr->const_vars.assign(cvars, cvars + nc);
     opr->mutable_vars.assign(mvars, mvars + nm);
-    opr->wait.store(nc + nm + 1);  // +1 removed after registration
+    // a var must appear at most once across both sets: a read entry
+    // plus a write entry for the same op deadlocks the var's queue
+    // (the write waits on running_reads>0 forever)
+    auto dedupe = [](std::vector<uint64_t>& v) {
+      std::sort(v.begin(), v.end());
+      v.erase(std::unique(v.begin(), v.end()), v.end());
+    };
+    dedupe(opr->const_vars);
+    dedupe(opr->mutable_vars);
+    const auto& mv = opr->mutable_vars;
+    opr->const_vars.erase(
+        std::remove_if(opr->const_vars.begin(), opr->const_vars.end(),
+                       [&](uint64_t v) {
+                         return std::binary_search(mv.begin(), mv.end(), v);
+                       }),
+        opr->const_vars.end());
+    opr->wait.store(static_cast<int>(opr->const_vars.size() +
+                                     opr->mutable_vars.size()) +
+                    1);  // +1 removed after registration
 
     {
       std::lock_guard<std::mutex> lk(mu_);

@@ -111,3 +111,15 @@ def test_image_det_iter(tmp_path):
     np.testing.assert_allclose(lab[0, 0, 1:], [0.1, 0.2, 0.6, 0.7],
                                rtol=1e-5)
     assert np.all(lab[0, 1:, 0] == -1)  # padding rows
+
+
+def test_imresize_float_no_uint8_clip():
+    """Float data (post-augmenter: negative / >255) must resize in float
+    — a uint8 round-trip would clip or wrap it."""
+    arr = np.full((8, 8, 3), -5.0, dtype=np.float32)
+    out = img.imresize(arr, 4, 4, interp=1).asnumpy()
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, -5.0, rtol=1e-6)
+    arr2 = np.full((8, 8, 3), 300.0, dtype=np.float32)
+    out2 = img.imresize(arr2, 4, 4, interp=1).asnumpy()
+    np.testing.assert_allclose(out2, 300.0, rtol=1e-6)

@@ -168,3 +168,66 @@ def test_dist_sync_kvstore_local_launcher():
         env=env, capture_output=True, text=True, timeout=300)
     assert res.returncode == 0, res.stdout + res.stderr
     assert res.stdout.count("DIST_SYNC_OK") == 2, res.stdout + res.stderr
+
+
+def test_save_load_optimizer_states_roundtrip(tmp_path):
+    """save_optimizer_states must persist the UPDATER's state buffers
+    (momentum), not just the optimizer object (reference
+    `python/mxnet/kvstore.py` saves `_updater.get_states()`)."""
+    kv = _init_kv("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    for _ in range(3):
+        kv.push(3, mx.nd.ones(SHAPE))
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+
+    kv2 = _init_kv("local")
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv2.load_optimizer_states(fname)
+    # momentum buffers must have survived the roundtrip
+    st1 = kv._updater.states
+    st2 = kv2._updater.states
+    assert set(st1) == set(st2) and len(st1) > 0
+    for k in st1:
+        s1 = st1[k] if not isinstance(st1[k], (list, tuple)) else st1[k][0]
+        s2 = st2[k] if not isinstance(st2[k], (list, tuple)) else st2[k][0]
+        np.testing.assert_allclose(s1.asnumpy(), s2.asnumpy(), rtol=1e-6)
+
+
+def test_ps_wire_codec_roundtrip():
+    """The PS transport uses a restricted serializer (JSON + raw numpy
+    buffers), never pickle, and HMAC-rejects tampered frames."""
+    from mxtpu import _ps
+
+    msg = {"op": "push", "key": ("weight", 2),
+           "value": np.arange(12, dtype=np.float32).reshape(3, 4),
+           "sync": True, "body": b"\x80\x05opaque", "extra": [1, 2.5, None]}
+    out = _ps._decode(_ps._encode(msg))
+    assert out["op"] == "push" and out["key"] == ("weight", 2)
+    assert out["sync"] is True and out["body"] == b"\x80\x05opaque"
+    assert out["extra"] == [1, 2.5, None]
+    np.testing.assert_array_equal(out["value"], msg["value"])
+    # pickle payloads must NOT execute: a malicious frame is just bytes
+    evil = b"cos\nsystem\n(S'echo pwned'\ntR."
+    dec = _ps._decode(_ps._encode({"body": evil}))
+    assert dec["body"] == evil
+
+    os.environ["MXTPU_PS_SECRET"] = "s3cret"
+    try:
+        import socket as _socket
+
+        a, b = _socket.socketpair()
+        _ps._send_msg(a, {"ok": True})
+        assert _ps._recv_msg(b) == {"ok": True}
+        # tampered frame fails HMAC
+        payload = _ps._encode({"ok": True})
+        import hashlib, hmac, struct
+
+        mac = hmac.new(b"wrong", payload, hashlib.sha256).digest()
+        framed = struct.pack("!Q", len(mac + payload)) + mac + payload
+        a.sendall(framed)
+        with pytest.raises(ConnectionError):
+            _ps._recv_msg(b)
+        a.close(); b.close()
+    finally:
+        del os.environ["MXTPU_PS_SECRET"]

@@ -205,3 +205,32 @@ def test_bucketing_nondefault_bucket_trains():
         .arg_dict["fc_weight"].asnumpy()
     assert np.abs(w_after - w_before).max() > 1e-6, \
         "non-default bucket update was a no-op"
+
+
+def test_unlabeled_then_labeled_batch_rebind():
+    """An unlabeled-batch rebind on a training module must not strand
+    the label slots: a following labeled batch of the same data shape
+    must actually train against ITS labels (bug: stale label buffers)."""
+    x, y = _blobs(n=32)
+    it = NDArrayIter(x, y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.0})
+    batch = next(iter(it))
+    # unlabeled forward at a NEW shape -> rebind without labels
+    mod.forward(DataBatch(data=[batch.data[0][:4]], label=None),
+                is_train=True)
+    # labeled forward at that same shape: grads must reflect the labels
+    def grad_for(labels):
+        mod.forward(DataBatch(data=[batch.data[0][:4]], label=[labels]),
+                    is_train=True)
+        mod.backward()
+        return mod._exec_group.execs[0].grad_dict["fc1_weight"].asnumpy().copy()
+
+    g_a = grad_for(nd.array(np.zeros(4, np.float32)))
+    g_b = grad_for(nd.array(np.ones(4, np.float32)))
+    assert mod._exec_group.label_shapes, "label slots were dropped"
+    assert not np.allclose(g_a, g_b), \
+        "different labels produced identical grads (stale label buffer)"

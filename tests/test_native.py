@@ -275,3 +275,15 @@ def test_python_producer_prefetcher():
         lib.MXTPUBufferFree(out)
     lib.MXTPUPrefetcherFree(h)
     assert got == [b"item%02d" % i for i in range(20)]
+
+
+def test_engine_overlapping_const_mutable_vars():
+    """A var listed as both const and mutable must not deadlock: the
+    engine drops the read entry (reference asserts disjointness)."""
+    eng = _engine()
+    v = eng.new_var()
+    hits = []
+    eng.push(lambda: hits.append(1), const_vars=[v], mutable_vars=[v])
+    eng.push(lambda: hits.append(2), const_vars=[v, v], mutable_vars=[v, v])
+    eng.wait_for_all()
+    assert hits == [1, 2]
