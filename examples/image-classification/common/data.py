@@ -1,0 +1,127 @@
+"""Data plumbing for the image-classification examples (reference
+`example/image-classification/common/data.py`): recordio iterators with
+worker sharding, standard augmentation flags, and a synthetic iterator
+for hermetic benchmarking (`--benchmark 1`)."""
+import os
+
+import numpy as np
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data", "the input images")
+    data.add_argument("--data-train", type=str, help="training record file")
+    data.add_argument("--data-train-idx", type=str, default="",
+                      help="training record index file")
+    data.add_argument("--data-val", type=str, help="validation record file")
+    data.add_argument("--data-val-idx", type=str, default="",
+                      help="validation record index file")
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939")
+    data.add_argument("--rgb-std", type=str, default="1,1,1")
+    data.add_argument("--pad-size", type=int, default=0)
+    data.add_argument("--image-shape", type=str, default="3,224,224")
+    data.add_argument("--num-classes", type=int, default=1000)
+    data.add_argument("--num-examples", type=int, default=1281167)
+    data.add_argument("--data-nthreads", type=int, default=4,
+                      help="number of decode threads")
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="if 1, run on synthetic data of --image-shape")
+    return data
+
+
+def add_data_aug_args(parser):
+    aug = parser.add_argument_group("MXTPU data augmentations")
+    aug.add_argument("--random-crop", type=int, default=0)
+    aug.add_argument("--random-mirror", type=int, default=1)
+    aug.add_argument("--max-random-h", type=int, default=0)
+    aug.add_argument("--max-random-s", type=int, default=0)
+    aug.add_argument("--max-random-l", type=int, default=0)
+    aug.add_argument("--max-random-aspect-ratio", type=float, default=0)
+    aug.add_argument("--max-random-rotate-angle", type=int, default=0)
+    aug.add_argument("--max-random-shear-ratio", type=float, default=0)
+    aug.add_argument("--max-random-scale", type=float, default=1)
+    aug.add_argument("--min-random-scale", type=float, default=1)
+    return aug
+
+
+class SyntheticDataIter(object):
+    """Fixed random batch served `epoch_size` times per epoch — the
+    reference's `--benchmark 1` mode (`common/data.py SyntheticDataIter`):
+    measures compute, not IO."""
+
+    def __init__(self, num_classes, data_shape, epoch_size,
+                 label_name="softmax_label", data_name="data"):
+        from mxtpu import nd
+        from mxtpu.io.io import DataDesc
+
+        self.batch_size = data_shape[0]
+        self.epoch_size = epoch_size
+        self.cur_iter = 0
+        rng = np.random.RandomState(0)
+        self._data = nd.array(
+            rng.uniform(-1, 1, data_shape).astype(np.float32))
+        self._label = nd.array(
+            rng.randint(0, num_classes, (self.batch_size,))
+            .astype(np.float32))
+        self.provide_data = [DataDesc(data_name, data_shape, np.float32)]
+        self.provide_label = [DataDesc(label_name, (self.batch_size,),
+                                       np.float32)]
+
+    def __iter__(self):
+        return self
+
+    def next(self):
+        from mxtpu.io.io import DataBatch
+
+        if self.cur_iter >= self.epoch_size:
+            raise StopIteration
+        self.cur_iter += 1
+        return DataBatch(data=[self._data], label=[self._label],
+                         pad=0, provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    __next__ = next
+
+    def reset(self):
+        self.cur_iter = 0
+
+
+def get_rec_iter(args, kv=None):
+    """(train, val) iterators; recordio-backed with rank sharding when
+    --data-train is given, synthetic otherwise."""
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    batch = args.batch_size
+    if args.benchmark or not args.data_train:
+        epoch_size = max(1, args.num_examples // batch)
+        train = SyntheticDataIter(args.num_classes, (batch,) + image_shape,
+                                  epoch_size)
+        return train, None
+    from mxtpu.io.record_iter import ImageRecordIter
+
+    rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
+    mean = [float(x) for x in args.rgb_mean.split(",")]
+    std = [float(x) for x in args.rgb_std.split(",")]
+    train = ImageRecordIter(
+        path_imgrec=args.data_train,
+        path_imgidx=args.data_train_idx,
+        data_shape=image_shape,
+        batch_size=batch,
+        shuffle=True,
+        rand_crop=bool(args.random_crop),
+        rand_mirror=bool(args.random_mirror),
+        mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+        std_r=std[0], std_g=std[1], std_b=std[2],
+        preprocess_threads=args.data_nthreads,
+        num_parts=nworker, part_index=rank)
+    val = None
+    if args.data_val:
+        val = ImageRecordIter(
+            path_imgrec=args.data_val,
+            path_imgidx=args.data_val_idx,
+            data_shape=image_shape,
+            batch_size=batch,
+            shuffle=False,
+            mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+            std_r=std[0], std_g=std[1], std_b=std[2],
+            preprocess_threads=args.data_nthreads,
+            num_parts=nworker, part_index=rank)
+    return train, val

@@ -1,0 +1,234 @@
+"""Training harness for the image-classification examples (reference
+`example/image-classification/common/fit.py`): arg surface, lr schedule,
+checkpoint/resume, monitor, and the Module.fit call.
+
+TPU-first differences from the reference:
+  * devices come from the jax platform (all local TPU chips, or the
+    virtual CPU mesh in tests) instead of a --gpus list;
+  * --dtype bfloat16/float16 enables the AMP compute policy
+    (`mxtpu/amp.py`) — fp32 master weights, low-precision matmuls — not
+    a symbol-level cast;
+  * --kv-store tpu rides the XLA allreduce path (BASELINE.json north
+    star).
+"""
+import logging
+import math
+import os
+import re
+import time
+
+
+def get_epoch_size(args, kv):
+    return math.ceil(int(args.num_examples / kv.num_workers)
+                     / args.batch_size)
+
+
+def _get_lr_scheduler(args, kv):
+    import mxtpu as mx
+
+    if not args.lr_step_epochs or args.lr_factor >= 1:
+        return (args.lr, None)
+    epoch_size = get_epoch_size(args, kv)
+    begin_epoch = args.load_epoch or 0
+    if "pow" in args.lr_step_epochs:
+        pwr = float(re.sub(r"pow[- ]*", "", args.lr_step_epochs))
+        max_up = args.num_epochs * epoch_size
+        return (args.lr, mx.lr_scheduler.PolyScheduler(
+            max_up, base_lr=args.lr, pwr=pwr))
+    step_epochs = [int(x) for x in args.lr_step_epochs.split(",")]
+    lr = args.lr
+    for s in step_epochs:
+        if begin_epoch >= s:
+            lr *= args.lr_factor
+    if lr != args.lr:
+        logging.info("Adjust learning rate to %e for epoch %d",
+                     lr, begin_epoch)
+    steps = [epoch_size * (x - begin_epoch) for x in step_epochs
+             if x - begin_epoch > 0]
+    if not steps:
+        return (lr, None)
+    return (lr, mx.lr_scheduler.MultiFactorScheduler(
+        step=steps, factor=args.lr_factor, base_lr=args.lr))
+
+
+def _load_model(args, rank=0):
+    import mxtpu as mx
+
+    if args.load_epoch is None or args.model_prefix is None:
+        return (None, None, None)
+    model_prefix = args.model_prefix
+    if rank > 0 and os.path.exists("%s-%d-symbol.json"
+                                   % (model_prefix, rank)):
+        model_prefix += "-%d" % rank
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        model_prefix, args.load_epoch)
+    logging.info("Loaded model %s_%04d.params", model_prefix,
+                 args.load_epoch)
+    return (sym, arg_params, aux_params)
+
+
+def _save_model(args, rank=0):
+    import mxtpu as mx
+
+    if args.model_prefix is None:
+        return None
+    return mx.callback.do_checkpoint(
+        args.model_prefix if rank == 0 else "%s-%d"
+        % (args.model_prefix, rank),
+        period=args.save_period)
+
+
+def add_fit_args(parser):
+    train = parser.add_argument_group("Training", "model training")
+    train.add_argument("--network", type=str,
+                       help="the neural network to use")
+    train.add_argument("--num-layers", type=int,
+                       help="number of layers, for nets like resnet")
+    train.add_argument("--num-devices", type=int, default=0,
+                       help="devices to train on; 0 = all visible")
+    train.add_argument("--kv-store", type=str, default="tpu",
+                       help="key-value store type (tpu = XLA allreduce)")
+    train.add_argument("--num-epochs", type=int, default=100)
+    train.add_argument("--lr", type=float, default=0.1)
+    train.add_argument("--lr-factor", type=float, default=0.1)
+    train.add_argument("--lr-step-epochs", type=str,
+                       help="epochs to reduce the lr, e.g. 30,60")
+    train.add_argument("--initializer", type=str, default="default")
+    train.add_argument("--optimizer", type=str, default="sgd")
+    train.add_argument("--mom", type=float, default=0.9)
+    train.add_argument("--wd", type=float, default=0.0001)
+    train.add_argument("--batch-size", type=int, default=128,
+                       help="GLOBAL batch size (split over devices)")
+    train.add_argument("--disp-batches", type=int, default=20)
+    train.add_argument("--model-prefix", type=str)
+    train.add_argument("--save-period", type=int, default=1)
+    train.add_argument("--monitor", type=int, default=0)
+    train.add_argument("--load-epoch", type=int)
+    train.add_argument("--top-k", type=int, default=0)
+    train.add_argument("--dtype", type=str, default="float32",
+                       choices=("float32", "bfloat16", "float16"),
+                       help="compute precision (AMP for bf16/fp16)")
+    train.add_argument("--max-batches", type=int, default=0,
+                       help="stop each epoch after N batches (smoke runs)")
+    return train
+
+
+def _devices(args):
+    import mxtpu as mx
+
+    n = mx.num_tpus()
+    if n:
+        devs = [mx.tpu(i) for i in range(n)]
+    else:
+        import jax
+
+        devs = [mx.cpu(i) for i in range(len(jax.devices()))]
+    if args.num_devices:
+        devs = devs[:args.num_devices]
+    return devs
+
+
+def _initializer(args):
+    import mxtpu as mx
+
+    if args.initializer in ("default", "xavier"):
+        return mx.initializer.Xavier(rnd_type="gaussian",
+                                     factor_type="in", magnitude=2)
+    if args.initializer == "msra":
+        return mx.initializer.MSRAPrelu()
+    return mx.initializer.Uniform(0.01)
+
+
+def fit(args, network, data_loader_fn, **kwargs):
+    """Train `network` (a Symbol) with the data from `data_loader_fn`
+    (reference `common/fit.py fit`)."""
+    import mxtpu as mx
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    logging.info("start with arguments %s", args)
+
+    if args.dtype != "float32":
+        mx.amp.set_compute_dtype(args.dtype)
+
+    kv = mx.kv.create(args.kv_store)
+    train, val = data_loader_fn(args, kv)
+
+    epoch_size = get_epoch_size(args, kv)
+    lr, lr_scheduler = _get_lr_scheduler(args, kv)
+    sym, arg_params, aux_params = _load_model(args, kv.rank)
+    if sym is not None:
+        network = sym
+    checkpoint = _save_model(args, kv.rank)
+
+    devs = _devices(args)
+    logging.info("devices: %s", devs)
+    mod = mx.mod.Module(network, context=devs,
+                        data_names=[d.name for d in train.provide_data],
+                        label_names=[l.name for l in train.provide_label])
+
+    optimizer_params = {
+        "learning_rate": lr,
+        "wd": args.wd,
+        "lr_scheduler": lr_scheduler,
+        "multi_precision": args.dtype != "float32",
+    }
+    if args.optimizer in ("sgd", "nag", "signum", "lbsgd"):
+        optimizer_params["momentum"] = args.mom
+
+    eval_metrics = ["accuracy"]
+    if args.top_k > 0:
+        eval_metrics.append(mx.metric.create("top_k_accuracy",
+                                             top_k=args.top_k))
+
+    batch_end_callbacks = [mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches)]
+    monitor = mx.monitor.Monitor(args.monitor, pattern=".*") \
+        if args.monitor > 0 else None
+
+    if args.max_batches:
+        train = _TruncatedIter(train, args.max_batches)
+
+    mod.fit(train,
+            begin_epoch=args.load_epoch or 0,
+            num_epoch=args.num_epochs,
+            eval_data=val,
+            eval_metric=eval_metrics,
+            kvstore=kv,
+            optimizer=args.optimizer,
+            optimizer_params=optimizer_params,
+            initializer=_initializer(args),
+            arg_params=arg_params,
+            aux_params=aux_params,
+            batch_end_callback=batch_end_callbacks,
+            epoch_end_callback=checkpoint,
+            allow_missing=True,
+            monitor=monitor)
+    return mod
+
+
+class _TruncatedIter(object):
+    """Cap an iterator at N batches/epoch (smoke-testing aid)."""
+
+    def __init__(self, base, max_batches):
+        self._base = base
+        self._max = max_batches
+        self._n = 0
+        self.provide_data = base.provide_data
+        self.provide_label = base.provide_label
+        self.batch_size = base.batch_size
+
+    def __iter__(self):
+        return self
+
+    def next(self):
+        if self._n >= self._max:
+            raise StopIteration
+        self._n += 1
+        return next(self._base)
+
+    __next__ = next
+
+    def reset(self):
+        self._n = 0
+        self._base.reset()

@@ -1,0 +1,24 @@
+"""Example-script utilities (reference `common/util.py`)."""
+import os
+import re
+
+
+def apply_platform_env():
+    """Honor JAX_PLATFORMS / xla_force_host_platform_device_count even
+    though the interpreter may have imported jax before this script ran
+    (the env vars are captured at import): re-assert them through the
+    config API, which works any time before backend init.  Same trick
+    as tests/conftest.py."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", platforms)
+    m = re.search(r"xla_force_host_platform_device_count=(\d+)",
+                  os.environ.get("XLA_FLAGS", ""))
+    if m and "cpu" in platforms:
+        try:
+            jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+        except Exception:
+            pass

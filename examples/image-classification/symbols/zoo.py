@@ -1,0 +1,71 @@
+"""Network symbols for the image-classification examples.
+
+The reference ships hand-written symbol builders per network
+(`example/image-classification/symbols/resnet.py` etc.).  The TPU-native
+framework already has every architecture in the Gluon model zoo
+(`mxtpu/gluon/model_zoo/vision`), so instead of duplicating the layer
+stacks this module TRACES a zoo network into a Symbol — the same
+hybridize machinery that powers `net.export()` — and attaches the
+softmax head.  One definition per architecture, two frontends.
+"""
+import sys
+
+
+def get_symbol(network="resnet", num_layers=50, num_classes=1000,
+               image_shape=(3, 224, 224), **kwargs):
+    """Build `network` from the gluon model zoo and trace it into a
+    Symbol whose input is named "data" with a SoftmaxOutput head named
+    "softmax" (reference `symbols/<net>.py get_symbol`)."""
+    import mxtpu as mx
+    from mxtpu import sym
+    from mxtpu.gluon.model_zoo import vision
+
+    if network in ("resnet", "resnet-v1"):
+        net = vision.get_resnet(1, num_layers, classes=num_classes)
+    elif network == "resnet-v2":
+        net = vision.get_resnet(2, num_layers, classes=num_classes)
+    elif network == "alexnet":
+        net = vision.alexnet(classes=num_classes)
+    elif network == "vgg":
+        net = vision.get_vgg(num_layers or 16, classes=num_classes)
+    elif network in ("inception-v3", "inception"):
+        net = vision.inception_v3(classes=num_classes)
+    elif network == "mobilenet":
+        net = vision.mobilenet1_0(classes=num_classes)
+    elif network == "squeezenet":
+        net = vision.squeezenet1_0(classes=num_classes)
+    elif network.startswith("densenet"):
+        net = vision.densenet121(classes=num_classes)
+    elif network in ("mlp", "lenet"):
+        return _small_symbol(network, num_classes)
+    else:
+        raise ValueError("unknown network %r" % network)
+
+    net.initialize()
+    x_trace = mx.nd.zeros((1,) + tuple(image_shape))
+    traced, _, _ = net._trace_symbol(x_trace)
+    # the trace names its input data0 — compose to the conventional name
+    out = traced(data0=sym.Variable("data"))
+    return sym.SoftmaxOutput(data=out, label=sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def _small_symbol(network, num_classes):
+    from mxtpu import sym
+
+    data = sym.Variable("data")
+    if network == "mlp":
+        h = sym.FullyConnected(data=sym.Flatten(data), num_hidden=128,
+                               name="fc1")
+        h = sym.Activation(data=h, act_type="relu")
+        h = sym.FullyConnected(data=h, num_hidden=num_classes, name="fc2")
+    else:  # lenet
+        h = sym.Convolution(data=data, kernel=(5, 5), num_filter=20,
+                            name="conv1")
+        h = sym.Activation(data=h, act_type="relu")
+        h = sym.Pooling(data=h, kernel=(2, 2), stride=(2, 2),
+                        pool_type="max")
+        h = sym.FullyConnected(data=sym.Flatten(h), num_hidden=num_classes,
+                               name="fc")
+    return sym.SoftmaxOutput(data=h, label=sym.Variable("softmax_label"),
+                             name="softmax")

@@ -40,6 +40,15 @@ from . import symbol as sym
 from .symbol import Symbol
 from .executor import Executor
 from .cached_op import CachedOp
+from . import amp
+from . import control_flow
+# reference API surface: mx.nd.contrib.foreach / mx.sym.contrib.foreach
+# (`python/mxnet/{ndarray,symbol}/contrib.py`) — one dispatching impl here
+for _ns in (ndarray.contrib, symbol.contrib):
+    _ns.foreach = control_flow.foreach
+    _ns.while_loop = control_flow.while_loop
+    _ns.cond = control_flow.cond
+del _ns
 from . import initializer
 from .initializer import init
 from . import optimizer

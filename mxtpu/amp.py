@@ -1,0 +1,96 @@
+"""Automatic mixed precision (AMP) — the TPU bfloat16 compute policy.
+
+Reference analog: `python/mxnet/contrib/amp/` (v1.5 AMP with
+FP16_FUNCS/FP32_FUNCS op lists and cast insertion).  The TPU-native
+redesign keeps parameters in float32 (master weights) and casts
+per-op INSIDE the single fused XLA module built by
+`executor._build_graph_fn`:
+
+  * matmul/conv FLOPs ops run in the compute dtype (bfloat16 hits the
+    MXU at full rate),
+  * numerically-sensitive ops (softmax/losses/norm-stats) are upcast
+    to float32,
+  * everything else runs in whatever dtype arrives (XLA fuses the
+    casts into neighboring kernels).
+
+Because the cast happens inside the traced graph, gradients flow
+through the cast's vjp and arrive as float32 — the optimizer needs no
+`multi_precision` handling and the fused whole-tree update still
+applies.
+
+Usage::
+
+    mxtpu.amp.set_compute_dtype("bfloat16")   # before bind/hybridize
+    ... bind / fit ...
+    mxtpu.amp.set_compute_dtype(None)         # back to pure fp32
+
+The policy is captured at graph-BUILD time (bind / first hybridized
+call), matching the reference where `amp.init()` must run before the
+model is created.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+__all__ = ["set_compute_dtype", "get_compute_dtype", "scope",
+           "LOWP_OPS", "FP32_OPS"]
+
+_state = threading.local()
+
+# The FLOPs carriers: run these in the low-precision compute dtype
+# (reference FP16_FUNCS list, `contrib/amp/lists/symbol.py`).
+LOWP_OPS = {
+    "Convolution", "Deconvolution", "FullyConnected", "dot", "batch_dot",
+    "RNN", "Correlation",
+}
+
+# Numerically sensitive: force float32 inputs (reference FP32_FUNCS).
+FP32_OPS = {
+    "SoftmaxOutput", "softmax", "log_softmax", "SoftmaxActivation",
+    "LayerNorm", "InstanceNorm", "L2Normalization", "LRN",
+    "CTCLoss", "_contrib_CTCLoss", "MakeLoss", "SVMOutput",
+    "LinearRegressionOutput", "LogisticRegressionOutput",
+    "MAERegressionOutput", "norm", "exp", "log", "log2", "log10",
+    "expm1", "log1p", "pow", "_power", "_power_scalar", "erfinv",
+    "SpatialTransformer", "GridGenerator",
+}
+
+
+def set_compute_dtype(dtype: Optional[str]) -> None:
+    """Set (or clear, with None) the AMP compute dtype for graphs built
+    after this call."""
+    _state.dtype = dtype
+
+
+def get_compute_dtype() -> Optional[str]:
+    return getattr(_state, "dtype", None)
+
+
+@contextmanager
+def scope(dtype: Optional[str]):
+    prev = get_compute_dtype()
+    set_compute_dtype(dtype)
+    try:
+        yield
+    finally:
+        set_compute_dtype(prev)
+
+
+def cast_op_inputs(op_name: str, invals, dtype):
+    """Apply the policy to one node's inputs (float arrays only — int
+    index/label-ish inputs pass through untouched)."""
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    f32 = jnp.float32
+    if op_name in LOWP_OPS:
+        return [v.astype(dt)
+                if getattr(v, "dtype", None) == f32 else v
+                for v in invals]
+    if op_name in FP32_OPS:
+        return [v.astype(f32)
+                if getattr(v, "dtype", None) == dt else v
+                for v in invals]
+    return invals

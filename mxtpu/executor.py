@@ -39,9 +39,16 @@ _BN_OPS = {"BatchNorm", "BatchNorm_v1", "_contrib_SyncBatchNorm"}
 
 def _build_graph_fn(symbol: Symbol, arg_names: List[str],
                     aux_names: List[str], is_train: bool):
-    """Return fn(arg_vals, aux_vals, key) -> (outputs, new_aux_vals)."""
+    """Return fn(arg_vals, aux_vals, key) -> (outputs, new_aux_vals).
+
+    The AMP compute-dtype policy (`mxtpu/amp.py`) is captured HERE, at
+    graph-build time: per-op casts are baked into the traced function
+    so XLA fuses them into neighboring kernels."""
     import jax
 
+    from . import amp as _amp
+
+    compute_dtype = _amp.get_compute_dtype()
     nodes = _topo_order(symbol._outputs)
     arg_pos = {n: i for i, n in enumerate(arg_names)}
     aux_pos = {n: i for i, n in enumerate(aux_names)}
@@ -50,40 +57,62 @@ def _build_graph_fn(symbol: Symbol, arg_names: List[str],
         env: Dict[Tuple[int, int], Any] = {}
         aux_new = list(aux_vals)
         rng_i = 0
-        for node in nodes:
-            if node.is_variable:
-                if node.is_aux:
-                    env[(id(node), 0)] = aux_vals[aux_pos[node.name]]
+        # re-assert the captured policy for the duration of the trace so
+        # nested graph builds (control-flow subgraphs constructed while
+        # tracing) inherit it even if the thread-local changed since bind
+        with _amp.scope(compute_dtype):
+            for node in nodes:
+                if node.is_variable:
+                    if node.is_aux:
+                        env[(id(node), 0)] = aux_vals[aux_pos[node.name]]
+                    else:
+                        env[(id(node), 0)] = arg_vals[arg_pos[node.name]]
+                    continue
+                invals = [env[(id(inode), idx)]
+                          for inode, idx in node.inputs]
+                if compute_dtype is not None:
+                    invals = _amp.cast_op_inputs(node.op.name, invals,
+                                                 compute_dtype)
+                attrs = dict(node.attrs)
+                if node.op.train_aware:
+                    attrs["is_train"] = is_train
+                if node.op.needs_rng:
+                    sub = jax.random.fold_in(key, rng_i)
+                    rng_i += 1
+                    out = node.op.fn(sub, *invals, **attrs)
                 else:
-                    env[(id(node), 0)] = arg_vals[arg_pos[node.name]]
-                continue
-            invals = [env[(id(inode), idx)] for inode, idx in node.inputs]
-            attrs = dict(node.attrs)
-            if node.op.train_aware:
-                attrs["is_train"] = is_train
-            if node.op.needs_rng:
-                sub = jax.random.fold_in(key, rng_i)
-                rng_i += 1
-                out = node.op.fn(sub, *invals, **attrs)
-            else:
-                out = node.op.fn(*invals, **attrs)
-            if not isinstance(out, tuple):
-                out = (out,)
-            for i, o in enumerate(out):
-                env[(id(node), i)] = o
-            # BatchNorm-family: fold the moving-stat update into the graph
-            # (reference mutates aux NDArrays in-place during forward)
-            if is_train and node.op.name in _BN_OPS \
-                    and not attrs.get("use_global_stats", False):
-                momentum = float(attrs.get("momentum", 0.9))
-                _, mean, var = out[0], out[1], out[2]
-                mm_node, mv_node = node.inputs[3][0], node.inputs[4][0]
-                for aux_node, batch_stat in ((mm_node, mean), (mv_node, var)):
-                    if aux_node.is_variable and aux_node.is_aux:
-                        p = aux_pos[aux_node.name]
-                        aux_new[p] = momentum * aux_new[p] + \
-                            (1.0 - momentum) * batch_stat
-        outputs = [env[(id(n), i)] for n, i in symbol._outputs]
+                    out = node.op.fn(*invals, **attrs)
+                if not isinstance(out, tuple):
+                    out = (out,)
+                n_vis = node.op.n_outputs(node.attrs)
+                # control-flow ops append their subgraph's updated aux
+                # values after the visible outputs; write them back to
+                # the matching outer aux slots by name
+                if is_train and len(out) > n_vis \
+                        and node.attrs.get("sub_aux"):
+                    for name, val in zip(node.attrs["sub_aux"],
+                                         out[n_vis:]):
+                        if name in aux_pos:
+                            aux_new[aux_pos[name]] = val
+                    out = out[:n_vis]
+                for i, o in enumerate(out):
+                    env[(id(node), i)] = o
+                # BatchNorm-family: fold the moving-stat update into the
+                # graph (reference mutates aux NDArrays in-place during
+                # forward)
+                if is_train and node.op.name in _BN_OPS \
+                        and not attrs.get("use_global_stats", False):
+                    momentum = float(attrs.get("momentum", 0.9))
+                    _, mean, var = out[0], out[1], out[2]
+                    mm_node, mv_node = (node.inputs[3][0],
+                                        node.inputs[4][0])
+                    for aux_node, batch_stat in ((mm_node, mean),
+                                                 (mv_node, var)):
+                        if aux_node.is_variable and aux_node.is_aux:
+                            p = aux_pos[aux_node.name]
+                            aux_new[p] = momentum * aux_new[p] + \
+                                (1.0 - momentum) * batch_stat
+            outputs = [env[(id(n), i)] for n, i in symbol._outputs]
         return outputs, aux_new
 
     return graph_fn
@@ -268,7 +297,14 @@ class Executor(object):
         if is_train and self._diff_idx:
             import jax.numpy as jnp
 
-            ograds = [jnp.ones(s, dtype=d) for s, d in self._out_avals()]
+            # the default ones head-gradients are step-invariant: build
+            # them once (each jnp.ones is otherwise a tiny device
+            # program per training step — costly over a remote tunnel)
+            ograds = getattr(self, "_ones_ograds", None)
+            if ograds is None:
+                ograds = [jnp.ones(s, dtype=d)
+                          for s, d in self._out_avals()]
+                self._ones_ograds = ograds
             outs, grads, aux_new = self._jit_step(
                 self._arg_vals(), self._aux_vals(), key, ograds)
             self._cached_grads = grads

@@ -66,3 +66,164 @@ def cond_jax(pred, then_func: Callable, else_func: Callable):
     import jax
 
     return jax.lax.cond(pred != 0, then_func, else_func)
+
+
+# ---------------------------------------------------------------------------
+# Registered subgraph ops (reference `src/operator/control_flow.cc:491-547`:
+# _foreach/_while_loop/_cond are ops holding subgraph Symbols).  Here the
+# subgraph lowers through `executor._build_graph_fn` into the SAME jax
+# trace as the outer graph, so the loop becomes a native lax.scan /
+# lax.while_loop / lax.cond inside the one fused XLA module — no nested
+# CachedOp dispatch.  Node-input layout and the attrs contract are
+# produced by `mxtpu/control_flow.py`.
+# ---------------------------------------------------------------------------
+
+from .registry import register
+
+
+def _sub_fn(subgraph, sub_args, sub_aux, is_train):
+    from ..executor import _build_graph_fn
+
+    return _build_graph_fn(subgraph, list(sub_args), list(sub_aux),
+                           is_train=bool(is_train))
+
+
+def _place(n_slots, locs_vals_pairs):
+    vals = [None] * n_slots
+    for locs, vs in locs_vals_pairs:
+        for loc, v in zip(locs, vs):
+            vals[loc] = v
+    return vals
+
+
+@register("_foreach", needs_rng=True, train_aware=True,
+          num_outputs=lambda attrs: int(attrs["num_out_data"])
+          + int(attrs["num_states"]))
+def _foreach_op(key, *inputs, subgraph, sub_args, sub_aux=(),
+                data_locs=(), state_locs=(), free_locs=(),
+                num_out_data=1, num_states=0, is_train=False):
+    """inputs = [data..., states..., frees..., aux...] in the order the
+    attrs' loc tuples describe; scans data over axis 0."""
+    import jax
+
+    import jax.numpy as jnp
+
+    nd_, ns_ = len(data_locs), len(state_locs)
+    data = inputs[:nd_]
+    states = inputs[nd_:nd_ + ns_]
+    frees = inputs[nd_ + ns_:nd_ + ns_ + len(free_locs)]
+    aux = list(inputs[nd_ + ns_ + len(free_locs):])
+    fn = _sub_fn(subgraph, sub_args, sub_aux, is_train)
+
+    def scan_body(carry, xt):
+        states_c, aux_c, i = carry
+        vals = _place(len(sub_args),
+                      [(data_locs, xt), (state_locs, states_c),
+                       (free_locs, frees)])
+        # fresh RNG per iteration (the reference runs the subgraph
+        # CachedOp per step, drawing new random state each time)
+        outs, aux_n = fn(vals, list(aux_c), jax.random.fold_in(key, i))
+        return ((tuple(outs[num_out_data:]), tuple(aux_n), i + 1),
+                tuple(outs[:num_out_data]))
+
+    (carry, aux_f, _), ys = jax.lax.scan(
+        scan_body, (tuple(states), tuple(aux), jnp.int32(0)),
+        tuple(data))
+    # updated subgraph aux values ride AFTER the visible outputs; the
+    # executor writes them back to the outer aux slots by name
+    out = tuple(ys) + tuple(carry) + tuple(aux_f)
+    return out if len(out) != 1 else out[0]
+
+
+@register("_while_loop", needs_rng=True, train_aware=True,
+          num_outputs=lambda attrs: int(attrs["num_out_data"])
+          + int(attrs["num_states"]))
+def _while_loop_op(key, *inputs, cond_graph, cond_args, body_graph,
+                   body_args, sub_aux=(), state_locs_cond=(),
+                   free_locs_cond=(), state_locs_body=(),
+                   free_locs_body=(), cond_state_idx=None, n_states=0,
+                   num_out_data=0, num_states=0, max_iterations=0,
+                   is_train=False):
+    """inputs = [loop_vars..., frees_cond..., frees_body..., aux...].
+    Semantics of the reference _while_loop: body returns
+    (step_outputs..., new_loop_vars...); step outputs are stacked into
+    (max_iterations, ...) buffers, rows past the trip count stay 0."""
+    import jax
+    import jax.numpy as jnp
+
+    lv = list(inputs[:n_states])
+    off = n_states
+    frees_c = list(inputs[off:off + len(free_locs_cond)])
+    off += len(free_locs_cond)
+    frees_b = list(inputs[off:off + len(free_locs_body)])
+    off += len(free_locs_body)
+    aux = list(inputs[off:])
+
+    cond_fn = _sub_fn(cond_graph, cond_args, sub_aux, is_train)
+    body_fn = _sub_fn(body_graph, body_args, sub_aux, is_train)
+
+    def run_cond(vars_, aux_c, i):
+        vsel = ([vars_[j] for j in cond_state_idx]
+                if cond_state_idx is not None else vars_)
+        vals = _place(len(cond_args),
+                      [(state_locs_cond, vsel), (free_locs_cond, frees_c)])
+        outs, _ = cond_fn(vals, list(aux_c), jax.random.fold_in(key, i))
+        return outs[0].reshape(()) != 0
+
+    def run_body(vars_, aux_c, i):
+        vals = _place(len(body_args),
+                      [(state_locs_body, vars_), (free_locs_body, frees_b)])
+        outs, aux_n = body_fn(vals, list(aux_c),
+                              jax.random.fold_in(key, i))
+        return (list(outs[:num_out_data]), list(outs[num_out_data:]),
+                list(aux_n))
+
+    outs0, _, _ = run_body(lv, aux, jnp.int32(0))
+    bufs = tuple(jnp.zeros((max_iterations,) + tuple(o.shape), o.dtype)
+                 for o in outs0)
+
+    def lcond(carry):
+        i, vars_, aux_c, _ = carry
+        return jnp.logical_and(i < max_iterations,
+                               run_cond(vars_, aux_c, i))
+
+    def lbody(carry):
+        i, vars_, aux_c, bufs_ = carry
+        step_outs, new_vars, aux_n = run_body(vars_, aux_c, i)
+        bufs_ = tuple(b.at[i].set(o) for b, o in zip(bufs_, step_outs))
+        return i + 1, tuple(new_vars), tuple(aux_n), bufs_
+
+    _, final_vars, aux_f, bufs = jax.lax.while_loop(
+        lcond, lbody, (jnp.int32(0), tuple(lv), tuple(aux), bufs))
+    out = tuple(bufs) + tuple(final_vars[:num_states]) + tuple(aux_f)
+    return out if len(out) != 1 else out[0]
+
+
+@register("_cond", needs_rng=True, train_aware=True,
+          num_outputs=lambda attrs: int(attrs["num_outputs"]))
+def _cond_op(key, *inputs, then_graph, then_args, else_graph, else_args,
+             sub_aux=(), n_then_free=0, num_outputs=1, is_train=False):
+    """inputs = [pred, frees_then..., frees_else..., aux...]; both
+    branches must produce matching output shapes/dtypes (XLA cond)."""
+    import jax
+
+    pred = inputs[0]
+    frees_t = list(inputs[1:1 + n_then_free])
+    rest = inputs[1 + n_then_free:]
+    n_else_free = len(else_args)
+    frees_e = list(rest[:n_else_free])
+    aux = list(rest[n_else_free:])
+
+    then_fn = _sub_fn(then_graph, then_args, sub_aux, is_train)
+    else_fn = _sub_fn(else_graph, else_args, sub_aux, is_train)
+
+    def run_then(_):
+        outs, aux_n = then_fn(frees_t, aux, key)
+        return tuple(outs) + tuple(aux_n)
+
+    def run_else(_):
+        outs, aux_n = else_fn(frees_e, aux, key)
+        return tuple(outs) + tuple(aux_n)
+
+    out = jax.lax.cond(pred.reshape(()) != 0, run_then, run_else, None)
+    return out if len(out) != 1 else out[0]

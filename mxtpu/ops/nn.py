@@ -196,7 +196,9 @@ def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
     # specializes to reduce_window_max/add primitives (which carry the
     # autodiff rules); a traced init array kills differentiability.
     if pool_type == "max":
-        init = -np.inf if np.issubdtype(np.dtype(data.dtype), np.floating) else \
+        # jnp's lattice knows extension floats (bfloat16 has numpy kind
+        # 'V', so np.issubdtype would misroute it to iinfo)
+        init = -np.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
             int(np.iinfo(np.dtype(data.dtype)).min)
         return lax.reduce_window(data, np.dtype(data.dtype).type(init), lax.max,
                                  window, strides, pads)
@@ -279,15 +281,23 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     axes = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
     bshape = [1] * data.ndim
     bshape[axis % data.ndim] = data.shape[axis % data.ndim]
+    # statistics accumulate in float32 even for bf16/fp16 activations
+    # (reference accumulates in AccReal=float, batch_norm-inl.h); the
+    # normalized output returns in the input dtype so AMP graphs stay
+    # low-precision end to end
+    x32 = data.astype(jnp.float32)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if is_train and not use_global_stats:
-        mean = jnp.mean(data, axis=axes)
-        var = jnp.var(data, axis=axes)
+        mean = jnp.mean(x32, axis=axes)
+        var = jnp.var(x32, axis=axes)
     else:
-        mean, var = moving_mean, moving_var
-    inv = g.reshape(bshape) / jnp.sqrt(var.reshape(bshape) + eps)
-    out = (data - mean.reshape(bshape)) * inv + beta.reshape(bshape)
-    return out, mean, var
+        mean, var = (moving_mean.astype(jnp.float32),
+                     moving_var.astype(jnp.float32))
+    inv = g.astype(jnp.float32).reshape(bshape) / \
+        jnp.sqrt(var.reshape(bshape) + eps)
+    out = (x32 - mean.reshape(bshape)) * inv + \
+        beta.astype(jnp.float32).reshape(bshape)
+    return out.astype(data.dtype), mean, var
 
 
 @register("LayerNorm", num_outputs=3,

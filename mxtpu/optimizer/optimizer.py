@@ -24,6 +24,16 @@ __all__ = ["Optimizer", "SGD", "Signum", "SignSGD", "FTML", "DCASGD", "NAG",
            "create", "register"]
 
 
+def _is_lowp(dtype) -> bool:
+    """Low-precision float needing an fp32 master copy under
+    multi_precision: fp16 (reference mp_sgd_update) and bfloat16 (the
+    TPU compute dtype)."""
+    dt = np.dtype(dtype)
+    if dt == np.float16:
+        return True
+    return dt.name == "bfloat16"
+
+
 class Optimizer(object):
     opt_registry: Dict[str, type] = {}
 
@@ -68,7 +78,7 @@ class Optimizer(object):
 
     def create_state_multi_precision(self, index, weight):
         weight_master_copy = None
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_lowp(weight.dtype):
             weight_master_copy = weight.astype(np.float32)
             return (weight_master_copy, self.create_state(index,
                                                           weight_master_copy))
@@ -78,7 +88,7 @@ class Optimizer(object):
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_lowp(weight.dtype):
             weight32, base_state = state
             grad32 = grad.astype(np.float32)
             self.update(index, weight32, grad32, base_state)
@@ -190,8 +200,8 @@ _FUSED_CACHE: Dict[Any, Any] = {}
 
 
 def _fused_step_fn(kind: str, n: int, has_state: bool, has_clip: bool,
-                   donate: bool):
-    key = (kind, n, has_state, has_clip, donate)
+                   donate: bool, out_dtypes: Tuple = ()):
+    key = (kind, n, has_state, has_clip, donate, out_dtypes)
     fn = _FUSED_CACHE.get(key)
     if fn is not None:
         return fn
@@ -216,6 +226,31 @@ def _fused_step_fn(kind: str, n: int, has_state: bool, has_clip: bool,
                 else:
                     new_w.append(w - lrs[i] * (g + wds[i] * w))
             return new_w, new_s
+    elif kind == "sgd_mp":
+        # multi-precision whole-tree step (reference mp_sgd[_mom]_update,
+        # `src/operator/optimizer_op.cc`): fp32 master weights carry the
+        # update; low-precision (bf16/fp16) compute weights are re-cast
+        # from the masters inside the same XLA module.  `weights` here
+        # are the MASTERS; `out_dtypes[i]` is the compute weight's dtype
+        # (grads may arrive fp32 — mp_sgd_update casts back to the
+        # WEIGHT's type, not the grad's).
+        def step(masters, states, grads, lrs, wds, rescale, momentum,
+                 clip):
+            new_w32, new_s, new_w_out = [], [], []
+            for i in range(n):
+                w = masters[i]
+                g = grads[i].astype(jnp.float32) * rescale
+                if has_clip:
+                    g = jnp.clip(g, -clip, clip)
+                if has_state:
+                    m = momentum * states[i] - lrs[i] * (g + wds[i] * w)
+                    new_s.append(m)
+                    w2 = w + m
+                else:
+                    w2 = w - lrs[i] * (g + wds[i] * w)
+                new_w32.append(w2)
+                new_w_out.append(w2.astype(out_dtypes[i]))
+            return new_w32, new_s, new_w_out
     elif kind == "adam":
         # math identical to adam_update with bias correction in lrs
         def step(weights, states, grads, lrs, wds, rescale, hyper, clip):
@@ -295,22 +330,48 @@ class SGD(Optimizer):
     def fused_update_multi(self, indices, weights, grads, states) -> bool:
         from ..ndarray.sparse import BaseSparseNDArray
 
-        if self.multi_precision or any(
-                isinstance(g, BaseSparseNDArray) for g in grads):
+        if any(isinstance(g, BaseSparseNDArray) for g in grads):
             return False
+        mp = self.multi_precision and any(_is_lowp(w.dtype)
+                                          for w in weights)
+        if mp and not all(_is_lowp(w.dtype) for w in weights):
+            return False  # mixed precision trees take the per-param path
         has_state = self.momentum != 0.0
         for i in indices:
             self._update_count(i)
         lrs = [self._get_lr(i) for i in indices]
         wds = [self._get_wd(i) for i in indices]
+        clip = (self.clip_gradient
+                if self.clip_gradient is not None else 0.0)
+        if mp:
+            # states[i] = (fp32 master, momentum-or-None) from
+            # create_state_multi_precision
+            masters = [s[0] for s in states]
+            moms = [s[1] for s in states] if has_state else []
+            fn = _fused_step_fn("sgd_mp", len(indices), has_state,
+                                self.clip_gradient is not None,
+                                self._donate(),
+                                out_dtypes=tuple(str(w.dtype)
+                                                 for w in weights))
+            new_w32, new_s, new_w_out = fn(
+                [m._data for m in masters],
+                [m._data for m in moms] if has_state else [],
+                [g._data for g in grads], lrs, wds,
+                self.rescale_grad, self.momentum, clip)
+            for m, nw in zip(masters, new_w32):
+                m._set_jax(nw)
+            for w, nw in zip(weights, new_w_out):
+                w._set_jax(nw)
+            if has_state:
+                for s, ns in zip(moms, new_s):
+                    s._set_jax(ns)
+            return True
         fn = _fused_step_fn("sgd", len(indices), has_state,
                             self.clip_gradient is not None, self._donate())
         w_in = [w._data for w in weights]
         s_in = [s._data for s in states] if has_state else []
         new_w, new_s = fn(w_in, s_in, [g._data for g in grads], lrs, wds,
-                          self.rescale_grad, self.momentum,
-                          self.clip_gradient
-                          if self.clip_gradient is not None else 0.0)
+                          self.rescale_grad, self.momentum, clip)
         for w, nw in zip(weights, new_w):
             w._set_jax(nw)
         if has_state:

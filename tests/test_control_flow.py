@@ -1,0 +1,179 @@
+"""Control-flow op tests (reference
+`tests/python/unittest/test_contrib_control_flow.py`): foreach /
+while_loop / cond over NDArrays and Symbols, gradients, and an RNN
+trained through `foreach`."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, control_flow as cf, nd, sym
+from mxtpu.io.io import DataBatch, DataDesc, NDArrayIter
+
+
+def test_foreach_imperative_matches_numpy():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    outs, fin = cf.foreach(lambda x, s: (x + s, x + s), data,
+                           nd.zeros((4,)))
+    exp = np.cumsum(np.arange(12).reshape(3, 4), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), exp)
+    np.testing.assert_allclose(fin.asnumpy(), exp[-1])
+
+
+def test_foreach_symbolic_matches_imperative():
+    x = sym.var("x")
+    st = sym.var("st")
+    w = sym.var("w")
+    o, _ = cf.foreach(
+        lambda xt, s: (sym.dot(xt, w) + s, sym.dot(xt, w) + s), x, st)
+    ex = o.simple_bind(ctx=mx.cpu(), x=(3, 2, 2), st=(2, 2), w=(2, 2))
+    rng = np.random.RandomState(0)
+    xv = rng.randn(3, 2, 2).astype(np.float32)
+    wv = rng.randn(2, 2).astype(np.float32)
+    out = ex.forward(x=xv, st=np.zeros((2, 2), np.float32), w=wv)[0]
+
+    acc, outs = np.zeros((2, 2), np.float32), []
+    for t in range(3):
+        acc = xv[t] @ wv + acc
+        outs.append(acc)
+    np.testing.assert_allclose(out.asnumpy(), np.stack(outs), rtol=1e-5)
+
+
+def test_foreach_symbolic_gradient():
+    """Gradient flows through lax.scan and matches the imperative tape."""
+    rng = np.random.RandomState(1)
+    xv = rng.randn(4, 3).astype(np.float32)
+    wv = rng.randn(3, 3).astype(np.float32)
+
+    x = sym.var("x")
+    w = sym.var("w")
+    st = sym.var("st")
+    o, fin = cf.foreach(
+        lambda xt, s: (sym.dot(xt, w) + s,) * 2, x, st)
+    loss = sym.sum(fin)
+    ex = loss.simple_bind(ctx=mx.cpu(), x=(4, 3), st=(3,), w=(3, 3),
+                          grad_req={"w": "write", "x": "null",
+                                    "st": "null"})
+    ex.forward(is_train=True, x=xv, st=np.zeros(3, np.float32), w=wv)
+    ex.backward()
+    g_sym = ex.grad_dict["w"].asnumpy()
+
+    wn = nd.array(wv)
+    wn.attach_grad()
+    with autograd.record():
+        s = nd.zeros((3,))
+        for t in range(4):
+            s = nd.dot(nd.array(xv[t]), wn) + s
+        loss_i = s.sum()
+    loss_i.backward()
+    np.testing.assert_allclose(g_sym, wn.grad.asnumpy(), rtol=1e-4)
+
+
+def test_while_loop_symbolic_and_imperative():
+    i = sym.var("i")
+    acc = sym.var("acc")
+    outs, fin = cf.while_loop(
+        lambda i, a: i < 5,
+        lambda i, a: (i * 2, [i + 1, a + i]), [i, acc], max_iterations=8)
+    ex = outs[0].simple_bind(ctx=mx.cpu(), i=(1,), acc=(1,))
+    r = ex.forward(i=np.zeros(1, np.float32), acc=np.zeros(1, np.float32))
+    np.testing.assert_allclose(
+        r[0].asnumpy(),
+        np.array([0, 2, 4, 6, 8, 0, 0, 0], np.float32).reshape(8, 1))
+
+    o, fv = cf.while_loop(lambda i: i < 3,
+                          lambda i: (i * 10, [i + 1]),
+                          [nd.zeros((1,))], max_iterations=5)
+    np.testing.assert_allclose(o.asnumpy(), [[0], [10], [20], [0], [0]])
+    np.testing.assert_allclose(fv[0].asnumpy(), [3])
+
+
+def test_cond_symbolic_and_imperative():
+    p = sym.var("p")
+    a = sym.var("a")
+    b = sym.var("b")
+    c = cf.cond(p, lambda: a * 2, lambda: b + 1)
+    ex = c.simple_bind(ctx=mx.cpu(), p=(1,), a=(3,), b=(3,))
+    kw = dict(a=np.full(3, 2, np.float32), b=np.zeros(3, np.float32))
+    np.testing.assert_allclose(
+        ex.forward(p=np.ones(1, np.float32), **kw)[0].asnumpy(), [4, 4, 4])
+    np.testing.assert_allclose(
+        ex.forward(p=np.zeros(1, np.float32), **kw)[0].asnumpy(),
+        [1, 1, 1])
+
+    r = cf.cond(nd.ones((1,)), lambda: nd.ones((2,)) * 7,
+                lambda: nd.zeros((2,)))
+    np.testing.assert_allclose(r.asnumpy(), [7, 7])
+
+
+def test_rnn_via_foreach_trains():
+    """An Elman RNN classifier built with `foreach` trains end to end
+    through Module (the reference's foreach-RNN example,
+    `example/control_flow/`)."""
+    T, E, H, C, N = 6, 5, 16, 3, 48
+    rng = np.random.RandomState(0)
+    # sequences whose mean over time determines the class
+    y = rng.randint(0, C, N).astype(np.float32)
+    x = rng.randn(N, T, E).astype(np.float32) * 0.1
+    for n in range(N):
+        x[n, :, int(y[n])] += 1.0
+
+    data = sym.var("data")
+    xs = sym.transpose(data, axes=(1, 0, 2))     # [T, N, E]
+    h0 = sym.var("h0")
+
+    def cell(xt, h):
+        i2h = sym.FullyConnected(data=xt, num_hidden=H, name="i2h")
+        h2h = sym.FullyConnected(data=h, num_hidden=H, name="h2h")
+        hn = sym.Activation(data=i2h + h2h, act_type="tanh")
+        return hn, hn
+
+    _, h_last = cf.foreach(cell, xs, h0)
+    fc = sym.FullyConnected(data=h_last, num_hidden=C, name="out")
+    net = sym.SoftmaxOutput(data=fc, label=sym.var("softmax_label"),
+                            name="softmax")
+
+    it = NDArrayIter({"data": x, "h0": np.zeros((N, H), np.float32)},
+                     {"softmax_label": y}, batch_size=16,
+                     label_name="softmax_label")
+    mod = mx.mod.Module(net, data_names=("data", "h0"),
+                        label_names=("softmax_label",), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    metric = mx.metric.create("acc")
+    accs = []
+    for epoch in range(12):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        accs.append(metric.get()[1])
+    assert accs[-1] > 0.8, accs
+
+
+def test_foreach_batchnorm_aux_updates():
+    """Moving stats of a BatchNorm INSIDE a foreach body must update the
+    outer aux arrays (the reference's subgraph CachedOp mutates aux
+    in place)."""
+    x = sym.var("x")
+    st = sym.var("st")
+
+    def body(xt, s):
+        h = sym.BatchNorm(data=xt, name="bn", fix_gamma=False)
+        return h, s + 1
+
+    o, _ = cf.foreach(body, x, st)
+    ex = o.simple_bind(ctx=mx.cpu(), x=(4, 2, 3), st=(1,))
+    rng = np.random.RandomState(0)
+    xv = (rng.randn(4, 2, 3) * 3 + 5).astype(np.float32)
+    mm0 = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True, x=xv, st=np.zeros(1, np.float32))
+    mm1 = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert not np.allclose(mm0, mm1), "moving_mean did not update"
+    # inference after training uses the updated stats without error
+    out = ex.forward(is_train=False, x=xv, st=np.zeros(1, np.float32))[0]
+    assert np.isfinite(out.asnumpy()).all()

@@ -1,0 +1,50 @@
+"""End-to-end example-script tests (reference runs its examples in CI
+via `tests/nightly/test_image_classification.sh`).  Each script runs in
+a subprocess on the virtual 8-device CPU mesh with `--kv-store tpu` —
+the BASELINE.json north-star config of
+`examples/image-classification/train_imagenet.py`."""
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "examples", "image-classification")
+
+
+def _run(script, *extra, timeout=560):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    cmd = [sys.executable, os.path.join(SCRIPTS, script)] + list(extra)
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout,
+                       env=env, cwd=REPO)
+    assert r.returncode == 0, "rc=%d\nstdout:%s\nstderr:%s" % (
+        r.returncode, r.stdout[-2000:], r.stderr[-2000:])
+    return r.stdout + r.stderr
+
+
+def test_train_imagenet_kvstore_tpu_8dev():
+    out = _run("train_imagenet.py", "--benchmark", "1", "--num-epochs", "1",
+               "--max-batches", "4", "--batch-size", "16",
+               "--image-shape", "3,32,32", "--num-classes", "16",
+               "--num-examples", "64", "--num-layers", "18",
+               "--kv-store", "tpu", "--disp-batches", "2")
+    assert "Train-accuracy" in out
+    assert re.search(r"devices: \[.*\(0\).*\(7\)\]", out), out[-800:]
+
+
+def test_train_cifar10_bf16_checkpoint_resume(tmp_path):
+    prefix = str(tmp_path / "ck")
+    common = ["--benchmark", "1", "--max-batches", "4",
+              "--batch-size", "16", "--image-shape", "3,16,16",
+              "--num-classes", "8", "--num-examples", "64",
+              "--network", "mlp", "--dtype", "bfloat16",
+              "--kv-store", "device", "--model-prefix", prefix]
+    out = _run("train_cifar10.py", "--num-epochs", "1", *common)
+    assert "Train-accuracy" in out
+    assert os.path.exists(prefix + "-0001.params"), out[-800:]
+    # resume from epoch 1
+    out2 = _run("train_cifar10.py", "--num-epochs", "2",
+                "--load-epoch", "1", *common)
+    assert "Epoch[1]" in out2

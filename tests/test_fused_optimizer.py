@@ -72,3 +72,28 @@ def test_fused_respects_lr_schedule():
         upd_b.update_multi(list(zip(range(len(ws2)), gs, ws2)))
     for wa, wb in zip(ws, ws2):
         np.testing.assert_allclose(wa.asnumpy(), wb.asnumpy(), rtol=2e-6)
+
+
+def test_fused_mp_sgd_bf16_weights():
+    """multi_precision SGD on bfloat16 weights: fused path keeps fp32
+    masters, weights STAY bf16 (reference mp_sgd_update casts back to
+    the weight's type), and the trajectory tracks an fp32 run."""
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(8, 4).astype(np.float32)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.array(w0).astype("bfloat16")
+    for step in range(5):
+        g = mx.nd.array(rng.randn(8, 4).astype(np.float32))
+        upd.update_multi([(0, g.astype("bfloat16"), w)])
+        assert np.dtype(w.dtype) == bf16, w.dtype
+    # master copy must exist and be fp32
+    master = upd.states[0][0]
+    assert np.dtype(master.dtype) == np.float32
+    np.testing.assert_allclose(master.asnumpy(),
+                               w.asnumpy().astype(np.float32),
+                               rtol=0.02, atol=0.02)
