@@ -1,0 +1,312 @@
+"""Legacy vision ops: spatial sampling (GridGenerator / BilinearSampler /
+SpatialTransformer), Correlation, and the SSD training/inference heads
+(MultiBoxTarget / MultiBoxDetection).
+
+References:
+  * `src/operator/grid_generator-inl.h` (affine/warp grid)
+  * `src/operator/bilinear_sampler-inl.h`
+  * `src/operator/spatial_transformer-inl.h`
+  * `src/operator/correlation-inl.h` (FlowNet correlation layer)
+  * `src/operator/contrib/multibox_target.cc` / `multibox_detection.cc`
+
+TPU-native style: everything is vectorized gathers/masks + reduce_window
+(no per-pixel scalar loops), so XLA tiles the work onto the vector/MXU
+units and the ops stay differentiable where the reference's are.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+from .contrib import _iou_matrix
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# grid generation + bilinear sampling
+# ---------------------------------------------------------------------------
+
+
+@register("GridGenerator")
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """affine: data (N, 6) -> grid (N, 2, H, W) of normalized (x, y)
+    sample coords; warp: data (N, 2, H, W) pixel flow -> grid."""
+    jnp = _jnp()
+    if transform_type == "affine":
+        h, w = int(target_shape[0]), int(target_shape[1])
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        tgt = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+        theta = data.reshape(-1, 2, 3).astype(jnp.float32)
+        grid = jnp.einsum("nij,jk->nik", theta, tgt)             # (N,2,HW)
+        return grid.reshape(-1, 2, h, w).astype(data.dtype)
+    # warp: pixel-space flow added to the identity grid, then normalized
+    n, _, h, w = data.shape
+    xs = jnp.arange(w, dtype=jnp.float32)
+    ys = jnp.arange(h, dtype=jnp.float32)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    fx = data[:, 0].astype(jnp.float32) + gx[None]
+    fy = data[:, 1].astype(jnp.float32) + gy[None]
+    nx = 2.0 * fx / max(w - 1, 1) - 1.0
+    ny = 2.0 * fy / max(h - 1, 1) - 1.0
+    return jnp.stack([nx, ny], axis=1).astype(data.dtype)
+
+
+def _bilinear_sample(jnp, data, grid_x, grid_y):
+    """data (N,C,H,W); grid_x/y (N,Ho,Wo) in [-1,1]; zero padding
+    outside (reference `bilinear_sampler-inl.h` between-sampling)."""
+    n, c, h, w = data.shape
+    x = (grid_x.astype(jnp.float32) + 1.0) * (w - 1) / 2.0
+    y = (grid_y.astype(jnp.float32) + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    def gather(yy, xx):
+        inside = ((xx >= 0) & (xx <= w - 1) & (yy >= 0) & (yy <= h - 1))
+        xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        # (N,C,Ho,Wo) gather: index per batch
+        v = jnp.take_along_axis(
+            data.reshape(n, c, h * w),
+            (yi * w + xi).reshape(n, 1, -1).astype(jnp.int32)
+            .repeat(c, axis=1), axis=2).reshape(n, c, *xx.shape[1:])
+        return jnp.where(inside[:, None], v.astype(jnp.float32), 0.0)
+
+    out = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+           + gather(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+           + gather(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+           + gather(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+    return out.astype(data.dtype)
+
+
+@register("BilinearSampler")
+def _bilinear_sampler(data, grid, cudnn_off=False):
+    """data (N,C,H,W), grid (N,2,Ho,Wo) normalized -> (N,C,Ho,Wo)."""
+    jnp = _jnp()
+    return _bilinear_sample(jnp, data, grid[:, 0], grid[:, 1])
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine",
+                         sampler_type="bilinear", cudnn_off=False):
+    """Affine spatial transformer = GridGenerator(affine) +
+    BilinearSampler (reference `spatial_transformer-inl.h`)."""
+    jnp = _jnp()
+    grid = _grid_generator(loc, transform_type="affine",
+                           target_shape=target_shape)
+    return _bilinear_sample(jnp, data, grid[:, 0], grid[:, 1])
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet)
+# ---------------------------------------------------------------------------
+
+
+@register("Correlation")
+def _correlation(data1, data2, kernel_size=1, max_displacement=1,
+                 stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """Patch cross-correlation of two feature maps
+    (reference `correlation-inl.h`): one output channel per displacement
+    in the (2*max_displacement/stride2+1)^2 neighborhood, each the
+    kernel_size-window mean of (x1*x2) (or |x1-x2|)."""
+    import jax
+
+    jnp = _jnp()
+    n, c, h, w = data1.shape
+    kr = (kernel_size - 1) // 2
+    bsz = max_displacement + kr
+    d = 2 * (max_displacement // stride2) + 1
+    ph, pw = h + 2 * pad_size, w + 2 * pad_size
+    out_h = int(np.ceil((ph - 2 * bsz) / float(stride1)))
+    out_w = int(np.ceil((pw - 2 * bsz) / float(stride1)))
+
+    pad_spec = ((0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size))
+    p1 = jnp.pad(data1.astype(jnp.float32), pad_spec)
+    p2 = jnp.pad(data2.astype(jnp.float32), pad_spec)
+
+    outs = []
+    for dy in range(-(max_displacement // stride2) * stride2,
+                    (max_displacement // stride2) * stride2 + 1, stride2):
+        for dx in range(-(max_displacement // stride2) * stride2,
+                        (max_displacement // stride2) * stride2 + 1,
+                        stride2):
+            shifted = jnp.roll(p2, shift=(-dy, -dx), axis=(2, 3))
+            prod = p1 * shifted if is_multiply else jnp.abs(p1 - shifted)
+            # mean over channels + kernel window
+            s = prod.sum(axis=1, keepdims=True)                # (N,1,ph,pw)
+            win = jax.lax.reduce_window(
+                s, 0.0, jax.lax.add, (1, 1, kernel_size, kernel_size),
+                (1, 1, 1, 1), "SAME")
+            # top-left of each output cell: offset bsz, stride1
+            ys = bsz + stride1 * jnp.arange(out_h)
+            xs = bsz + stride1 * jnp.arange(out_w)
+            outs.append(win[:, 0][:, ys][:, :, xs])
+    out = jnp.stack(outs, axis=1) / (kernel_size * kernel_size * c)
+    return out.astype(data1.dtype)
+
+
+# ---------------------------------------------------------------------------
+# SSD heads: MultiBoxTarget / MultiBoxDetection
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_MultiBoxTarget", num_outputs=3, differentiable=False,
+          aliases=("MultiBoxTarget",))
+def _multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground-truth boxes (reference
+    `multibox_target.cc`): per-anchor best-IOU matching plus per-gt best
+    anchor forcing; returns (box_target (N, A*4), box_mask (N, A*4),
+    cls_target (N, A)) with cls 0 = background, gt class + 1 otherwise.
+
+    labels: (N, O, 5) rows [cls, x1, y1, x2, y2], cls = -1 padding."""
+    jnp = _jnp()
+    a = anchors.reshape(-1, 4)                                 # (A, 4)
+    A = a.shape[0]
+    n, o, _ = labels.shape
+    var = jnp.asarray(variances, jnp.float32)
+
+    aw = jnp.maximum(a[:, 2] - a[:, 0], 1e-12)
+    ah = jnp.maximum(a[:, 3] - a[:, 1], 1e-12)
+    acx = (a[:, 0] + a[:, 2]) / 2
+    acy = (a[:, 1] + a[:, 3]) / 2
+
+    def one(lab):
+        valid = lab[:, 0] >= 0                                  # (O,)
+        iou = _iou_matrix(jnp, a, lab[:, 1:5], "corner")        # (A, O)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)                       # (A,)
+        best_iou = jnp.take_along_axis(iou, best_gt[:, None],
+                                       1)[:, 0]
+        matched = best_iou >= overlap_threshold
+        # force-match: each valid gt claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)                   # (O,)
+        forced = jnp.zeros((A,), bool).at[best_anchor].set(valid)
+        forced_gt = jnp.zeros((A,), jnp.int32).at[best_anchor].set(
+            jnp.where(valid, jnp.arange(o), 0))
+        gt_idx = jnp.where(forced, forced_gt, best_gt)
+        pos = matched | forced
+
+        g = lab[gt_idx]                                         # (A, 5)
+        gw = jnp.maximum(g[:, 3] - g[:, 1], 1e-12)
+        gh = jnp.maximum(g[:, 4] - g[:, 2], 1e-12)
+        gcx = (g[:, 1] + g[:, 3]) / 2
+        gcy = (g[:, 2] + g[:, 4]) / 2
+        tx = (gcx - acx) / aw / var[0]
+        ty = (gcy - acy) / ah / var[1]
+        tw = jnp.log(gw / aw) / var[2]
+        th = jnp.log(gh / ah) / var[3]
+        bt = jnp.stack([tx, ty, tw, th], axis=1)                # (A, 4)
+        bt = jnp.where(pos[:, None], bt, 0.0)
+        bm = jnp.where(pos[:, None], 1.0, 0.0) * jnp.ones((A, 4))
+        ct = jnp.where(pos, g[:, 0] + 1.0, 0.0)
+        return bt.reshape(-1), bm.reshape(-1), ct
+
+    import jax
+
+    bt, bm, ct = jax.vmap(one)(labels.astype(jnp.float32))
+    return (bt.astype(anchors.dtype), bm.astype(anchors.dtype),
+            ct.astype(anchors.dtype))
+
+
+@register("_contrib_MultiBoxDetection", differentiable=False,
+          aliases=("MultiBoxDetection",))
+def _multibox_detection(cls_prob, loc_pred, anchors, clip=True,
+                        threshold=0.01, background_id=0,
+                        nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode box regressions against anchors + per-class greedy NMS
+    (reference `multibox_detection.cc`).  Returns (N, A, 6) rows
+    [cls_id, score, x1, y1, x2, y2], suppressed rows -1-filled."""
+    import jax
+
+    jnp = _jnp()
+    a = anchors.reshape(-1, 4).astype(jnp.float32)
+    A = a.shape[0]
+    var = jnp.asarray(variances, jnp.float32)
+
+    aw = jnp.maximum(a[:, 2] - a[:, 0], 1e-12)
+    ah = jnp.maximum(a[:, 3] - a[:, 1], 1e-12)
+    acx = (a[:, 0] + a[:, 2]) / 2
+    acy = (a[:, 1] + a[:, 3]) / 2
+
+    def one(probs, loc):
+        # probs (C+1, A), loc (A*4,)
+        l = loc.reshape(A, 4).astype(jnp.float32)
+        cx = l[:, 0] * var[0] * aw + acx
+        cy = l[:, 1] * var[1] * ah + acy
+        bw = jnp.exp(l[:, 2] * var[2]) * aw
+        bh = jnp.exp(l[:, 3] * var[3]) * ah
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2, cy + bh / 2], axis=1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        masked = probs.at[background_id].set(-1.0)
+        cls_id = jnp.argmax(masked, axis=0).astype(jnp.float32)
+        score = masked.max(axis=0)
+        keep = score > threshold
+        cls_id = jnp.where(keep, cls_id - (cls_id > background_id),
+                           -1.0)
+        score = jnp.where(keep, score, -1.0)
+
+        # greedy NMS, score-descending, same-class unless force_suppress
+        order = jnp.argsort(-score)
+        cls_s, score_s, box_s = cls_id[order], score[order], boxes[order]
+        iou = _iou_matrix(jnp, box_s, box_s, "corner")
+
+        def body(i, alive):
+            valid_i = alive[i] & (score_s[i] >= 0)
+            same = (cls_s == cls_s[i]) | force_suppress
+            kill = (iou[i] > nms_threshold) & same \
+                & (jnp.arange(A) > i) & valid_i
+            return alive & ~kill
+
+        alive = jax.lax.fori_loop(0, A, body,
+                                  score_s >= 0)
+        cls_o = jnp.where(alive, cls_s, -1.0)
+        score_o = jnp.where(alive, score_s, -1.0)
+        box_o = jnp.where(alive[:, None], box_s, -1.0)
+        return jnp.concatenate([cls_o[:, None], score_o[:, None], box_o],
+                               axis=1)
+
+    out = jax.vmap(one)(cls_prob.astype(jnp.float32),
+                        loc_pred.astype(jnp.float32))
+    return out.astype(cls_prob.dtype)
+
+
+# ---------------------------------------------------------------------------
+# storage casts (dense graph forms; the sparse NDArray layer handles the
+# imperative sparse conversions — `mxtpu/ndarray/sparse.py`)
+# ---------------------------------------------------------------------------
+
+
+@register("cast_storage")
+def _cast_storage(data, stype="default"):
+    """In the compiled graph every array is dense XLA storage; stype
+    tracking lives on the NDArray wrapper (reference
+    `src/operator/tensor/cast_storage.cc`)."""
+    return data
+
+
+@register("_sparse_retain")
+def _sparse_retain_op(data, indices):
+    """Dense graph form of row retention: rows NOT in `indices` are
+    zeroed (reference `sparse_retain.cc` on row_sparse inputs)."""
+    jnp = _jnp()
+    rows = jnp.arange(data.shape[0])
+    keep = (rows[:, None] == indices.astype(rows.dtype)[None, :]).any(1)
+    return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)),
+                     data, jnp.zeros((), data.dtype))
