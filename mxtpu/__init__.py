@@ -71,6 +71,8 @@ from . import module as mod
 from . import rnn
 from . import image
 from . import gluon
+from . import fused_train
+from .fused_train import FusedTrainLoop
 
 
 def tpu_count():

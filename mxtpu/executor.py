@@ -143,6 +143,11 @@ class Executor(object):
         self._diff_idx = [i for i, r in enumerate(grad_req) if r != "null"]
         self._has_rng = any((not n.is_variable) and n.op.needs_rng
                             for n in _topo_order(symbol._outputs))
+        from . import amp as _amp
+
+        # remembered so fused_train can rebuild the graph fn under the
+        # SAME compute-dtype policy this executor was bound with
+        self._amp_dtype = _amp.get_compute_dtype()
 
         infer_fn = _build_graph_fn(symbol, self._arg_names, self._aux_names,
                                    is_train=False)

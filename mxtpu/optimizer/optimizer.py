@@ -133,18 +133,21 @@ class Optimizer(object):
             self.num_update = max(self._index_update_count[idx],
                                   self.num_update)
 
+    def _get_lr_mult(self, index):
+        if index in self.param_dict:
+            return self.param_dict[index].lr_mult
+        if index in self.lr_mult:
+            return self.lr_mult[index]
+        if index in self.idx2name:
+            return self.lr_mult.get(self.idx2name[index], 1.0)
+        return 1.0
+
     def _get_lr(self, index):
         if self.lr_scheduler is not None:
             lr = self.lr_scheduler(self.num_update)
         else:
             lr = self.lr
-        if index in self.param_dict:
-            lr *= self.param_dict[index].lr_mult
-        elif index in self.lr_mult:
-            lr *= self.lr_mult[index]
-        elif index in self.idx2name:
-            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lr
+        return lr * self._get_lr_mult(index)
 
     def _get_wd(self, index):
         wd = self.wd
@@ -168,6 +171,36 @@ class Optimizer(object):
         falls back to per-param update)."""
         return False
 
+    def make_scan_step(self, indices, weights) -> Optional["ScanStep"]:
+        """Return a pure-functional whole-tree step usable INSIDE a
+        compiled multi-step training program (`mxtpu.fused_train`), or
+        None when this optimizer has no such form.  Unlike
+        `fused_update_multi` (host-driven, one dispatch per call), the
+        ScanStep is traced into the SAME XLA module as forward+backward
+        so K optimizer steps ride one device dispatch."""
+        return None
+
+    def _sched_counts(self, indices, k_steps):
+        """Simulate `k_steps` whole-tree `_update_count` advances WITHOUT
+        mutating real counters; yields (per-index count dict, num_update)
+        per step — the inputs schedulers/bias-correction need."""
+        counts = dict(self._index_update_count)
+        num_update = self.num_update
+        out = []
+        for _ in range(k_steps):
+            for idx in indices:
+                c = counts.get(idx, self.begin_num_update) + 1
+                counts[idx] = c
+                num_update = max(c, num_update)
+            out.append((dict(counts), num_update))
+        return out
+
+    def commit_scan_steps(self, indices, k_steps):
+        """Advance the real update counters after a multi-step program
+        ran `k_steps` whole-tree updates."""
+        for _ in range(k_steps):
+            self._update_count(list(indices))
+
     @staticmethod
     def _donate() -> bool:
         import jax
@@ -185,6 +218,31 @@ class Optimizer(object):
 
 register = Optimizer.register
 create = Optimizer.create_optimizer
+
+
+class ScanStep(object):
+    """Pure-functional whole-tree optimizer step for compiled multi-step
+    training (`mxtpu/fused_train.py`).
+
+    Fields:
+      * ``pack_states(state_objs)``  -> jnp pytree from updater states
+      * ``init_states(w_vals)``      -> zero-state pytree (fresh start)
+      * ``step(w, s, g, lr_row)``    -> (new_w, new_s); traceable, applied
+        inside lax.scan — ``lr_row`` is this step's (n,) effective-lr row
+      * ``host_sched(k)``            -> np.float32 (k, n) effective lrs,
+        computed host-side with NO counter mutation (exact scheduler +
+        bias-correction semantics per step)
+      * ``writeback_states(state_objs, new_s)`` -> copy the final state
+        pytree back into the updater's NDArrays
+    """
+
+    def __init__(self, pack_states, init_states, step, host_sched,
+                 writeback_states):
+        self.pack_states = pack_states
+        self.init_states = init_states
+        self.step = step
+        self.host_sched = host_sched
+        self.writeback_states = writeback_states
 
 
 # ---------------------------------------------------------------------------
@@ -379,6 +437,60 @@ class SGD(Optimizer):
                 s._set_jax(ns)
         return True
 
+    def make_scan_step(self, indices, weights):
+        if self.multi_precision and any(_is_lowp(w.dtype) for w in weights):
+            return None  # mp trees keep the host-fused path
+        n = len(indices)
+        momentum = self.momentum
+        has_state = momentum != 0.0
+        clip = self.clip_gradient
+        rescale = self.rescale_grad
+        wds = [self._get_wd(i) for i in indices]
+
+        def pack_states(state_objs):
+            return [s._data for s in state_objs] if has_state else []
+
+        def init_states(w_vals):
+            import jax.numpy as jnp
+
+            return [jnp.zeros_like(w) for w in w_vals] if has_state else []
+
+        def step(w_list, s_list, g_list, lr_row):
+            import jax.numpy as jnp
+
+            new_w, new_s = [], []
+            for i in range(n):
+                w = w_list[i]
+                g = g_list[i].astype(w.dtype) * rescale
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                lr = lr_row[i].astype(w.dtype)  # keep carry dtype stable
+                if has_state:
+                    m = momentum * s_list[i] - lr * (g + wds[i] * w)
+                    new_s.append(m)
+                    new_w.append(w + m)
+                else:
+                    new_w.append(w - lr * (g + wds[i] * w))
+            return new_w, new_s
+
+        def host_sched(k_steps):
+            out = np.empty((k_steps, n), np.float32)
+            for k, (_, num_update) in enumerate(
+                    self._sched_counts(indices, k_steps)):
+                base = (self.lr_scheduler(num_update)
+                        if self.lr_scheduler is not None else self.lr)
+                for j, idx in enumerate(indices):
+                    out[k, j] = base * self._get_lr_mult(idx)
+            return out
+
+        def writeback_states(state_objs, new_s):
+            if has_state:
+                for s, ns in zip(state_objs, new_s):
+                    s._set_jax(ns)
+
+        return ScanStep(pack_states, init_states, step, host_sched,
+                        writeback_states)
+
 
 @register
 class Signum(Optimizer):
@@ -555,6 +667,68 @@ class Adam(Optimizer):
             s[0]._set_jax(nm)
             s[1]._set_jax(nv)
         return True
+
+    def make_scan_step(self, indices, weights):
+        if self.multi_precision:
+            return None
+        n = len(indices)
+        beta1, beta2, epsilon = self.beta1, self.beta2, self.epsilon
+        clip = self.clip_gradient
+        rescale = self.rescale_grad
+        wds = [self._get_wd(i) for i in indices]
+
+        def pack_states(state_objs):
+            return ([s[0]._data for s in state_objs],
+                    [s[1]._data for s in state_objs])
+
+        def init_states(w_vals):
+            import jax.numpy as jnp
+
+            return ([jnp.zeros_like(w) for w in w_vals],
+                    [jnp.zeros_like(w) for w in w_vals])
+
+        def step(w_list, s_tree, g_list, lr_row):
+            import jax.numpy as jnp
+
+            means, variances = s_tree
+            new_w, new_m, new_v = [], [], []
+            for i in range(n):
+                w = w_list[i]
+                g = g_list[i].astype(w.dtype) * rescale
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                g = g + wds[i] * w
+                m = beta1 * means[i] + (1.0 - beta1) * g
+                v = beta2 * variances[i] + (1.0 - beta2) * jnp.square(g)
+                new_m.append(m)
+                new_v.append(v)
+                lr = lr_row[i].astype(w.dtype)  # keep carry dtype stable
+                new_w.append(w - lr * m / (jnp.sqrt(v) + epsilon))
+            return new_w, (new_m, new_v)
+
+        def host_sched(k_steps):
+            # bias correction folded into the effective lr, exactly as
+            # the per-step `update` does with the per-index count t
+            out = np.empty((k_steps, n), np.float32)
+            for k, (counts, num_update) in enumerate(
+                    self._sched_counts(indices, k_steps)):
+                base = (self.lr_scheduler(num_update)
+                        if self.lr_scheduler is not None else self.lr)
+                for j, idx in enumerate(indices):
+                    t = counts[idx]
+                    out[k, j] = (base * self._get_lr_mult(idx) *
+                                 math.sqrt(1.0 - beta2 ** t) /
+                                 (1.0 - beta1 ** t))
+            return out
+
+        def writeback_states(state_objs, new_s):
+            new_m, new_v = new_s
+            for s, nm, nv in zip(state_objs, new_m, new_v):
+                s[0]._set_jax(nm)
+                s[1]._set_jax(nv)
+
+        return ScanStep(pack_states, init_states, step, host_sched,
+                        writeback_states)
 
 
 @register
