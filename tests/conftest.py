@@ -8,6 +8,12 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# CPU-only test subprocesses (kvstore launcher, example scripts) must not
+# dial the TPU tunnel at interpreter start — the pool sitecustomize keys
+# on this var, and a busy/cold tunnel turns every child's startup into
+# minutes.  Clearing it here only affects children; this process's
+# sitecustomize already ran.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

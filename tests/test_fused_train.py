@@ -1,0 +1,159 @@
+"""FusedTrainLoop (K steps per dispatch) must match the per-step path.
+
+The reference amortizes per-op scheduling with engine bulking
+(`src/engine/threaded_engine.h:411-426`); the TPU analog scans K whole
+train steps into one donated XLA program (`mxtpu/fused_train.py`).
+Semantic equivalence — params, optimizer state, BN moving stats, lr
+schedule advance — is the contract these tests pin down.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import sym
+from mxtpu.io.io import DataBatch
+
+
+def _make_module(seed, optimizer="sgd", opt_params=None, batch=8):
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    x = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    x = sym.BatchNorm(data=x, name="bn1")
+    x = sym.Activation(data=x, act_type="relu")
+    x = sym.FullyConnected(data=x, num_hidden=4, name="fc2")
+    out = sym.SoftmaxOutput(data=x, label=label, name="softmax")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (batch, 10))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                                      magnitude=2.0),
+                    force_init=True)
+    # deterministic identical init across modules
+    rng = np.random.RandomState(seed)
+    args, auxs = mod.get_params()
+    new_args = {k: mx.nd.array(rng.randn(*v.shape).astype(np.float32) * 0.1)
+                for k, v in sorted(args.items())}
+    mod.set_params(new_args, auxs, force_init=True)
+    mod.init_optimizer(optimizer=optimizer,
+                       optimizer_params=dict(opt_params or
+                                             {"learning_rate": 0.05}))
+    return mod
+
+
+def _batches(n, batch=8, seed=3):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        d = mx.nd.array(rng.randn(batch, 10).astype(np.float32))
+        l = mx.nd.array(rng.randint(0, 4, (batch,)).astype(np.float32))
+        out.append(DataBatch(data=[d], label=[l]))
+    return out
+
+
+def _run_per_step(mod, batches):
+    for b in batches:
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+
+
+@pytest.mark.parametrize("optimizer,opt_params,tol", [
+    ("sgd", {"learning_rate": 0.05}, 2e-5),
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}, 2e-5),
+    # Adam divides by sqrt(v)+eps with v near zero early in training, so
+    # fp reassociation between the scanned and per-step XLA programs
+    # compounds faster (a single step matches to ~1e-7) — wider tol
+    ("adam", {"learning_rate": 0.01, "wd": 1e-4}, 2e-4),
+])
+def test_fused_matches_per_step(optimizer, opt_params, tol):
+    K = 3
+    batches = _batches(2 * K)
+    mod_a = _make_module(7, optimizer, opt_params)
+    mod_b = _make_module(7, optimizer, opt_params)
+
+    _run_per_step(mod_a, batches)
+
+    loop = mx.FusedTrainLoop(mod_b, steps_per_program=K)
+    loop.run(batches[:K])
+    loop.run(batches[K:])
+
+    args_a, aux_a = mod_a.get_params()
+    args_b, aux_b = mod_b.get_params()
+    for name in args_a:
+        np.testing.assert_allclose(args_a[name].asnumpy(),
+                                   args_b[name].asnumpy(),
+                                   rtol=tol, atol=tol, err_msg=name)
+    # BatchNorm moving stats advanced per scanned step, not once per chunk
+    for name in aux_a:
+        np.testing.assert_allclose(aux_a[name].asnumpy(),
+                                   aux_b[name].asnumpy(),
+                                   rtol=tol, atol=tol, err_msg=name)
+
+
+def test_fused_lr_schedule_advances_per_step():
+    """The scheduler must see every scanned step, not one per program."""
+    from mxtpu.lr_scheduler import FactorScheduler
+
+    K = 4
+    # FactorScheduler is stateful — each module needs its own instance
+    def opt_params():
+        return {"learning_rate": 0.1,
+                "lr_scheduler": FactorScheduler(step=2, factor=0.5)}
+    batches = _batches(K)
+    mod_a = _make_module(11, "sgd", opt_params())
+    mod_b = _make_module(11, "sgd", opt_params())
+
+    _run_per_step(mod_a, batches)
+    mx.FusedTrainLoop(mod_b, steps_per_program=K).run(batches)
+
+    args_a, _ = mod_a.get_params()
+    args_b, _ = mod_b.get_params()
+    for name in args_a:
+        np.testing.assert_allclose(args_a[name].asnumpy(),
+                                   args_b[name].asnumpy(),
+                                   rtol=2e-5, atol=2e-5, err_msg=name)
+    assert mod_a._optimizer.num_update == mod_b._optimizer.num_update
+
+
+def test_fused_outputs_stacked_and_switchable():
+    """Collected outputs are (K, ...) stacks matching per-step outputs,
+    and per-step training continues seamlessly after a fused chunk."""
+    K = 2
+    batches = _batches(K + 1)
+    mod_a = _make_module(5)
+    mod_b = _make_module(5)
+
+    outs_a = []
+    for b in batches[:K]:
+        mod_a.forward(b, is_train=True)
+        outs_a.append(mod_a.get_outputs()[0].asnumpy())
+        mod_a.backward()
+        mod_a.update()
+
+    loop = mx.FusedTrainLoop(mod_b, steps_per_program=K)
+    stacked = loop.run(batches[:K])
+    assert stacked[0].shape == (K,) + outs_a[0].shape
+    for k in range(K):
+        np.testing.assert_allclose(stacked[0].asnumpy()[k], outs_a[k],
+                                   rtol=2e-5, atol=2e-5)
+
+    # hand the module back to the per-step path: states must be current
+    _run_per_step(mod_a, batches[K:])
+    _run_per_step(mod_b, batches[K:])
+    args_a, _ = mod_a.get_params()
+    args_b, _ = mod_b.get_params()
+    for name in args_a:
+        np.testing.assert_allclose(args_a[name].asnumpy(),
+                                   args_b[name].asnumpy(),
+                                   rtol=2e-5, atol=2e-5, err_msg=name)
+
+
+def test_fused_rejects_unsupported():
+    mod = _make_module(1)
+    with pytest.raises(mx.MXNetError):
+        mx.FusedTrainLoop(mod, steps_per_program=0)
+    mod2 = _make_module(1, optimizer="rmsprop",
+                        opt_params={"learning_rate": 0.01})
+    with pytest.raises(mx.MXNetError):
+        mx.FusedTrainLoop(mod2)
