@@ -126,3 +126,44 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+class PooledBuffer(object):
+    """A host byte buffer drawn from the native storage pool
+    (`src/storage.cc` size-bucketed free-lists — the reference's
+    `storage::CPUDeviceStorage` pooling, GPUPooledStorageManager analog
+    `src/storage/pooled_storage_manager.h`).  Used by the IO path to
+    stage recordio payloads without a malloc per record.
+
+    Returns memory to the pool on `release()` (or GC).  Use
+    `memoryview(buf)` / `buf.view` for zero-copy reads into it.
+    """
+
+    __slots__ = ("_ptr", "_size", "view")
+
+    def __init__(self, size: int):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native runtime not built")
+        self._size = int(size)
+        self._ptr = lib.MXTPUStorageAlloc(self._size)
+        if not self._ptr:
+            raise MemoryError("MXTPUStorageAlloc(%d) failed" % size)
+        self.view = (ctypes.c_char * self._size).from_address(self._ptr)
+
+    def release(self):
+        if self._ptr:
+            lib = get_lib()
+            if lib is not None:
+                lib.MXTPUStorageFree(ctypes.c_void_p(self._ptr), self._size)
+            self._ptr = None
+            self.view = None
+
+    def __len__(self):
+        return self._size
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass

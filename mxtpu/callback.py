@@ -50,45 +50,54 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer(object):
-    """Log throughput and metrics every `frequent` batches (reference
-    `callback.py:120`)."""
+    """Batch-end callback logging samples/sec (and, optionally, the
+    running metric values) once every `frequent` batches.
+
+    Behavioral spec per reference `python/mxnet/callback.py:120`: the
+    rate covers the batches since the previous report, the metric is
+    optionally reset after each report so values are per-window, and a
+    batch counter that moved backwards (new epoch) restarts the timing
+    window.  Implementation is window-accounted on a monotonic clock —
+    it reports a correct rate even when the callback is invoked on a
+    different cadence than `frequent` (e.g. resumed mid-epoch).
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
-        self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self.frequent = max(1, int(frequent))
         self.auto_reset = auto_reset
+        self._window_start = None   # monotonic ts of window begin
+        self._window_batches = 0    # batches accumulated in the window
+        self._prev_nbatch = None
+
+    def _restart_window(self):
+        self._window_start = time.monotonic()
+        self._window_batches = 0
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                try:
-                    speed = self.frequent * self.batch_size / \
-                        (time.time() - self.tic)
-                except ZeroDivisionError:
-                    speed = float("inf")
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                        param.epoch, count, speed)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        nbatch = param.nbatch
+        if self._window_start is None or self._prev_nbatch is None \
+                or nbatch < self._prev_nbatch:
+            # first call, or the batch counter wrapped (new epoch)
+            self._prev_nbatch = nbatch
+            self._restart_window()
+            return
+        self._window_batches += max(0, nbatch - self._prev_nbatch)
+        self._prev_nbatch = nbatch
+        if nbatch % self.frequent != 0 or self._window_batches == 0:
+            return
+        elapsed = time.monotonic() - self._window_start
+        rate = (self._window_batches * self.batch_size / elapsed
+                if elapsed > 0 else float("inf"))
+        parts = ["Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                 % (param.epoch, nbatch, rate)]
+        if param.eval_metric is not None:
+            for name, value in param.eval_metric.get_name_value():
+                parts.append("%s=%f" % (name, value))
+            if self.auto_reset:
+                param.eval_metric.reset()
+        logging.info("\t".join(parts))
+        self._restart_window()
 
 
 class ProgressBar(object):

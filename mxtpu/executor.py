@@ -13,8 +13,12 @@ graph to jitted XLA computations (the BASELINE.json north star):
                activation is recomputed.  `forward(is_train=True)` runs
                the fused step with default ones head-gradients (the
                reference seeds ograds with ones too — imperative.cc:302),
-               and `backward()` publishes the cached grads; explicit
-               `backward(out_grads)` re-runs the step with those.
+               and `backward()` publishes the cached grads.  Explicit
+               `backward(out_grads)` flips the executor into a split
+               fwd/vjp mode: forward returns outputs plus the vjp
+               pullback (a jit-returnable pytree holding the residuals),
+               and backward applies the cached closure — the forward is
+               never recomputed.
 
 Gradient bookkeeping (grad_req write/add/null per arg) matches
 `python/mxnet/executor.py`; PlanMemory/inplace passes have no analog —
@@ -184,6 +188,35 @@ class Executor(object):
         self._jit_fwd_train = jax.jit(fwd_train_only)
         self._cached_grads = None
 
+        # explicit-ograd support: forward returns outputs PLUS the vjp
+        # pullback (a jit-returnable pytree closing over the residuals),
+        # so backward(out_grads) applies the cached closure instead of
+        # re-running the whole fused step (2x compute).  Only engaged
+        # once a caller actually passes out_grads — the default ones-
+        # ograd path stays ONE fused dispatch per step.
+        def fwd_vjp(arg_vals, aux_vals, key):
+            diff_vals = [arg_vals[i] for i in diff_idx]
+
+            def f(dvals):
+                full = list(arg_vals)
+                for i, v in zip(diff_idx, dvals):
+                    full[i] = v
+                return train_fn(full, aux_vals, key)
+
+            (outs, aux_new), vjp = jax.vjp(f, diff_vals)
+            return outs, aux_new, vjp
+
+        def apply_vjp(vjp, ograds, aux_new):
+            zero_aux = [jax.numpy.zeros_like(a) for a in aux_new]
+            (dgrads,) = vjp((list(ograds), zero_aux))
+            return dgrads
+
+        self._jit_fwd_vjp = jax.jit(fwd_vjp)
+        self._jit_apply_vjp = jax.jit(apply_vjp)
+        self._explicit_ograd_mode = False
+        self._cached_vjp = None
+        self._last_fwd_state = None
+
     # -- binding entry points --------------------------------------------
     @staticmethod
     def _normalize_grad_req(grad_req, arg_names: List[str]) -> List[str]:
@@ -299,7 +332,16 @@ class Executor(object):
         key = self._key()
         self._last_key = key  # reused by explicit-ograd backward so the
         # gradients see the SAME dropout/random masks as these outputs
-        if is_train and self._diff_idx:
+        if is_train and self._diff_idx and self._explicit_ograd_mode:
+            # split path: outputs + residual-closing vjp in one dispatch;
+            # backward applies the cached pullback (no fwd recompute)
+            self._last_fwd_state = (self._arg_vals(), self._aux_vals(), key)
+            outs, aux_new, vjp = self._jit_fwd_vjp(
+                self._arg_vals(), self._aux_vals(), key)
+            self._cached_vjp = (vjp, aux_new)
+            self._cached_grads = None
+            self._write_aux(aux_new)
+        elif is_train and self._diff_idx:
             import jax.numpy as jnp
 
             # the default ones head-gradients are step-invariant: build
@@ -310,6 +352,10 @@ class Executor(object):
                 ograds = [jnp.ones(s, dtype=d)
                           for s, d in self._out_avals()]
                 self._ones_ograds = ograds
+            # remembered so a FIRST explicit-ograd backward can build
+            # the vjp for THIS step without semantic drift (jax arrays
+            # are immutable; holding the refs is free)
+            self._last_fwd_state = (self._arg_vals(), self._aux_vals(), key)
             outs, grads, aux_new = self._jit_step(
                 self._arg_vals(), self._aux_vals(), key, ograds)
             self._cached_grads = grads
@@ -328,18 +374,41 @@ class Executor(object):
         if not self._diff_idx:
             return
         if out_grads is None:
-            if self._cached_grads is None:
+            if self._cached_vjp is not None:
+                import jax.numpy as jnp
+
+                ograds = getattr(self, "_ones_ograds", None)
+                if ograds is None:
+                    ograds = [jnp.ones(s, dtype=d)
+                              for s, d in self._out_avals()]
+                    self._ones_ograds = ograds
+                vjp, aux_new = self._cached_vjp
+                grads = self._jit_apply_vjp(vjp, ograds, aux_new)
+                self._cached_vjp = None
+            elif self._cached_grads is None:
                 raise MXNetError("backward() before forward(is_train=True)")
-            grads = self._cached_grads
+            else:
+                grads = self._cached_grads
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             ograds = [g._data for g in out_grads]
-            key = getattr(self, "_last_key", None)
-            if key is None:
-                key = self._key()
-            _, grads, _ = self._jit_step(self._arg_vals(), self._aux_vals(),
-                                         key, ograds)
+            if self._cached_vjp is not None:
+                vjp, aux_new = self._cached_vjp
+                grads = self._jit_apply_vjp(vjp, ograds, aux_new)
+                self._cached_vjp = None
+            else:
+                # first explicit-ograd call: build the pullback from the
+                # forward we already ran, then stay in split mode so
+                # future steps never compute the forward twice
+                self._explicit_ograd_mode = True
+                if self._last_fwd_state is not None:
+                    arg_vals, aux_vals, key = self._last_fwd_state
+                else:
+                    key = getattr(self, "_last_key", None) or self._key()
+                    arg_vals, aux_vals = self._arg_vals(), self._aux_vals()
+                _, aux_new, vjp = self._jit_fwd_vjp(arg_vals, aux_vals, key)
+                grads = self._jit_apply_vjp(vjp, ograds, aux_new)
         for j, i in enumerate(self._diff_idx):
             garr = self.grad_arrays[i]
             if garr is None:

@@ -299,10 +299,17 @@ class ResizeIter(DataIter):
 
 
 class PrefetchingIter(DataIter):
-    """Background-thread prefetch over one or more iterators (reference
-    `io.py:345`, backed in C++ by `dmlc::ThreadedIter`,
-    `src/io/iter_prefetcher.h`).  When the native engine extension is
-    built, the producer runs on its IO lane."""
+    """Prefetch over one or more iterators (reference `io.py:345`,
+    backed in C++ by `dmlc::ThreadedIter`, `src/io/iter_prefetcher.h`).
+
+    Producer tasks are scheduled on the dependency engine
+    (`mxtpu.engine.get_engine()`), serialized by a mutable engine var:
+    under the native ThreadedEngine they run on its C++ worker threads
+    and overlap the consumer (decode releases the GIL in numpy/PIL);
+    under NaiveEngine (``MXTPU_ENGINE_TYPE=NaiveEngine``) each task
+    executes synchronously at schedule time — the reference's
+    serialize-everything debug mode.  At most ``prefetch_depth`` batches
+    are in flight ahead of the consumer."""
 
     def __init__(self, iters, rename_data=None, rename_label=None,
                  prefetch_depth=2):
@@ -312,10 +319,14 @@ class PrefetchingIter(DataIter):
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
-        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self._start()
+        from .. import engine as _engine_mod
+
+        self._engine = _engine_mod.get_engine()
+        self._var = self._engine.new_var()
+        self._depth = max(1, int(prefetch_depth))
+        self._queue: "queue.Queue" = queue.Queue()
+        self._seen_end = False
+        self._prime()
 
     @property
     def provide_data(self):
@@ -333,41 +344,52 @@ class PrefetchingIter(DataIter):
                      for d in i.provide_label]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
-    def _producer(self):
-        while not self._stop.is_set():
-            try:
-                batches = [i.next() for i in self.iters]
-            except StopIteration:
-                self._queue.put(None)
-                return
-            except Exception as e:  # surface async errors at next()
-                self._queue.put(e)
-                return
-            self._queue.put(batches)
+    def _produce_one(self):
+        """One producer task: pull a batch from every child iterator and
+        enqueue it.  Runs on the engine (never raises — end-of-data and
+        errors travel through the queue to the consumer)."""
+        try:
+            batches = [i.next() for i in self.iters]
+        except StopIteration:
+            self._queue.put(None)
+            return
+        except Exception as e:  # surface async errors at next()
+            self._queue.put(e)
+            return
+        self._queue.put(batches)
 
-    def _start(self):
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._producer, daemon=True)
-        self._thread.start()
+    def _schedule(self):
+        self._engine.push(self._produce_one, mutable_vars=[self._var])
+
+    def _prime(self):
+        self._seen_end = False
+        for _ in range(self._depth):
+            self._schedule()
 
     def reset(self):
-        self._stop.set()
+        # drain: every scheduled producer task has run once the var is
+        # reached, so nothing can enqueue after the flush below
+        self._engine.wait_for_var(self._var)
         try:
             while True:
                 self._queue.get_nowait()
         except queue.Empty:
             pass
-        self._thread.join()
         for i in self.iters:
             i.reset()
-        self._start()
+        self._prime()
 
     def next(self):
+        if self._seen_end:
+            raise StopIteration
         got = self._queue.get()
         if got is None:
+            self._seen_end = True
             raise StopIteration
         if isinstance(got, Exception):
+            self._seen_end = True
             raise got
+        self._schedule()  # keep `prefetch_depth` batches in flight
         batches = got
         if len(batches) == 1:
             return batches[0]
@@ -377,9 +399,6 @@ class PrefetchingIter(DataIter):
             pad=max(b.pad or 0 for b in batches),
             provide_data=self.provide_data,
             provide_label=self.provide_label)
-
-    def __del__(self):
-        self._stop.set()
 
 
 class CSVIter(DataIter):

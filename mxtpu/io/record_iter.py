@@ -5,12 +5,18 @@ record reads + OMP-parallel JPEG decode `ParseChunk :78-150`),
 `src/io/image_aug_default.cc` (crop/resize/mirror/HSL augmenters),
 `src/io/iter_batchloader.h`.
 
-TPU-native design: a pool of host decode threads consumes records from
-the recordio reader (the C++ chunk reader in `src/` when built, python
-recordio otherwise), applies augmentation in numpy/PIL, and fills
-pre-allocated NCHW batch buffers; the consumer gets one device
-transfer per batch.  Distributed sharding (num_parts/part_index)
-mirrors the reference's `InputSplit` behavior.
+TPU-native design: whole-batch decode tasks are scheduled ahead of the
+consumer on the dependency engine (`mxtpu.engine` — native C++ worker
+threads when `src/` is built), each task fanning record decode across a
+host thread pool; recordio payloads stage through the native storage
+pool (`src/storage.cc`) so the read path does no malloc per record.
+The consumer pops finished batches — one device transfer per batch —
+while the next `prefetch_buffer` batches decode behind it, overlapping
+IO with the training step exactly as the reference's prefetcher does
+(`src/io/iter_prefetcher.h`).  `MXTPU_ENGINE_TYPE=NaiveEngine`
+serializes every decode at schedule time for debugging.  Distributed
+sharding (num_parts/part_index) mirrors the reference's `InputSplit`
+behavior.
 """
 from __future__ import annotations
 
@@ -91,13 +97,15 @@ class ImageRecordIter(DataIter):
     """
 
     _dtype = np.float32
+    _label_fill = 0.0  # padded label slots (det variant uses -1 sentinel)
 
     def __init__(self, path_imgrec, data_shape, batch_size,
                  path_imgidx=None, shuffle=False, rand_crop=False,
                  rand_mirror=False, resize=-1, mean_r=0.0, mean_g=0.0,
                  mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
                  preprocess_threads=4, round_batch=True, num_parts=1,
-                 part_index=0, label_width=1, seed=0, **_):
+                 part_index=0, label_width=1, seed=0,
+                 prefetch_buffer=2, **_):
         super(ImageRecordIter, self).__init__(batch_size)
         self.data_shape = tuple(int(x) for x in data_shape)
         if len(self.data_shape) != 3:
@@ -133,18 +141,41 @@ class ImageRecordIter(DataIter):
         self._epoch_order = np.arange(len(self._offsets))
         self._reader = open(path_imgrec, "rb")
         self._lock = threading.Lock()
+
+        # decode-ahead pipeline: batch decode tasks ride the dependency
+        # engine, serialized by one var so completion order == schedule
+        # order; `prefetch_buffer` batches stay in flight
+        from .. import engine as _engine_mod
+
+        self._engine = _engine_mod.get_engine()
+        self._var = self._engine.new_var()
+        self._prefetch = max(1, int(prefetch_buffer))
+        self._done_q: "queue.Queue" = queue.Queue()
+        self._inflight = 0
         self.reset()
 
     # -- record access ------------------------------------------------------
-    def _read_at(self, offset) -> bytes:
+    def _read_at(self, offset):
+        """Read one record payload.  Returns (payload, pooled): with the
+        native runtime built the payload is a zero-copy memoryview into
+        a `src/storage.cc` pool block (same-bucket reads recycle the
+        same host memory — no malloc per record) and the caller releases
+        `pooled` once decoded; otherwise plain bytes and None."""
         import struct as _struct
+
+        from .. import _native
+
         with self._lock:
             self._reader.seek(offset)
             header = self._reader.read(8)
             magic, lrec = _struct.unpack("<II", header)
             length = lrec & ((1 << 29) - 1)
-            payload = self._reader.read(length)
-        return payload
+            if _native.available():
+                buf = _native.PooledBuffer(length)
+                view = memoryview(buf.view).cast("B")
+                got = self._reader.readinto(view)
+                return view[:got], buf
+            return self._reader.read(length), None
 
     # -- augmentation -------------------------------------------------------
     def _augment(self, img: np.ndarray, rng) -> np.ndarray:
@@ -167,18 +198,33 @@ class ImageRecordIter(DataIter):
         return (chw - self.mean[:c]) / self.std[:c]
 
     def _decode_one(self, offset, rng) -> Tuple[np.ndarray, np.ndarray]:
-        payload = self._read_at(offset)
+        payload, pooled = self._read_at(offset)
         header, img_buf = unpack(payload)
-        label = np.atleast_1d(np.asarray(header.label, dtype=np.float32))
+        # copy: header.label may view pooled memory released below
+        label = np.array(np.atleast_1d(np.asarray(header.label,
+                                                  dtype=np.float32)))
         c, h, w = self.data_shape
         img = _decode_image(img_buf, shape_hint=(h, w, c))
-        return self._augment(img, rng), label[:self.label_width]
+        out = self._augment(img, rng)  # astype() below always copies
+        if pooled is not None:
+            pooled.release()
+        return out, label[:self.label_width]
 
     # -- epoch machinery ----------------------------------------------------
     def reset(self):
+        # drain in-flight decode tasks, flush finished batches, restart
+        self._engine.wait_for_var(self._var)
+        try:
+            while True:
+                self._done_q.get_nowait()
+        except queue.Empty:
+            pass
+        self._inflight = 0
         if self.shuffle:
             self._rng.shuffle(self._epoch_order)
         self._cursor = 0
+        for _ in range(self._prefetch):
+            self._schedule_batch()
 
     @property
     def provide_data(self):
@@ -191,23 +237,37 @@ class ImageRecordIter(DataIter):
             (self.batch_size, self.label_width)
         return [DataDesc("softmax_label", shape, np.float32)]
 
-    def next(self) -> DataBatch:
+    def _schedule_batch(self):
+        """Reserve the next batch window (cursor + RNG advance on the
+        consumer thread — deterministic order) and push its decode onto
+        the engine."""
         n = len(self._epoch_order)
         if self._cursor >= n:
-            raise StopIteration
+            return
         hi = self._cursor + self.batch_size
         if hi > n and not self.round_batch:
-            raise StopIteration
-        sel = self._epoch_order[
-            np.arange(self._cursor, hi) % n]
+            return
+        sel = self._epoch_order[np.arange(self._cursor, hi) % n].copy()
         pad = max(0, hi - n)
         self._cursor = hi
+        seeds = self._rng.randint(0, 2 ** 31 - 1, size=len(sel))
 
+        def task():
+            try:
+                self._done_q.put(self._decode_batch(sel, pad, seeds))
+            except Exception as e:  # surfaced at next()
+                self._done_q.put(e)
+
+        self._engine.push(task, mutable_vars=[self._var])
+        self._inflight += 1
+
+    def _decode_batch(self, sel, pad, seeds) -> DataBatch:
+        """Decode one batch (runs as an engine task; fans across the
+        intra-batch thread pool like the reference's OMP ParseChunk)."""
         c, h, w = self.data_shape
         data = np.empty((self.batch_size, c, h, w), dtype=np.float32)
-        labels = np.zeros((self.batch_size, self.label_width),
-                          dtype=np.float32)
-        seeds = self._rng.randint(0, 2 ** 31 - 1, size=len(sel))
+        labels = np.full((self.batch_size, self.label_width),
+                         self._label_fill, dtype=np.float32)
 
         def work(lo, hi_):
             rng = np.random.RandomState(seeds[lo])
@@ -233,6 +293,16 @@ class ImageRecordIter(DataIter):
         return DataBatch(data=[nd_array(data)], label=[nd_array(label_out)],
                          pad=pad, provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+    def next(self) -> DataBatch:
+        if self._inflight == 0:
+            raise StopIteration
+        got = self._done_q.get()
+        self._inflight -= 1
+        self._schedule_batch()  # keep the pipeline `prefetch_buffer` deep
+        if isinstance(got, Exception):
+            raise got
+        return got
 
     def _postprocess(self, img_chw: np.ndarray) -> np.ndarray:
         return img_chw
@@ -262,7 +332,7 @@ class ImageDetRecordIter(ImageRecordIter):
     def __init__(self, *args, label_pad_width=0, label_pad_value=-1.0,
                  **kwargs):
         self._pad_width = int(label_pad_width)
-        self._pad_value = float(label_pad_value)
+        self._label_fill = float(label_pad_value)  # -1 = ignore sentinel
         kwargs.setdefault("label_width",
                           self._pad_width if self._pad_width else 6)
         super(ImageDetRecordIter, self).__init__(*args, **kwargs)

@@ -433,17 +433,42 @@ class Symbol(object):
             f.write(self.tojson())
 
     # -- binding (whole-graph XLA lowering) -------------------------------
+    @staticmethod
+    def _check_group2ctx(group2ctx, ctx):
+        """Reference `graph_executor.cc:1594` places ctx-grouped subgraphs
+        on distinct devices.  The TPU-native counterpart of that kind of
+        model parallelism is mesh sharding (`mxtpu.parallel`), not
+        per-node device placement — so a group2ctx that actually asks
+        for multi-device placement raises instead of being silently
+        ignored.  A mapping where every group lands on the bind context
+        is a no-op and accepted."""
+        if not group2ctx:
+            return
+        from ..context import current_context
+
+        distinct = {str(c) for c in group2ctx.values()}
+        distinct.add(str(ctx if ctx is not None else current_context()))
+        if len(distinct) > 1:
+            raise NotImplementedError(
+                "group2ctx with multi-device placement (%s) is not "
+                "supported: whole-graph XLA lowering places the graph on "
+                "one logical device. Use mesh-based model parallelism "
+                "(mxtpu.parallel: pjit shardings over a Mesh) instead."
+                % sorted(distinct))
+
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
                     shared_exec=None, shared_buffer=None, **kwargs):
         from ..executor import Executor
 
+        Symbol._check_group2ctx(group2ctx, ctx)
         return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from ..executor import Executor
 
+        Symbol._check_group2ctx(group2ctx, ctx)
         return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states)
 
     def eval(self, ctx=None, **kwargs):

@@ -168,3 +168,55 @@ def test_grad_req_add_and_null():
     ex.forward(is_train=True)
     ex.backward()
     np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), [12.0])
+
+
+def test_explicit_ograd_backward_cached_vjp():
+    """backward(out_grads) must produce d(sum(ograd*out))/darg WITHOUT
+    re-running the forward: the executor flips into split fwd/vjp mode
+    (executor.py fwd_vjp) and applies the cached pullback.  Gradients
+    and group2ctx-free semantics must match the analytic values."""
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = a * b  # d(out)/da = b, d(out)/db = a
+    av, bv = nd.array([1.0, 2.0, 3.0]), nd.array([4.0, 5.0, 6.0])
+    ex = out.bind(ctx=mx.cpu(), args={"a": av, "b": bv},
+                  args_grad={"a": nd.zeros((3,)), "b": nd.zeros((3,))})
+
+    # step 1: first explicit-ograd call builds the pullback lazily
+    ex.forward(is_train=True)
+    og = nd.array([1.0, 10.0, 100.0])
+    ex.backward([og])
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(),
+                               (og.asnumpy() * bv.asnumpy()))
+    np.testing.assert_allclose(ex.grad_dict["b"].asnumpy(),
+                               (og.asnumpy() * av.asnumpy()))
+    assert ex._explicit_ograd_mode
+
+    # step 2: split mode — forward caches the vjp, backward applies it
+    ex.forward(is_train=True)
+    assert ex._cached_vjp is not None
+    ex.backward([og * 2])
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(),
+                               2 * og.asnumpy() * bv.asnumpy())
+    assert ex._cached_vjp is None
+
+    # step 3: default ones-ograd backward still works in split mode
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), bv.asnumpy())
+
+
+def test_group2ctx_multi_device_raises():
+    """group2ctx asking for real multi-device placement must raise, not
+    silently no-op (reference honors it, graph_executor.cc:1594); a
+    same-device mapping is accepted."""
+    import pytest
+
+    a = sym.Variable("a")
+    out = a * 2
+    with pytest.raises(NotImplementedError):
+        out.bind(ctx=mx.cpu(0), args={"a": nd.array([1.0])},
+                 group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    ex = out.bind(ctx=mx.cpu(0), args={"a": nd.array([1.0])},
+                  group2ctx={"dev1": mx.cpu(0)})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [2.0])
