@@ -256,12 +256,16 @@ def _sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
 @register("_contrib_arange_like", differentiable=False)
 def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
     jnp = _jnp()
+
+    def ramp(n):
+        """First n values of (start + step*arange) with each value
+        repeated `repeat` times (reference arange repeat semantics)."""
+        repeat_ = max(1, int(repeat))
+        base = jnp.arange(-(-n // repeat_), dtype=data.dtype) * step + start
+        return jnp.repeat(base, repeat_)[:n]
+
     if axis is None:
         n = int(np.prod(data.shape))
-        return (jnp.arange(n, dtype=data.dtype) * step + start).reshape(data.shape)
-    n = data.shape[axis]
-    shape = [1] * data.ndim
-    shape[axis] = n
-    return jnp.broadcast_to(
-        (jnp.arange(n, dtype=data.dtype) * step + start).reshape(shape),
-        data.shape)
+        return ramp(n).reshape(data.shape)
+    # reference arange_like with axis: a 1-D range of length shape[axis]
+    return ramp(data.shape[axis])

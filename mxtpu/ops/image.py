@@ -35,14 +35,18 @@ def _normalize(data, mean=(0.0,), std=(1.0,)):
     return (data - mean.reshape(shape)) / std.reshape(shape)
 
 
+# images are HWC / NHWC (reference `python/mxnet/image/image.py`):
+# width is axis -2 (channels last), height is axis -3
+
+
 @register("_image_flip_left_right", differentiable=False)
 def _flip_lr(data):
-    return _jnp().flip(data, axis=-1 if data.ndim == 3 else -1)
+    return _jnp().flip(data, axis=-2)
 
 
 @register("_image_flip_top_bottom", differentiable=False)
 def _flip_tb(data):
-    return _jnp().flip(data, axis=-2)
+    return _jnp().flip(data, axis=-3)
 
 
 @register("_image_random_flip_left_right", needs_rng=True, differentiable=False)
@@ -51,7 +55,7 @@ def _random_flip_lr(key, data):
 
     jnp = _jnp()
     flip = jax.random.bernoulli(key)
-    return jnp.where(flip, jnp.flip(data, axis=-1), data)
+    return jnp.where(flip, jnp.flip(data, axis=-2), data)
 
 
 @register("_image_random_flip_top_bottom", needs_rng=True, differentiable=False)
@@ -60,7 +64,7 @@ def _random_flip_tb(key, data):
 
     jnp = _jnp()
     flip = jax.random.bernoulli(key)
-    return jnp.where(flip, jnp.flip(data, axis=-2), data)
+    return jnp.where(flip, jnp.flip(data, axis=-3), data)
 
 
 @register("_image_resize", aliases=("image_resize",), differentiable=False)

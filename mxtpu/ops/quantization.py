@@ -78,6 +78,32 @@ def _requantize(data, min_range, max_range, out_type="int8",
     return q, jnp.asarray(lo, np.float32), jnp.asarray(hi, np.float32)
 
 
+def _int32_out_range(jnp, min_data, max_data, min_weight, max_weight):
+    """Scale-propagated int32 output range for int8*int8 accumulation
+    (reference `src/operator/quantization/quantization_utils.h`
+    QuantizationRangeForS8S8Multiplication): real = acc * sd * sw with
+    sd/sw the int8 scales, so the stored range must be
+    +-(2^31 - 1) * sd * sw for downstream dequantize to recover reals."""
+    sd = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) / 127.0
+    sw = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight)) / 127.0
+    hi = (sd * sw * float(2 ** 31 - 1)).astype(np.float32)
+    return -hi, hi
+
+
+def _rescaled_bias(jnp, bias, min_data, max_data, min_weight, max_weight,
+                   min_bias, max_bias):
+    """Bias arrives quantized at its OWN scale sb; the accumulator is in
+    sd*sw units, so add round(bias * sb/(sd*sw)) (reference
+    quantized_fully_connected.cc bias rescale)."""
+    if min_bias is None or max_bias is None:
+        return bias.astype(np.int32)
+    sd = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) / 127.0
+    sw = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight)) / 127.0
+    sb = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
+    scale = sb / (sd * sw)
+    return jnp.round(bias.astype(np.float32) * scale).astype(np.int32)
+
+
 @register("_contrib_quantized_fully_connected", num_outputs=3,
           differentiable=False)
 def _quantized_fc(data, weight, bias, min_data, max_data, min_weight,
@@ -93,10 +119,12 @@ def _quantized_fc(data, weight, bias, min_data, max_data, min_weight,
         (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=np.int32)
     if not no_bias and bias is not None:
-        acc = acc + bias.astype(np.int32)
-    out_min = -(2.0 ** 31)
-    out_max = 2.0 ** 31
-    return acc, jnp.asarray(out_min, np.float32), jnp.asarray(out_max, np.float32)
+        acc = acc + _rescaled_bias(jnp, bias, min_data, max_data,
+                                   min_weight, max_weight,
+                                   min_bias, max_bias)
+    out_min, out_max = _int32_out_range(jnp, min_data, max_data,
+                                        min_weight, max_weight)
+    return acc, out_min, out_max
 
 
 @register("_contrib_quantized_conv", num_outputs=3, differentiable=False)
@@ -123,9 +151,12 @@ def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
         feature_group_count=num_group,
         preferred_element_type=np.int32)
     if not no_bias and bias is not None:
-        acc = acc + bias.astype(np.int32).reshape((1, -1) + (1,) * ns)
-    return acc, jnp.asarray(-(2.0 ** 31), np.float32), \
-        jnp.asarray(2.0 ** 31, np.float32)
+        acc = acc + _rescaled_bias(jnp, bias, min_data, max_data,
+                                   min_weight, max_weight, min_bias,
+                                   max_bias).reshape((1, -1) + (1,) * ns)
+    out_min, out_max = _int32_out_range(jnp, min_data, max_data,
+                                        min_weight, max_weight)
+    return acc, out_min, out_max
 
 
 @register("_contrib_quantized_pooling", num_outputs=3, differentiable=False)

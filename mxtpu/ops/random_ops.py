@@ -213,13 +213,31 @@ def _sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
 
 @register("_sample_unique_zipfian", needs_rng=True, differentiable=False)
 def _sample_unique_zipfian(key, range_max=1, shape=()):
+    """Unique draws from the log-uniform (zipfian) class distribution
+    (reference `src/operator/random/unique_sample_op.cc`: rejection
+    sampling until n distinct).  TPU-native form: Gumbel-top-k over the
+    class log-probs — sampling WITHOUT replacement in one static-shape
+    op (p(c) = log((c+2)/(c+1)) / log(range_max+1))."""
     jax = _jax()
     import jax.numpy as jnp
 
     shape, _ = _shape_dtype(shape, None)
-    u = jax.random.uniform(key, shape)
-    cls = (jnp.exp(u * np.log(range_max + 1.0)) - 1.0).astype(np.int64)
-    return jnp.clip(cls, 0, range_max - 1)
+    shape = shape or (1,)
+    n = int(shape[-1])  # uniqueness holds per ROW (reference semantics)
+    if n > range_max:
+        raise ValueError(
+            "_sample_unique_zipfian: cannot draw %d unique samples from "
+            "range_max=%d" % (n, range_max))
+    rows = int(np.prod(shape[:-1])) if len(shape) > 1 else 1
+    c = jnp.arange(range_max, dtype=jnp.float32)
+    logp = jnp.log(jnp.log1p(1.0 / (c + 1.0)))
+
+    def draw(k):
+        g = jax.random.gumbel(k, (range_max,))
+        return jax.lax.top_k(logp + g, n)[1]
+
+    idx = jax.vmap(draw)(jax.random.split(key, rows))
+    return idx.reshape(shape).astype(jnp.int64)
 
 
 @register("_shuffle", needs_rng=True, differentiable=False,

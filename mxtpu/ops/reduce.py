@@ -50,11 +50,15 @@ def _norm(x, ord=2, axis=None, keepdims=False, out_dtype=None):
     if isinstance(axis, (list, tuple)) and len(axis) == 1:
         axis = axis[0]
     if ord == 1:
-        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
-    if ord == 2:
-        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
-    # reference supports only ord=1,2 (broadcast_reduce_op norm)
-    raise ValueError("norm only supports ord=1 or ord=2, got %r" % (ord,))
+        out = jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    elif ord == 2:
+        out = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+    else:
+        # reference supports only ord=1,2 (broadcast_reduce_op norm)
+        raise ValueError("norm only supports ord=1 or ord=2, got %r" % (ord,))
+    if axis is None and not keepdims:
+        out = out.reshape(1)  # reference full-reduce norm is shape (1,)
+    return out
 
 
 @register("_square_sum")
