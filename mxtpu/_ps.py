@@ -430,6 +430,10 @@ class Server(object):
                     _send_msg(conn, self._push(msg))
                 elif op == "pull":
                     _send_msg(conn, self._pull(msg))
+                elif op == "pull_rows":
+                    _send_msg(conn, self._pull_rows(msg))
+                elif op == "push_rows":
+                    _send_msg(conn, self._push_rows(msg))
                 elif op == "command":
                     _send_msg(conn, self._command(msg))
                 elif op == "shutdown":
@@ -516,6 +520,48 @@ class Server(object):
                                  "version %d" % (key, min_version)}
             return {"value": self._store.get(key),
                     "version": self._versions.get(key, 0)}
+
+    def _push_rows(self, msg):
+        """Row-subset push: the wire carries only the touched flat spans;
+        the server expands to a dense delta for its chunk and rides the
+        ordinary sync-accumulate path (reference kRowSparsePushPull —
+        the server-side store stays dense here, documented deviation)."""
+        key, sync = msg["key"], msg["sync"]
+        spans = np.asarray(msg["spans"], dtype=np.int64).reshape(-1, 2)
+        buf = np.asarray(msg["value"])
+        with self._lock:
+            ref = self._store.get(key)
+        if ref is None:
+            return {"error": "key %r not initialized on server" % (key,)}
+        dense = np.zeros_like(ref)
+        ofs = 0
+        for a, b in spans:
+            dense[a:b] = buf[ofs:ofs + (b - a)]
+            ofs += b - a
+        return self._push({"key": key, "value": dense, "sync": sync})
+
+    def _pull_rows(self, msg):
+        """Row-subset pull (reference `src/kvstore/kvstore_dist.h`
+        PullRowSparse / kRowSparsePushPull): ship ONLY the requested
+        flat spans of this server's chunk, not the whole value."""
+        key, min_version = msg["key"], msg.get("min_version", 0)
+        spans = np.asarray(msg["spans"], dtype=np.int64).reshape(-1, 2)
+        with self._cv:
+            while (key not in self._store
+                   or self._versions.get(key, 0) < min_version) \
+                    and not self._shutdown and key not in self._errors:
+                self._cv.wait()
+            if key in self._errors:
+                return {"value": None, "error": self._errors[key]}
+            if key not in self._store or \
+                    self._versions.get(key, 0) < min_version:
+                return {"value": None,
+                        "error": "server shut down before %r reached "
+                                 "version %d" % (key, min_version)}
+            arr = self._store[key]
+            parts = [arr[a:b] for a, b in spans]
+            value = np.concatenate(parts) if parts else arr[:0]
+            return {"value": value, "version": self._versions.get(key, 0)}
 
     def _command(self, msg):
         head, body = msg["head"], msg["body"]
@@ -619,6 +665,78 @@ class Worker(object):
                         "error", "server shut down while waiting")))
             flat[lo:hi] = rep["value"]
         return flat.reshape(shape)
+
+    def pull_rows(self, key, row_ids, sync: bool = True) -> np.ndarray:
+        """Pull only `row_ids` rows of `key` (reference PullRowSparse,
+        `src/kvstore/kvstore_dist.h`): each server ships just the flat
+        spans of its chunk that requested rows overlap — wire traffic is
+        O(nnz_rows * row_width), not O(full value)."""
+        shape, dtype = self._meta_shape[key]
+        if len(shape) < 1:
+            raise ValueError("pull_rows needs a >=1-D key")
+        width = int(np.prod(shape[1:], dtype=np.int64)) if len(shape) > 1 \
+            else 1
+        rows = np.unique(np.asarray(row_ids, dtype=np.int64))
+        rows = rows[(rows >= 0) & (rows < shape[0])]
+        out = np.zeros((len(rows), width), dtype=dtype)
+        size = int(np.prod(shape, dtype=np.int64))
+        for sidx, subkey, lo, hi in self._chunks(key, size):
+            spans = []
+            fills = []  # (row_pos, col_lo, col_hi)
+            for j, r in enumerate(rows):
+                a, b = int(r) * width, (int(r) + 1) * width
+                ia, ib = max(a, lo), min(b, hi)
+                if ia < ib:
+                    spans.append((ia - lo, ib - lo))
+                    fills.append((j, ia - a, ib - a))
+            if not spans:
+                continue
+            rep = self._servers[sidx].request(
+                {"op": "pull_rows", "key": subkey,
+                 "spans": np.asarray(spans, np.int64),
+                 "min_version": self._last_version.get(subkey, 0)
+                 if sync else 0})
+            if rep.get("value") is None:
+                raise ConnectionError(
+                    "pull_rows of %r failed: %s" % (key, rep.get(
+                        "error", "server shut down while waiting")))
+            buf = np.asarray(rep["value"])
+            ofs = 0
+            for (j, ca, cb) in fills:
+                out[j, ca:cb] = buf[ofs:ofs + (cb - ca)]
+                ofs += cb - ca
+        return rows.astype(np.int64), out.reshape(
+            (len(rows),) + tuple(shape[1:]))
+
+    def push_rows(self, key, rows: np.ndarray, data: np.ndarray,
+                  sync: bool = True):
+        """Push only `rows` of `key`: wire traffic O(rows * width)."""
+        shape, dtype = self._meta_shape[key]
+        width = int(np.prod(shape[1:], dtype=np.int64)) if len(shape) > 1 \
+            else 1
+        order = np.argsort(rows)
+        rows = np.asarray(rows, np.int64)[order]
+        flat = np.ascontiguousarray(data, dtype=dtype).reshape(
+            -1, width)[order]
+        size = int(np.prod(shape, dtype=np.int64))
+        for sidx, subkey, lo, hi in self._chunks(key, size):
+            spans, parts = [], []
+            for j, r in enumerate(rows):
+                a, b = int(r) * width, (int(r) + 1) * width
+                ia, ib = max(a, lo), min(b, hi)
+                if ia < ib:
+                    spans.append((ia - lo, ib - lo))
+                    parts.append(flat[j, ia - a:ib - a])
+            value = np.concatenate(parts) if parts \
+                else np.zeros((0,), dtype)
+            rep = self._servers[sidx].request(
+                {"op": "push_rows", "key": subkey,
+                 "spans": np.asarray(spans, np.int64).reshape(-1, 2),
+                 "value": value, "sync": sync})
+            if rep.get("error"):
+                raise ConnectionError("push_rows of %r failed: %s"
+                                      % (key, rep["error"]))
+            self._last_version[subkey] = rep["version"]
 
     def barrier(self):
         self._sched.request({"op": "barrier"})

@@ -163,6 +163,141 @@ def _record_fn(name, tupled_fn, nd_inputs, jax_inputs):
     return outs, node
 
 
+class SparseCot(object):
+    """Row-sparse cotangent flowing through the tape: `indices` (k,)
+    int32, sorted, padded at the tail with the OUT-OF-RANGE id
+    `full_shape[0]` (zero rows; jax scatters drop them) + `values`
+    (k, dim).  The TPU-native embedding-gradient
+    form (reference: Embedding sparse_grad emits a RowSparseNDArray
+    grad, `src/operator/tensor/indexing_op.cc` EmbeddingOpBackwardEx):
+    static shapes (k = number of looked-up ids), no vocab-sized buffer
+    ever materializes."""
+
+    __slots__ = ("indices", "values", "full_shape")
+
+    def __init__(self, indices, values, full_shape):
+        self.indices = indices
+        self.values = values
+        self.full_shape = tuple(full_shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def densify(self):
+        import jax.numpy as jnp
+
+        out = jnp.zeros(self.full_shape, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def __add__(self, other):
+        import jax.numpy as jnp
+
+        if isinstance(other, SparseCot):
+            # re-dedup so the sorted-unique+OOB-padding invariant holds
+            # for consumers (scatter kernels use .set, so duplicate rows
+            # would drop contributions)
+            return _dedup_sparse_cot(
+                jnp.concatenate([self.indices, other.indices]),
+                jnp.concatenate([self.values, other.values]),
+                self.full_shape[0])
+        return self.densify() + other
+
+    __radd__ = __add__
+
+
+_EMB_FWD = None
+
+
+def _emb_fwd_jit():
+    """Cached jitted embedding gather (clip mode matches
+    ops/indexing.py _embedding) — a fresh jax.jit per step would
+    recompile the hottest op every batch."""
+    global _EMB_FWD
+    if _EMB_FWD is None:
+        import jax
+        import jax.numpy as jnp
+
+        def fwd(d, w):
+            idx = jnp.clip(d.astype(jnp.int32), 0, w.shape[0] - 1)
+            return jnp.take(w, idx, axis=0)
+
+        _EMB_FWD = jax.jit(fwd)
+    return _EMB_FWD
+
+
+_DEDUP_JIT = None
+
+
+def _dedup_sparse_cot(idx, vals, n_rows):
+    """(possibly-duplicated) scatter rows -> SparseCot with sorted
+    unique indices, OOB tail padding (see SparseCot).  Static shapes:
+    k = idx.size regardless of duplicate count.  One jitted kernel —
+    unique/searchsorted/segment-sum fuse into a single dispatch."""
+    global _DEDUP_JIT
+    import jax
+
+    if _DEDUP_JIT is None:
+        import jax.numpy as jnp
+
+        def kern(idx, vals, n_rows):
+            k = idx.shape[0]
+            uniq = jnp.unique(idx, size=k, fill_value=n_rows)
+            pos = jnp.searchsorted(uniq, idx)
+            seg = jax.ops.segment_sum(vals, pos, num_segments=k)
+            return uniq, seg
+
+        _DEDUP_JIT = jax.jit(kern, static_argnums=2)
+    uniq, seg = _DEDUP_JIT(idx, vals, int(n_rows))
+    return SparseCot(uniq, seg, (n_rows,) + tuple(vals.shape[1:]))
+
+
+def _record_embedding_sparse(opdef, nd_inputs, jax_inputs, attrs, rng_key):
+    """Tape an Embedding lookup whose weight cotangent stays row-sparse.
+    Forward is the ordinary gather; the hand-written vjp deduplicates
+    ids via fixed-size unique + segment-sum — O(k·dim), never O(vocab)."""
+    import jax
+    import jax.numpy as jnp
+
+    data, weight = jax_inputs
+    vocab, dim = weight.shape
+
+    out = _emb_fwd_jit()(data, weight)
+
+    def vjp_fn(cots):
+        (og,) = cots
+        # clip like the forward does (ops/indexing.py _embedding), so
+        # out-of-range ids send gradient to the same clamped row on both
+        # the sparse and dense paths
+        idx = jnp.clip(data.astype(jnp.int32), 0, vocab - 1).reshape(-1)
+        vals = og.reshape(-1, dim)
+        # fixed-size unique + segment-sum (XLA-static).  Padding slots
+        # get index `vocab` — OUT of range, which keeps the array sorted
+        # (so the searchsorted position map is correct) and makes every
+        # sparse consumer drop the padding for free: jax scatters
+        # discard out-of-bounds rows, and host-side retain/searchsorted
+        # paths see them past the last valid row.
+        return (None, _dedup_sparse_cot(idx, vals, vocab))
+
+    entries = []
+    tracked = False
+    for x in nd_inputs:
+        ent = getattr(x, "_entry", None)
+        if ent is not None:
+            entries.append(("node", ent[0], ent[1]))
+            tracked = True
+        elif getattr(x, "_marked", False):
+            entries.append(("leaf", x))
+            tracked = True
+        else:
+            entries.append(None)
+    if not tracked:
+        return (out,), None
+    node = TapeNode(opdef.name, vjp_fn, entries,
+                    [(tuple(out.shape), out.dtype)])
+    return (out,), node
+
+
 def _record_op(opdef, nd_inputs, jax_inputs, attrs: Dict[str, Any], rng_key=None):
     """Run op under jax.vjp and tape it. Returns (jax outputs tuple, node).
 
@@ -172,6 +307,9 @@ def _record_op(opdef, nd_inputs, jax_inputs, attrs: Dict[str, Any], rng_key=None
     reference's kernel-per-op execution."""
     from .ops.registry import _jitted, canonical_attrs
 
+    if opdef.name == "Embedding" and attrs.get("sparse_grad"):
+        return _record_embedding_sparse(opdef, nd_inputs, jax_inputs,
+                                        attrs, rng_key)
     fn = _jitted(opdef.name, canonical_attrs(attrs))
 
     if opdef.needs_rng:
@@ -232,11 +370,33 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Compute gradients of ``heads`` w.r.t. marked variables, accumulating
     into their ``.grad`` buffers (reference: `python/mxnet/autograd.py:243`,
     `Imperative::Backward` `src/imperative/imperative.cc:278`)."""
+    from .ndarray.sparse import RowSparseNDArray
+    from .ndarray.ndarray import NDArray as _ND
+
     grads = _run_backward(heads, head_grads, retain_graph)
     for var, g in grads.items():
         req = getattr(var, "_grad_req", "write")
         if var._grad is None:
             continue
+        if isinstance(var._grad, RowSparseNDArray):
+            if req == "add":
+                raise MXNetError("grad_req='add' is not supported for "
+                                 "row_sparse gradients (reference parity)")
+            if isinstance(g, SparseCot):
+                var._grad._set_jax(g.values.astype(var._grad.dtype))
+                var._grad._aux = (_ND(g.indices, ctx=var._grad.ctx),)
+                var._grad._shape = g.full_shape
+            else:  # a dense path also touched this leaf
+                from .ndarray.sparse import cast_storage as _cast
+
+                dense = _ND(g, ctx=var._grad.ctx, _committed=True)
+                rsp = _cast(dense, "row_sparse")
+                var._grad._set_jax(rsp._data)
+                var._grad._aux = rsp._aux
+                var._grad._shape = rsp._shape
+            continue
+        if isinstance(g, SparseCot):
+            g = g.densify()
         if req == "add":
             var._grad._set_jax(var._grad._data + g)
         else:
@@ -327,6 +487,8 @@ def _run_backward(heads, head_grads=None, retain_graph=False, extra_vars=None):
                 "retain_graph=True) to backprop through it a second time")
         full = []
         for c, (shape, dtype) in zip(slot, node.out_avals):
+            if isinstance(c, SparseCot):
+                c = c.densify()  # upstream vjps consume dense arrays
             full.append(c if c is not None else jnp.zeros(shape, dtype=dtype))
         in_cots = node.vjp_fn(tuple(full))
         for ent, g in zip(node.input_entries, in_cots):
@@ -357,6 +519,12 @@ def _run_backward(heads, head_grads=None, retain_graph=False, extra_vars=None):
                     g = cots[id(ent[0])][ent[1]]
             if g is None:
                 g = jnp.zeros(v.shape, dtype=v.dtype)
+            if isinstance(g, SparseCot):
+                from .ndarray.sparse import RowSparseNDArray as _RSP
+
+                res.append(_RSP(g.values, (g.indices,), g.full_shape,
+                                ctx=v.ctx))
+                continue
             res.append(_ND(g, ctx=v.ctx))
         return {"__vars__": res}
     return leaf_grads

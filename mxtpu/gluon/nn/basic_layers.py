@@ -222,15 +222,22 @@ class InstanceNorm(HybridBlock):
 
 
 class Embedding(HybridBlock):
+    """Lookup table.  ``sparse_grad=True`` keeps the weight gradient
+    row-sparse end to end (tape emits a SparseCot, the grad buffer is a
+    RowSparseNDArray, and optimizers apply lazy row updates) — the
+    reference's EmbeddingOpBackwardEx path, re-designed with static
+    shapes (`mxtpu/autograd.py:_record_embedding_sparse`)."""
+
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
-                            "dtype": dtype}
+                            "dtype": dtype, "sparse_grad": sparse_grad}
             self.weight = self.params.get(
                 "weight", shape=(input_dim, output_dim),
                 init=weight_initializer, dtype=dtype,
+                grad_stype="row_sparse" if sparse_grad else "default",
                 allow_deferred_init=True)
 
     def hybrid_forward(self, F, x, weight):

@@ -48,6 +48,7 @@ class Parameter(object):
         self.init = init
         self.allow_deferred_init = allow_deferred_init
         self._stype = stype
+        self._grad_stype = grad_stype
 
     def __repr__(self):
         return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self._shape,
@@ -139,7 +140,7 @@ class Parameter(object):
             return
         self._grad = []
         for d in self._data:
-            d.attach_grad(self.grad_req)
+            d.attach_grad(self.grad_req, stype=self._grad_stype)
             self._grad.append(d.grad)
 
     # -- access -----------------------------------------------------------

@@ -445,57 +445,119 @@ class CSVIter(DataIter):
 
 
 class LibSVMIter(DataIter):
-    """LibSVM text format -> (dense or CSR) batches (reference
-    `src/io/iter_libsvm.cc`).  Values materialize as CSR NDArray."""
+    """LibSVM text format -> CSR batches (reference
+    `src/io/iter_libsvm.cc`).  Rows parse straight into CSR triplets —
+    nothing densifies, so million-feature datasets cost O(nnz), and each
+    batch materializes as a CSRNDArray sliced from the triplet store.
+    `num_parts`/`part_index` shard rows for distributed training
+    (reference InputSplit)."""
 
     def __init__(self, data_libsvm, data_shape, label_libsvm=None,
-                 label_shape=None, batch_size=1, round_batch=True, **_):
+                 label_shape=None, batch_size=1, round_batch=True,
+                 num_parts=1, part_index=0, **_):
         super(LibSVMIter, self).__init__(batch_size)
         self.data_shape = tuple(data_shape) if isinstance(
             data_shape, (tuple, list)) else (int(data_shape),)
-        num_col = int(np.prod(self.data_shape))
-        labels, rows = [], []
+        self._num_col = int(np.prod(self.data_shape))
+        self.round_batch = round_batch
+
+        labels, cols, vals, indptr = [], [], [], [0]
+        row_no = 0  # non-empty data rows seen, for shard selection
         with open(data_libsvm) as f:
             for line in f:
                 parts = line.split()
                 if not parts:
                     continue
-                labels.append(float(parts[0]))
-                row = np.zeros(num_col, dtype=np.float32)
+                mine = num_parts <= 1 or (row_no % num_parts) == part_index
+                row_no += 1
+                if not mine:
+                    continue
+                labels.append([float(parts[0])])
                 for kv in parts[1:]:
                     k, v = kv.split(":")
-                    row[int(k)] = float(v)
-                rows.append(row)
-        data = np.stack(rows) if rows else np.zeros((0, num_col), np.float32)
-        label = np.asarray(labels, dtype=np.float32).reshape(-1, 1)
+                    cols.append(int(k))
+                    vals.append(float(v))
+                indptr.append(len(cols))
         if label_libsvm is not None:
+            # separate label file: rows pair 1:1 with data rows, so the
+            # SAME shard selection applies (multi-column labels kept)
+            labels = []
+            lrow = 0
             with open(label_libsvm) as f:
-                label = np.asarray(
-                    [[float(t) for t in line.split()]
-                     for line in f if line.strip()], dtype=np.float32)
-        self._sparse = True
-        self._inner = NDArrayIter(
-            {"data": data}, {"label": label}, batch_size=batch_size,
-            last_batch_handle="pad" if round_batch else "discard")
+                for line in f:
+                    if not line.strip():
+                        continue
+                    mine = num_parts <= 1 or \
+                        (lrow % num_parts) == part_index
+                    lrow += 1
+                    if mine:
+                        labels.append([float(t) for t in line.split()])
+        self._labels = np.asarray(labels, np.float32) \
+            if labels else np.zeros((0, 1), np.float32)
+        self._cols = np.asarray(cols, np.int32)
+        self._vals = np.asarray(vals, np.float32)
+        self._indptr = np.asarray(indptr, np.int64)
+        if len(self._labels) != len(self._indptr) - 1:
+            raise MXNetError(
+                "label rows (%d) != data rows (%d) in %s"
+                % (len(self._labels), len(self._indptr) - 1, data_libsvm))
+        if row_no == 0:
+            raise MXNetError("no rows in %s" % data_libsvm)
+        # an EMPTY shard (fewer leftover rows than workers) is legal:
+        # this worker simply iterates zero batches
+        self.reset()
 
     @property
     def provide_data(self):
-        return self._inner.provide_data
+        return [DataDesc("data", (self.batch_size, self._num_col),
+                         np.float32)]
 
     @property
     def provide_label(self):
-        return self._inner.provide_label
+        lw = self._labels.shape[1] if self._labels.ndim > 1 else 1
+        shape = (self.batch_size,) if lw == 1 else (self.batch_size, lw)
+        return [DataDesc("softmax_label", shape, np.float32)]
 
     def reset(self):
-        self._inner.reset()
+        self._cursor = 0
+
+    def _csr_batch(self, lo, hi):
+        """CSRNDArray over rows [lo, hi) of the triplet store, padded by
+        wrapping (round_batch) so the batch shape is static."""
+        from ..ndarray import sparse as _sp
+
+        n = len(self._labels)
+        sel = np.arange(lo, hi) % n
+        counts = self._indptr[sel + 1] - self._indptr[sel]
+        indptr = np.zeros(len(sel) + 1, np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        take = np.concatenate([
+            np.arange(self._indptr[r], self._indptr[r + 1]) for r in sel]) \
+            if len(sel) else np.zeros((0,), np.int64)
+        data = self._vals[take]
+        cols = self._cols[take]
+        csr = _sp.csr_matrix((data, cols, indptr),
+                             shape=(len(sel), self._num_col))
+        label = self._labels[sel]
+        if label.ndim > 1 and label.shape[1] == 1:
+            label = label[:, 0]
+        return csr, label
 
     def next(self):
-        batch = self._inner.next()
-        try:  # present data as CSR like the reference iterator
-            batch.data = [d.tostype("csr") for d in batch.data]
-        except (AttributeError, MXNetError):
-            pass
-        return batch
+        n = len(self._labels)
+        if self._cursor >= n:
+            raise StopIteration
+        hi = self._cursor + self.batch_size
+        if hi > n and not self.round_batch:
+            raise StopIteration
+        pad = max(0, hi - n)
+        csr, label = self._csr_batch(self._cursor, hi)
+        self._cursor = hi
+        from ..ndarray.ndarray import array as _nd_array
+
+        return DataBatch(data=[csr], label=[_nd_array(label)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
 
 
 def _read_idx_file(path):

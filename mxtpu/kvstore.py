@@ -178,12 +178,31 @@ class KVStore(object):
         return NDArray(merged, ctx=vals[0].ctx, _committed=True)
 
     def push(self, key, value, priority=0):
+        from .ndarray.sparse import (BaseSparseNDArray, RowSparseNDArray,
+                                     add as _sp_add)
+
         keys, values = _group_kv(key, value)
         for k, vals in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % (k,))
-            merged = self._reduce(k, vals)
             stored = self._store[k]
+            if any(isinstance(v, BaseSparseNDArray) for v in vals):
+                # row-sparse merge (reference KVStoreLocal sparse push):
+                # rsp grads sum sparsely, and the updater sees the
+                # MERGED sparse grad so lazy row updates stay lazy
+                if not all(isinstance(v, RowSparseNDArray) for v in vals):
+                    raise MXNetError(
+                        "push of mixed sparse/dense values for key %r "
+                        "is not supported" % (k,))
+                merged = vals[0]
+                for v in vals[1:]:
+                    merged = _sp_add(merged, v)
+                if self._updater is not None:
+                    self._updater(k, merged, stored)
+                else:
+                    stored._set_jax(merged.todense()._data)
+                continue
+            merged = self._reduce(k, vals)
             if self._updater is not None:
                 self._updater(k, merged, stored)
             else:
@@ -389,13 +408,26 @@ class KVStoreDist(KVStoreDevice):
         self._worker.barrier()
 
     def push(self, key, value, priority=0):
+        from .ndarray.sparse import RowSparseNDArray, add as _sp_add
+
         keys, values = _group_kv(key, value)
+        sync = self._type != "dist_async"
         for k, vals in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % (k,))
+            if all(isinstance(v, RowSparseNDArray) for v in vals):
+                # rows-only on the wire (reference kRowSparsePushPull)
+                merged = vals[0]
+                for v in vals[1:]:
+                    merged = _sp_add(merged, v)
+                rows = np.asarray(merged.indices.asnumpy(), np.int64)
+                data = np.asarray(merged.data.asnumpy())
+                valid = rows < merged.shape[0]  # drop OOB grad padding
+                self._worker.push_rows(k, rows[valid], data[valid],
+                                       sync=sync)
+                continue
             merged = self._reduce(k, vals)
-            self._worker.push(k, merged.asnumpy(),
-                              sync=self._type != "dist_async")
+            self._worker.push(k, merged.asnumpy(), sync=sync)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if out is None:
@@ -414,6 +446,10 @@ class KVStoreDist(KVStoreDevice):
                 src.copyto(d)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull ONLY the requested rows over the wire (reference
+        `src/kvstore/kvstore_dist.h` PullRowSparse): the worker asks each
+        server for the flat spans its chunk holds of those rows —
+        traffic is O(rows * width), never the full value."""
         if out is None or row_ids is None:
             raise MXNetError("row_sparse_pull requires out= and row_ids=")
         keys, outs = _group_kv(key, out)
@@ -422,11 +458,14 @@ class KVStoreDist(KVStoreDevice):
             rids = rids * len(outs[0])
         from .ndarray import sparse as _sp
 
+        sync = self._type != "dist_async"
         for k, dsts in zip(keys, outs):
-            arr = self._worker.pull(k, sync=self._type != "dist_async")
-            src = NDArray(np.asarray(arr), ctx=dsts[0].ctx)
             for d, rid in zip(dsts, rids):
-                _sp.retain_rows_into(src, rid, d)
+                rid_np = np.asarray(
+                    rid.asnumpy() if isinstance(rid, NDArray) else rid
+                ).reshape(-1)
+                rows, data = self._worker.pull_rows(k, rid_np, sync=sync)
+                _sp.set_rows_into(rows, data, d)
 
     def set_optimizer(self, optimizer):
         # reference: optimizer is serialized to the servers and runs there

@@ -231,10 +231,19 @@ class NDArray(object):
     # -- autograd -----------------------------------------------------------
     def attach_grad(self, grad_req: str = "write", stype: Optional[str] = None):
         """Attach a gradient buffer (reference:
-        `python/mxnet/ndarray/ndarray.py` attach_grad → MXAutogradMarkVariables)."""
+        `python/mxnet/ndarray/ndarray.py` attach_grad → MXAutogradMarkVariables).
+        ``stype='row_sparse'`` makes the buffer a RowSparseNDArray so
+        embedding-style gradients stay sparse end to end."""
         import jax.numpy as jnp
 
-        grad = NDArray(jnp.zeros(self.shape, dtype=self._data.dtype), ctx=self._ctx)
+        if stype == "row_sparse":
+            from . import sparse as _sp
+
+            grad = _sp.zeros("row_sparse", self.shape, ctx=self._ctx,
+                             dtype=self._data.dtype)
+        else:
+            grad = NDArray(jnp.zeros(self.shape, dtype=self._data.dtype),
+                           ctx=self._ctx)
         self._grad = grad
         self._grad_req = grad_req
         self._marked = grad_req != "null"

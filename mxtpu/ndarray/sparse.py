@@ -36,7 +36,7 @@ from .ndarray import NDArray, array as _dense_array
 __all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
            "csr_matrix", "row_sparse_array", "cast_storage", "zeros",
            "empty", "array", "dot", "retain", "retain_rows_into",
-           "add", "elemwise_add"]
+           "set_rows_into", "add", "elemwise_add"]
 
 
 def _jnp():
@@ -317,7 +317,31 @@ def dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
             out = jax.ops.segment_sum(gathered, cols, num_segments=n)
         if vec_rhs:
             out = out[:, 0]
-        return NDArray(out, ctx=rhs.ctx, _committed=True)
+        result = NDArray(out, ctx=rhs.ctx, _committed=True)
+        # taped path: d(csr·W)/dW stays ROW-SPARSE (rows = features that
+        # appear in the batch) — the reference's dot(csr.T, ograd)
+        # row_sparse backward (`src/operator/tensor/dot-inl.h`
+        # DotCsrTransDnsRspImpl), static-shape segment-sum form here
+        from .. import autograd as _ag
+
+        if _ag.is_recording() and not vec_rhs and not transpose_a and (
+                getattr(rhs, "_marked", False)
+                or getattr(rhs, "_entry", None) is not None):
+            n_rows = rhs.shape[0]
+
+            def vjp_fn(cots):
+                (og,) = cots
+                contrib = jnp.take(og, rows, axis=0) * vals[:, None]
+                return (None, _ag._dedup_sparse_cot(cols, contrib, n_rows))
+
+            ent = getattr(rhs, "_entry", None)
+            entries = [None,
+                       ("node", ent[0], ent[1]) if ent is not None
+                       else ("leaf", rhs)]
+            node = _ag.TapeNode("sparse_dot", vjp_fn, entries,
+                               [(tuple(out.shape), out.dtype)])
+            result._entry = (node, 0)
+        return result
     if isinstance(lhs, NDArray) and not isinstance(lhs, BaseSparseNDArray) \
             and isinstance(rhs, CSRNDArray):
         # Dᵃ · Sᵇ = (Sᵇᵀ · Dᵃᵀ)ᵀ, with Dᵃᵀ = D when transpose_a else Dᵀ
@@ -379,6 +403,23 @@ def retain_rows_into(src: NDArray, row_ids, dst) -> None:
         dst._shape = tuple(src.shape)
     elif isinstance(dst, NDArray):
         out = jnp.zeros(src.shape, src._data.dtype).at[rids].set(rows)
+        dst._set_jax(out)
+    else:
+        raise MXNetError("bad row_sparse_pull target %r" % type(dst))
+
+
+def set_rows_into(rows: np.ndarray, data: np.ndarray, dst) -> None:
+    """Write already-gathered rows (from a wire row-subset pull) into
+    `dst`: a row_sparse target takes them verbatim; a dense target gets
+    them scattered over its existing shape."""
+    jnp = _jnp()
+    if isinstance(dst, RowSparseNDArray):
+        dst._set_jax(jnp.asarray(data))
+        dst._aux = (NDArray(jnp.asarray(rows.astype(np.int32)),
+                            ctx=dst.ctx),)
+    elif isinstance(dst, NDArray):
+        out = jnp.zeros(dst.shape, jnp.asarray(data).dtype)
+        out = out.at[jnp.asarray(rows)].set(jnp.asarray(data))
         dst._set_jax(out)
     else:
         raise MXNetError("bad row_sparse_pull target %r" % type(dst))

@@ -220,6 +220,67 @@ register = Optimizer.register
 create = Optimizer.create_optimizer
 
 
+_LAZY_KERNELS: Dict[Any, Any] = {}
+
+
+def _lazy_sgd_kernel(has_mom: bool, has_clip: bool):
+    """Jitted lazy row-sparse SGD step; weight (and momentum) buffers
+    donated so XLA scatters in place on TPU."""
+    key = ("sgd", has_mom, has_clip)
+    fn = _LAZY_KERNELS.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    if has_mom:
+        def kern(w, m, rows, gdata, lr, wd, rescale, momentum, clip):
+            g = gdata * rescale
+            if has_clip:
+                g = jnp.clip(g, -clip, clip)
+            wr = jnp.take(w, rows, axis=0)
+            mr = jnp.take(m, rows, axis=0)
+            mr = momentum * mr - lr * (g + wd * wr)
+            return w.at[rows].set(wr + mr), m.at[rows].set(mr)
+
+        fn = jax.jit(kern, donate_argnums=(0, 1))
+    else:
+        def kern(w, rows, gdata, lr, wd, rescale, momentum, clip):
+            g = gdata * rescale
+            if has_clip:
+                g = jnp.clip(g, -clip, clip)
+            wr = jnp.take(w, rows, axis=0)
+            return (w.at[rows].set(wr - lr * (g + wd * wr)),)
+
+        fn = jax.jit(kern, donate_argnums=(0,))
+    _LAZY_KERNELS[key] = fn
+    return fn
+
+
+def _lazy_adagrad_kernel(has_clip: bool):
+    """Jitted lazy row-sparse AdaGrad step (reference
+    `_sparse_adagrad_update`), history+weight donated."""
+    key = ("adagrad", has_clip)
+    fn = _LAZY_KERNELS.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    def kern(w, h, rows, gdata, lr, wd, rescale, eps, clip):
+        g = gdata * rescale
+        if has_clip:
+            g = jnp.clip(g, -clip, clip)
+        hr = jnp.take(h, rows, axis=0) + g * g
+        wr = jnp.take(w, rows, axis=0)
+        upd = wr - lr * (g / (jnp.sqrt(hr) + eps) + wd * wr)
+        return w.at[rows].set(upd), h.at[rows].set(hr)
+
+    fn = jax.jit(kern, donate_argnums=(0, 1))
+    _LAZY_KERNELS[key] = fn
+    return fn
+
+
 class ScanStep(object):
     """Pure-functional whole-tree optimizer step for compiled multi-step
     training (`mxtpu/fused_train.py`).
@@ -359,23 +420,24 @@ class SGD(Optimizer):
         if isinstance(grad, RowSparseNDArray) and self.lazy_update:
             # lazy row-sparse update (reference sgd[_mom]_update with
             # row_sparse grad, `src/operator/optimizer_op.cc`): only the
-            # rows present in the gradient are touched
-            import jax.numpy as jnp
-
-            rows = grad.indices._data
-            g = grad.data._data * self.rescale_grad
-            if self.clip_gradient is not None:
-                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-            w = weight._data
-            wr = jnp.take(w, rows, axis=0)
+            # rows present in the gradient are touched.  ONE jitted
+            # kernel with the weight/momentum buffers donated, so on
+            # TPU the scatter updates in place (O(rows) HBM traffic)
+            kern = _lazy_sgd_kernel(state is not None,
+                                    self.clip_gradient is not None)
             if state is None:
-                weight._set_jax(w.at[rows].set(
-                    wr - lr * (g + wd * wr)))
+                (new_w,) = kern(weight._data, grad.indices._data,
+                                grad.data._data, lr, wd,
+                                self.rescale_grad, self.momentum,
+                                self.clip_gradient or 0.0)
             else:
-                mr = jnp.take(state._data, rows, axis=0)
-                mr = self.momentum * mr - lr * (g + wd * wr)
-                state._set_jax(state._data.at[rows].set(mr))
-                weight._set_jax(w.at[rows].set(wr + mr))
+                new_w, new_m = kern(weight._data, state._data,
+                                    grad.indices._data, grad.data._data,
+                                    lr, wd, self.rescale_grad,
+                                    self.momentum,
+                                    self.clip_gradient or 0.0)
+                state._set_jax(new_m)
+            weight._set_jax(new_w)
             return
         if isinstance(grad, RowSparseNDArray):
             grad = grad.todense()
@@ -747,19 +809,15 @@ class AdaGrad(Optimizer):
         lr, wd = self._get_lr(index), self._get_wd(index)
         if isinstance(grad, RowSparseNDArray):
             # reference `_sparse_adagrad_update`: history/weight touched
-            # only on the gradient's rows
-            import jax.numpy as jnp
-
-            rows = grad.indices._data
-            g = grad.data._data * self.rescale_grad
-            if self.clip_gradient is not None:
-                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-            hr = jnp.take(state._data, rows, axis=0) + g * g
-            state._set_jax(state._data.at[rows].set(hr))
-            wr = jnp.take(weight._data, rows, axis=0)
-            upd = wr - lr * (g / (jnp.sqrt(hr) + self.float_stable_eps)
-                             + wd * wr)
-            weight._set_jax(weight._data.at[rows].set(upd))
+            # only on the gradient's rows; one jitted donated kernel
+            kern = _lazy_adagrad_kernel(self.clip_gradient is not None)
+            new_w, new_h = kern(weight._data, state._data,
+                                grad.indices._data, grad.data._data,
+                                lr, wd, self.rescale_grad,
+                                self.float_stable_eps,
+                                self.clip_gradient or 0.0)
+            state._set_jax(new_h)
+            weight._set_jax(new_w)
             return
         self._apply("_sparse_adagrad_update", weight, grad, (state,), lr=lr,
                     wd=wd, epsilon=self.float_stable_eps,

@@ -40,6 +40,46 @@ def main():
     np.testing.assert_allclose(big.asnumpy(),
                                np.full(BIG_SHAPE, expected), rtol=1e-5)
 
+    # row-sparse wire ops (reference kRowSparsePushPull,
+    # `src/kvstore/kvstore_dist.h` PullRowSparse): rows-only pushes
+    # merge across workers; rows-only pulls return exactly those rows.
+    # BIG shape so the key is server-SHARDED — spans cross chunk bounds.
+    kv.barrier()
+    from mxtpu.ndarray import sparse as sp
+
+    RSP_SHAPE = (1400, 1000)
+    kv.init("rsp", mx.nd.zeros(RSP_SHAPE))
+    my_rows = np.array([rank, 10 + rank, 1399], np.int64)
+    grad = sp.row_sparse_array(
+        (np.full((3, RSP_SHAPE[1]), float(rank + 1), np.float32), my_rows),
+        shape=RSP_SHAPE)
+    kv.push("rsp", grad)
+    dst = sp.zeros("row_sparse", RSP_SHAPE)
+    kv.row_sparse_pull("rsp", out=dst,
+                       row_ids=mx.nd.array(np.arange(0, 1400, 1,
+                                                     dtype=np.float32)))
+    dense = dst.asnumpy()
+    for r in range(nworker):  # each worker's private rows arrived
+        np.testing.assert_allclose(dense[r], np.full((RSP_SHAPE[1],),
+                                                     r + 1.0), rtol=1e-5)
+        np.testing.assert_allclose(dense[10 + r],
+                                   np.full((RSP_SHAPE[1],), r + 1.0),
+                                   rtol=1e-5)
+    # the shared row accumulated every worker's push
+    np.testing.assert_allclose(
+        dense[1399], np.full((RSP_SHAPE[1],),
+                             nworker * (nworker + 1) / 2.0), rtol=1e-5)
+    # untouched rows stayed zero
+    assert not dense[500].any()
+    # subset pull returns ONLY the requested rows
+    sub = sp.zeros("row_sparse", RSP_SHAPE)
+    kv.row_sparse_pull("rsp", out=sub,
+                       row_ids=mx.nd.array(np.array([1399.0], np.float32)))
+    assert sub.data.shape[0] == 1
+    np.testing.assert_allclose(
+        sub.data.asnumpy()[0],
+        np.full((RSP_SHAPE[1],), nworker * (nworker + 1) / 2.0), rtol=1e-5)
+
     # updater-on-server: sgd with lr 0.1 -> stored -= 0.1 * merged
     kv.barrier()
     kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1, wd=0.0))

