@@ -275,6 +275,11 @@ class Scheduler(object):
         self._barrier_gen = 0
         self._done = 0
         self._threads: List[threading.Thread] = []
+        # failure detection (reference `include/mxnet/kvstore.h:346-355`
+        # get_num_dead_node + ps-lite heartbeats): node id -> last beat.
+        # Node ids follow the ps-lite convention: scheduler 1, server
+        # rank r -> 8 + 2r, worker rank r -> 9 + 2r.
+        self._last_beat: Dict[int, float] = {}
 
     def run(self):
         while True:
@@ -302,12 +307,28 @@ class Scheduler(object):
                 op = msg["op"]
                 if op == "register":
                     _send_msg(conn, self._register(msg))
+                elif op == "heartbeat":
+                    with self._cv:
+                        self._last_beat[int(msg["node_id"])] = time.time()
+                    _send_msg(conn, {"ok": True})
+                elif op == "dead_nodes":
+                    timeout = float(msg.get("timeout", 60.0))
+                    now = time.time()
+                    with self._cv:
+                        dead = sorted(nid for nid, ts in
+                                      self._last_beat.items()
+                                      if now - ts > timeout)
+                    _send_msg(conn, {"dead": dead})
                 elif op == "barrier":
                     self._barrier()
                     _send_msg(conn, {"ok": True})
                 elif op == "done":
                     with self._cv:
                         self._done += 1
+                        # a cleanly-exited node is not a DEAD node —
+                        # drop it from the failure detector
+                        self._last_beat.pop(int(msg.get("node_id", -1)),
+                                            None)
                         self._cv.notify_all()
                     _send_msg(conn, {"ok": True})
                     if self._maybe_shutdown():
@@ -324,14 +345,18 @@ class Scheduler(object):
             if msg["role"] == "server":
                 self._servers.append(tuple(msg["addr"]))
                 rank = len(self._servers) - 1
+                node_id = 8 + 2 * rank
                 self._cv.notify_all()
             else:
                 rank = self._worker_ranks
                 self._worker_ranks += 1
+                node_id = 9 + 2 * rank
+            self._last_beat[node_id] = time.time()
             while len(self._servers) < self._ns:
                 self._cv.wait()
             return {"rank": rank, "servers": list(self._servers),
-                    "num_workers": self._nw, "num_servers": self._ns}
+                    "num_workers": self._nw, "num_servers": self._ns,
+                    "node_id": node_id}
 
     def _barrier(self):
         with self._cv:
@@ -350,6 +375,10 @@ class Scheduler(object):
             if self._done < self._nw:
                 return False
             servers = list(self._servers)
+            # servers are being shut down deliberately below: clear
+            # their liveness entries too
+            for i in range(len(servers)):
+                self._last_beat.pop(8 + 2 * i, None)
         for addr in servers:
             try:
                 c = _Client(addr, retries=3)
@@ -365,6 +394,40 @@ class Scheduler(object):
         except OSError:
             pass
         return True
+
+
+def _heartbeat_interval() -> float:
+    return float(_env("MXTPU_PS_HEARTBEAT_INTERVAL",
+                      "DMLC_PS_HEARTBEAT_INTERVAL", default="1.0"))
+
+
+def _start_heartbeat(node_id: int, stopped):
+    """Daemon thread beating the scheduler every interval (ps-lite
+    heartbeat analog; feeds the scheduler's dead-node detector).
+
+    Uses its OWN scheduler connection: the main client's request lock
+    is held for the full duration of blocking ops (barrier), and a
+    worker waiting at a barrier must keep heartbeating — otherwise the
+    detector would flag exactly the healthy stragglers it exists to
+    distinguish from crashes."""
+    interval = _heartbeat_interval()
+
+    def loop():
+        try:
+            client = _Client(_root_addr())
+        except ConnectionError:
+            return
+        while not stopped():
+            try:
+                client.request({"op": "heartbeat", "node_id": node_id})
+            except (ConnectionError, EOFError, OSError):
+                break  # scheduler gone: shutdown in progress
+            time.sleep(interval)
+        client.close()
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
 
 
 # ---------------------------------------------------------------------------
@@ -400,6 +463,8 @@ class Server(object):
         info = self._sched.request({"op": "register", "role": "server",
                                     "addr": self._addr})
         self.rank = info["rank"]
+        self.node_id = info.get("node_id", 8 + 2 * self.rank)
+        _start_heartbeat(self.node_id, lambda: self._shutdown)
 
     def run(self):
         threads = []
@@ -608,6 +673,16 @@ class Worker(object):
         self._last_version: Dict[Any, int] = {}
         self._meta_shape: Dict[Any, Tuple] = {}
         self._bigarray = _bigarray_bound()
+        self.node_id = info.get("node_id", 9 + 2 * self.rank)
+        self._closed = False
+        _start_heartbeat(self.node_id, lambda: self._closed)
+
+    def num_dead_nodes(self, timeout: float = 60.0):
+        """Node ids with no heartbeat within `timeout` seconds
+        (reference `include/mxnet/kvstore.h:346-355` get_num_dead_node;
+        ps-lite Postoffice::GetDeadNodes)."""
+        rep = self._sched.request({"op": "dead_nodes", "timeout": timeout})
+        return list(rep.get("dead", []))
 
     def register_meta(self, key, shape, dtype):
         """Record a key's shape/dtype without initializing it on the
@@ -749,8 +824,9 @@ class Worker(object):
                                       % (head, rep["error"]))
 
     def close(self):
+        self._closed = True  # stop the heartbeat thread
         try:
-            self._sched.request({"op": "done"})
+            self._sched.request({"op": "done", "node_id": self.node_id})
         except ConnectionError:
             pass
         for s in self._servers:

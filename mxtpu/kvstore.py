@@ -324,41 +324,71 @@ class KVStoreTPU(KVStoreDevice):
         super().__init__()
         self._mesh = mesh
         self._axis = axis
+        self.last_reduce_path = None  # "psum" | "fallback" (introspection)
+        self._warned_fallback = False
 
     @property
     def type(self):
         return "tpu"
+
+    def _dp_line_mesh(self, mesh, n):
+        """A 1-D sub-mesh over the `n` devices forming the reduce axis.
+        For a 1-D (or effectively-1-D) mesh that is the mesh itself; for
+        a multi-axis mesh (dp, tp, ...) it is the dp line at index 0 of
+        every other axis — the n Module replicas map onto it in order."""
+        if self._axis not in mesh.shape or mesh.shape[self._axis] != n:
+            return None
+        if len(mesh.devices.flat) == n:
+            if len(mesh.axis_names) == 1:
+                return mesh
+            from jax.sharding import Mesh
+
+            return Mesh(mesh.devices.reshape(n), (self._axis,))
+        from jax.sharding import Mesh
+
+        ai = list(mesh.axis_names).index(self._axis)
+        line = np.moveaxis(mesh.devices, ai, 0).reshape(n, -1)[:, 0]
+        return Mesh(line, (self._axis,))
 
     def _reduce(self, k, vals: List[NDArray]) -> NDArray:
         from .parallel.mesh import current_mesh
 
         mesh = self._mesh or current_mesh()
         n = len(vals)
-        # shard-assembly below assumes a 1-D mesh (one device per pushed
-        # value); multi-axis meshes fall back to the fused device merge
-        if mesh is not None and n > 1 and self._axis in mesh.shape \
-                and mesh.shape[self._axis] == n \
-                and len(mesh.devices.flat) == n:
+        line = self._dp_line_mesh(mesh, n) if mesh is not None and n > 1 \
+            else None
+        if line is not None:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec
 
             from .parallel import collectives
 
-            # one shard per pushed value, placed on the mesh's dp-axis
+            # one shard per pushed value, placed on the reduce-line
             # devices in order — no host round-trip, replica i's gradient
-            # stays on (or moves device-to-device to) mesh device i
-            sharding = NamedSharding(mesh, PartitionSpec(self._axis))
+            # stays on (or moves device-to-device to) line device i
+            sharding = NamedSharding(line, PartitionSpec(self._axis))
             shape0 = vals[0].shape
-            mesh_devs = list(mesh.devices.flat)
+            line_devs = list(line.devices.flat)
             shards = [jax.device_put(v._data.reshape((1,) + shape0), d)
-                      for v, d in zip(vals, mesh_devs)]
+                      for v, d in zip(vals, line_devs)]
             stacked = jax.make_array_from_single_device_arrays(
                 (n,) + shape0, sharding, shards)
             merged = collectives.all_reduce(stacked, axis=self._axis,
-                                            mesh=mesh)[0]
+                                            mesh=line)[0]
             if self._compression is not None:
                 merged = self._compression.compress(k, merged)
+            self.last_reduce_path = "psum"
             return NDArray(merged, ctx=vals[0].ctx, _committed=True)
+        if mesh is not None and n > 1 and not self._warned_fallback:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "kvstore=tpu: %d pushed values do not line up with the "
+                "mesh's %r axis (shape %s) — falling back to the fused "
+                "device merge (no XLA collective)", n, self._axis,
+                dict(mesh.shape))
+            self._warned_fallback = True
+        self.last_reduce_path = "fallback"
         return super()._reduce(k, vals)
 
 
@@ -481,6 +511,19 @@ class KVStoreDist(KVStoreDevice):
 
     def send_command_to_servers(self, head, body):
         self._worker.send_command(head, body)
+
+    def num_dead_node(self, node_id=6, timeout=60):
+        """Count nodes with no heartbeat within `timeout` seconds
+        (reference `include/mxnet/kvstore.h:346-355` get_num_dead_node).
+        `node_id` is the ps-lite group mask: 2 servers | 4 workers
+        (default: both).  Scheduler liveness is not tracked — a dead
+        scheduler surfaces as a ConnectionError from this very query."""
+        count = 0
+        for nid in self._worker.num_dead_nodes(timeout):
+            group = 2 if nid % 2 == 0 else 4  # servers 8+2r, workers 9+2r
+            if node_id & group:
+                count += 1
+        return count
 
     def close(self):
         self._worker.close()

@@ -1,0 +1,90 @@
+"""Worker script for the multi-process dist_async test (run under
+tools/launch.py; reference: `tests/nightly/dist_async_kvstore.py`).
+
+Asserts ASYNC semantics: every push applies on the server immediately
+(updater per push, no per-round accumulation barrier), so after each
+worker pushes `k` times the store reflects ALL nworker*k updates once
+workers synchronize.  Also covers non-divisible server sharding (odd
+sizes striped over the server group) and heartbeat-based dead-node
+detection (reference `kvstore.h:346` get_num_dead_node)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXTPU_PS_HEARTBEAT_INTERVAL", "0.2")
+
+import time
+
+import numpy as np
+
+import mxtpu as mx
+
+# deliberately awkward shapes: prime row counts and sizes that do NOT
+# divide across 2 servers (reference nightly uses irregular keys too)
+SHAPE = (7, 13)
+BIG_SHAPE = (1217, 821)  # ~1M elements, prime-ish -> uneven server stripes
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker > 1, "run under tools/launch.py -n 2"
+    assert kv.type == "dist_async"
+
+    # updater-on-server, applied PER PUSH (no sync barrier): a counting
+    # updater makes the per-push semantics observable
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=1.0,
+                                         wd=0.0, rescale_grad=1.0))
+    kv.init("w", mx.nd.zeros(SHAPE))
+    kv.init("big", mx.nd.zeros(BIG_SHAPE))
+    kv.barrier()
+
+    # each worker pushes k times WITHOUT any barrier between pushes;
+    # async means each push lands on its own
+    k = 3
+    for _ in range(k):
+        kv.push("w", mx.nd.ones(SHAPE))
+    # big key: push rank-dependent value over the non-divisible stripes
+    kv.push("big", mx.nd.ones(BIG_SHAPE) * (rank + 1))
+
+    # async pulls return immediately with SOME recent state; only after
+    # the barrier must every push be visible
+    kv.barrier()
+    time.sleep(0.3)  # drain any in-flight server applies
+    out = mx.nd.empty(SHAPE)
+    kv.pull("w", out=out)
+    # sgd lr=1 on grad ones: w -= 1 per push -> -(nworker * k)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.full(SHAPE, -(nworker * k)), rtol=1e-5)
+
+    big = mx.nd.empty(BIG_SHAPE)
+    kv.pull("big", out=big)
+    expected = -sum(r + 1 for r in range(nworker))
+    np.testing.assert_allclose(big.asnumpy(),
+                               np.full(BIG_SHAPE, expected), rtol=1e-5)
+
+    # rows-only pull across the uneven stripes
+    from mxtpu.ndarray import sparse as sp
+
+    sub = sp.zeros("row_sparse", BIG_SHAPE)
+    kv.row_sparse_pull("big", out=sub,
+                       row_ids=mx.nd.array(np.array([0.0, 603.0, 1216.0],
+                                                    np.float32)))
+    assert sub.data.shape == (3, BIG_SHAPE[1])
+    np.testing.assert_allclose(sub.data.asnumpy(),
+                               np.full((3, BIG_SHAPE[1]), expected),
+                               rtol=1e-5)
+
+    # heartbeats: everything alive now; a node silent for longer than
+    # the probe window counts dead (we can't kill a process here without
+    # wedging the round, so probe with a sub-interval timeout instead)
+    assert kv.num_dead_node(timeout=30) == 0
+    time.sleep(0.5)
+    assert kv.num_dead_node(timeout=0.01) >= 1
+
+    kv.barrier()
+    kv.close()
+    print("DIST_ASYNC_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
