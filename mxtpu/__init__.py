@@ -73,6 +73,7 @@ from . import image
 from . import gluon
 from . import fused_train
 from .fused_train import FusedTrainLoop
+from . import contrib
 
 
 def tpu_count():

@@ -1,0 +1,4 @@
+"""Contrib: experimental / interchange subsystems (reference
+`python/mxnet/contrib/`): INT8 quantization calibration + ONNX."""
+from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401

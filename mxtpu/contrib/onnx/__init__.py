@@ -1,0 +1,10 @@
+"""ONNX interchange (reference `python/mxnet/contrib/onnx/`):
+`export_model` writes traced Symbols + params as real `.onnx`
+protobufs; `import_model` loads them back as (Symbol, arg_params,
+aux_params).  Self-contained — the protobuf wire format is encoded
+directly (`_proto.py`), no `onnx` package needed."""
+from .export_onnx import export_model, export_symbol  # noqa: F401
+from .import_onnx import import_model  # noqa: F401
+
+# reference exposes these under mx.contrib.onnx.mx2onnx/onnx2mx too
+get_model_metadata = None  # pragma: no cover (reference parity stub)

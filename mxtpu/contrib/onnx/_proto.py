@@ -1,0 +1,131 @@
+"""Minimal protobuf wire codec for ONNX ModelProto.
+
+The environment has no `onnx` package (zero egress), so this module
+encodes/decodes the protobuf wire format directly for the subset of
+fields export/import use.  Files written here are REAL `.onnx`
+protobufs — loadable by onnxruntime/netron elsewhere — not a private
+serialization.  Field numbers follow onnx/onnx.proto (IR v7/opset 12).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+# wire types
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+
+# ---------------------------------------------------------------------------
+# primitive writers
+# ---------------------------------------------------------------------------
+
+def _varint(v: int) -> bytes:
+    if v < 0:
+        v += 1 << 64
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def w_varint(field: int, v: int) -> bytes:
+    return _tag(field, _VARINT) + _varint(int(v))
+
+
+def w_bytes(field: int, payload: bytes) -> bytes:
+    return _tag(field, _LEN) + _varint(len(payload)) + payload
+
+
+def w_str(field: int, s: str) -> bytes:
+    return w_bytes(field, s.encode("utf-8"))
+
+
+def w_float(field: int, f: float) -> bytes:
+    return _tag(field, _I32) + struct.pack("<f", f)
+
+
+def w_packed_floats(field: int, fs) -> bytes:
+    return w_bytes(field, b"".join(struct.pack("<f", float(f)) for f in fs))
+
+
+def w_packed_ints(field: int, vs) -> bytes:
+    return w_bytes(field, b"".join(_varint(int(v)) for v in vs))
+
+
+# ---------------------------------------------------------------------------
+# generic reader
+# ---------------------------------------------------------------------------
+
+def parse(buf: bytes) -> Dict[int, List[Tuple[int, Any]]]:
+    """Parse one message into {field: [(wire_type, raw_value), ...]}.
+    LEN fields return raw bytes (parse nested messages recursively)."""
+    out: Dict[int, List[Tuple[int, Any]]] = {}
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == _VARINT:
+            v, i = _read_varint(buf, i)
+        elif wire == _I64:
+            v = struct.unpack_from("<q", buf, i)[0]
+            i += 8
+        elif wire == _LEN:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wire == _I32:
+            v = struct.unpack_from("<f", buf, i)[0]
+            i += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        out.setdefault(field, []).append((wire, v))
+    return out
+
+
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = v = 0
+    while True:
+        b = buf[i]
+        i += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            if v >= 1 << 63:
+                v -= 1 << 64
+            return v, i
+        shift += 7
+
+
+def first(fields, num, default=None):
+    vals = fields.get(num)
+    return vals[0][1] if vals else default
+
+
+def every(fields, num):
+    return [v for _, v in fields.get(num, [])]
+
+
+def as_str(v, default=""):
+    return v.decode("utf-8") if isinstance(v, (bytes, bytearray)) else \
+        (v if v is not None else default)
+
+
+def unpack_ints(raw) -> List[int]:
+    """Packed repeated varint field -> list."""
+    if raw is None:
+        return []
+    if isinstance(raw, int):
+        return [raw]
+    out, i = [], 0
+    while i < len(raw):
+        v, i = _read_varint(raw, i)
+        out.append(v)
+    return out

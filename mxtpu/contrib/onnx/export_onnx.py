@@ -1,0 +1,312 @@
+"""Symbol -> ONNX export (reference `contrib/onnx/mx2onnx/export_model.py`).
+
+Maps the traced Symbol IR onto ONNX opset-12 nodes and writes a real
+ModelProto protobuf via `_proto.py`.  Covered surface = what the gluon
+model zoo traces to (Conv/BN/activations/pooling/FC/residual adds/
+concat/flatten/softmax/dropout/reshape + scalar arithmetic); anything
+else raises with the op name so gaps are loud, like the reference's
+per-op converter registry.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...base import MXNetError
+from . import _proto as P
+
+_DT = {np.dtype(np.float32): 1, np.dtype(np.uint8): 2,
+       np.dtype(np.int8): 3, np.dtype(np.int32): 6,
+       np.dtype(np.int64): 7, np.dtype(np.bool_): 9,
+       np.dtype(np.float64): 11}
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+_ELEMWISE = {"elemwise_add": "Add", "broadcast_add": "Add",
+             "elemwise_mul": "Mul", "broadcast_mul": "Mul",
+             "elemwise_sub": "Sub", "broadcast_sub": "Sub",
+             "elemwise_div": "Div", "broadcast_div": "Div",
+             "_grad_add": "Add"}
+_UNARY = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+          "exp": "Exp", "log": "Log", "sqrt": "Sqrt", "abs": "Abs",
+          "negative": "Neg", "floor": "Floor", "ceil": "Ceil",
+          "_copy": "Identity", "BlockGrad": "Identity",
+          "make_loss": "Identity", "MakeLoss": "Identity"}
+
+
+def _attr_f(name: str, v: float) -> bytes:
+    return P.w_str(1, name) + P.w_float(2, float(v)) + P.w_varint(20, 1)
+
+
+def _attr_i(name: str, v: int) -> bytes:
+    return P.w_str(1, name) + P.w_varint(3, int(v)) + P.w_varint(20, 2)
+
+
+def _attr_s(name: str, s: str) -> bytes:
+    return P.w_str(1, name) + P.w_bytes(4, s.encode()) + P.w_varint(20, 3)
+
+
+def _attr_ints(name: str, vs) -> bytes:
+    body = P.w_str(1, name) + P.w_varint(20, 7)
+    for v in vs:
+        body += P.w_varint(8, int(v))
+    return body
+
+
+def _tensor(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in _DT:
+        raise MXNetError("unsupported ONNX dtype %s" % arr.dtype)
+    body = b"".join(P.w_varint(1, d) for d in arr.shape)
+    body += P.w_varint(2, _DT[arr.dtype])
+    body += P.w_str(8, name)
+    body += P.w_bytes(9, arr.tobytes())
+    return body
+
+
+def _value_info(name: str, shape, elem_type: int = 1) -> bytes:
+    dims = b"".join(P.w_bytes(1, P.w_varint(1, d)) for d in shape)
+    tensor_t = P.w_varint(1, elem_type) + P.w_bytes(2, dims)
+    return P.w_str(1, name) + P.w_bytes(2, P.w_bytes(1, tensor_t))
+
+
+def _node(op_type: str, inputs: List[str], outputs: List[str],
+          name: str, attrs: List[bytes] = ()) -> bytes:
+    body = b"".join(P.w_str(1, i) for i in inputs)
+    body += b"".join(P.w_str(2, o) for o in outputs)
+    body += P.w_str(3, name) + P.w_str(4, op_type)
+    body += b"".join(P.w_bytes(5, a) for a in attrs)
+    return body
+
+
+def _pair(v, n=2, default=1):
+    t = tuple(int(x) for x in v) if v else (default,) * n
+    return t if len(t) == n else t * n
+
+
+class _Exporter(object):
+    def __init__(self, sym, params: Dict[str, np.ndarray],
+                 aux: Dict[str, np.ndarray]):
+        self.sym = sym
+        self.params = dict(params)
+        self.aux = dict(aux)
+        self.nodes: List[bytes] = []
+        self.extra_inits: Dict[str, np.ndarray] = {}
+        self.used_params: set = set()
+        self._uid = 0
+
+    def uid(self, base):
+        self._uid += 1
+        return "%s_%d" % (base, self._uid)
+
+    def tname(self, entry) -> str:
+        node, idx = entry
+        if node.is_variable:
+            return node.name
+        if node.num_outputs() == 1:
+            return node.name + "_output"
+        return "%s_output%d" % (node.name, idx)
+
+    def const(self, base, arr) -> str:
+        name = self.uid(base)
+        self.extra_inits[name] = np.asarray(arr)
+        return name
+
+    def emit(self, op_type, ins, outs, name, attrs=()):
+        self.nodes.append(_node(op_type, ins, outs, name, list(attrs)))
+
+    # -- per-op conversion ------------------------------------------------
+    def convert(self, node):
+        a = node.attrs
+        ins = [self.tname(e) for e in node.inputs]
+        out = self.tname((node, 0))
+        op = node.op.name
+        for p in ins:
+            if p in self.params or p in self.aux:
+                self.used_params.add(p)
+        if op in ("Convolution", "Convolution_v1"):
+            k = tuple(int(x) for x in a["kernel"])
+            n = len(k)
+            attrs = [_attr_ints("kernel_shape", k),
+                     _attr_ints("strides", _pair(a.get("stride"), n)),
+                     _attr_ints("dilations", _pair(a.get("dilate"), n)),
+                     _attr_ints("pads", _pair(a.get("pad"), n, 0) * 2),
+                     _attr_i("group", a.get("num_group", 1))]
+            self.emit("Conv", ins[:2 if a.get("no_bias") else 3],
+                      [out], node.name, attrs)
+        elif op == "FullyConnected":
+            x = ins[0]
+            if a.get("flatten", True):
+                flat = self.uid(node.name + "_flat")
+                self.emit("Flatten", [x], [flat], flat, [_attr_i("axis", 1)])
+                x = flat
+            gemm_in = [x, ins[1]] + ([] if a.get("no_bias") else [ins[2]])
+            self.emit("Gemm", gemm_in, [out], node.name,
+                      [_attr_i("transB", 1)])
+        elif op in ("BatchNorm", "BatchNorm_v1", "_contrib_SyncBatchNorm"):
+            gamma = ins[1]
+            if a.get("fix_gamma", True):
+                shape = (self.params.get(ins[1]) if ins[1] in self.params
+                         else np.ones(1)).shape
+                gamma = self.const(node.name + "_fixed_gamma",
+                                   np.ones(shape, np.float32))
+            self.emit("BatchNormalization",
+                      [ins[0], gamma, ins[2], ins[3], ins[4]], [out],
+                      node.name,
+                      [_attr_f("epsilon", a.get("eps", 1e-3)),
+                       _attr_f("momentum", a.get("momentum", 0.9))])
+        elif op == "Activation":
+            act = a.get("act_type", "relu")
+            if act not in _ACT:
+                raise MXNetError("ONNX export: act_type %r" % act)
+            self.emit(_ACT[act], ins, [out], node.name)
+        elif op == "Pooling":
+            ptype = a.get("pool_type", "max")
+            if a.get("global_pool", False):
+                self.emit("GlobalMaxPool" if ptype == "max"
+                          else "GlobalAveragePool", ins, [out], node.name)
+            else:
+                k = tuple(int(x) for x in a["kernel"])
+                n = len(k)
+                attrs = [_attr_ints("kernel_shape", k),
+                         _attr_ints("strides", _pair(a.get("stride"), n)),
+                         _attr_ints("pads", _pair(a.get("pad"), n, 0) * 2)]
+                if a.get("pooling_convention", "valid") == "full":
+                    attrs.append(_attr_i("ceil_mode", 1))
+                if ptype == "avg":
+                    attrs.append(_attr_i(
+                        "count_include_pad",
+                        1 if a.get("count_include_pad", True) else 0))
+                self.emit("MaxPool" if ptype == "max" else "AveragePool",
+                          ins, [out], node.name, attrs)
+        elif op in ("softmax", "SoftmaxActivation"):
+            self.emit("Softmax", ins[:1], [out], node.name,
+                      [_attr_i("axis", a.get("axis", -1))])
+        elif op in ("SoftmaxOutput", "Softmax"):
+            self.emit("Softmax", ins[:1], [out], node.name,
+                      [_attr_i("axis", -1)])
+        elif op == "log_softmax":
+            self.emit("LogSoftmax", ins[:1], [out], node.name,
+                      [_attr_i("axis", a.get("axis", -1))])
+        elif op in _ELEMWISE:
+            self.emit(_ELEMWISE[op], ins, [out], node.name)
+        elif op in _UNARY:
+            self.emit(_UNARY[op], ins, [out], node.name)
+        elif op == "add_n":
+            self.emit("Sum", ins, [out], node.name)
+        elif op == "Concat":
+            self.emit("Concat", ins, [out], node.name,
+                      [_attr_i("axis", a.get("dim", 1))])
+        elif op == "Flatten":
+            self.emit("Flatten", ins, [out], node.name, [_attr_i("axis", 1)])
+        elif op == "Reshape":
+            shape = self.const(node.name + "_shape",
+                               np.asarray(a.get("shape", ()), np.int64))
+            self.emit("Reshape", [ins[0], shape], [out], node.name)
+        elif op == "transpose":
+            axes = a.get("axes")
+            self.emit("Transpose", ins, [out], node.name,
+                      [_attr_ints("perm", axes)] if axes else [])
+        elif op == "Dropout":
+            # opset 12: ratio travels as an optional input tensor
+            ratio = self.const(node.name + "_ratio",
+                               np.asarray(a.get("p", 0.5), np.float32))
+            self.emit("Dropout", [ins[0], ratio], [out], node.name)
+        elif op == "LeakyReLU":
+            act = a.get("act_type", "leaky")
+            if act == "leaky":
+                self.emit("LeakyRelu", ins[:1], [out], node.name,
+                          [_attr_f("alpha", a.get("slope", 0.25))])
+            elif act == "elu":
+                self.emit("Elu", ins[:1], [out], node.name,
+                          [_attr_f("alpha", a.get("slope", 1.0))])
+            else:
+                raise MXNetError("ONNX export: LeakyReLU %r" % act)
+        elif op == "clip":
+            # opset 11+: min/max are INPUT tensors, not attributes
+            lo = self.const(node.name + "_min",
+                            np.asarray(a.get("a_min", 0.0), np.float32))
+            hi = self.const(node.name + "_max",
+                            np.asarray(a.get("a_max", 0.0), np.float32))
+            self.emit("Clip", [ins[0], lo, hi], [out], node.name)
+        elif op in ("_mul_scalar", "_plus_scalar", "_minus_scalar",
+                    "_div_scalar"):
+            onnx_op = {"_mul_scalar": "Mul", "_plus_scalar": "Add",
+                       "_minus_scalar": "Sub", "_div_scalar": "Div"}[op]
+            s = self.const(node.name + "_scalar",
+                           np.asarray(a.get("scalar", 0.0), np.float32))
+            self.emit(onnx_op, [ins[0], s], [out], node.name)
+        elif op == "mean" and a.get("axis") in ((2, 3), [2, 3]) \
+                and not a.get("keepdims"):
+            gap = self.uid(node.name + "_gap")
+            self.emit("GlobalAveragePool", ins, [gap], gap)
+            self.emit("Flatten", [gap], [out], node.name,
+                      [_attr_i("axis", 1)])
+        else:
+            raise MXNetError(
+                "ONNX export: no converter for op %r (node %r) — "
+                "extend mxtpu/contrib/onnx/export_onnx.py" % (op, node.name))
+
+
+def export_symbol(sym, params: Dict[str, Any], aux: Dict[str, Any],
+                  input_shapes: Dict[str, Tuple[int, ...]],
+                  model_name: str = "mxtpu") -> bytes:
+    """Serialize (sym, params) to ONNX ModelProto bytes."""
+    pnp = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+           for k, v in (params or {}).items()}
+    anp = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+           for k, v in (aux or {}).items()}
+    ex = _Exporter(sym, pnp, anp)
+    label_like = set()
+    for node in sym._topo():
+        if node.is_variable:
+            continue
+        if node.op.name in ("SoftmaxOutput", "Softmax",
+                            "LinearRegressionOutput",
+                            "LogisticRegressionOutput",
+                            "MAERegressionOutput", "SVMOutput"):
+            for src, _ in node.inputs[1:]:
+                if src.is_variable:
+                    label_like.add(src.name)
+        ex.convert(node)
+
+    inits = b""
+    for name in sorted(ex.used_params):
+        arr = pnp.get(name, anp.get(name))
+        inits += P.w_bytes(5, _tensor(name, arr))
+    for name, arr in ex.extra_inits.items():
+        inits += P.w_bytes(5, _tensor(name, arr))
+
+    inputs = b""
+    all_params = set(pnp) | set(anp) | set(ex.extra_inits)
+    for node in sym._topo():
+        if node.is_variable and node.name not in all_params \
+                and node.name not in label_like:
+            if node.name not in input_shapes:
+                raise MXNetError("input_shapes missing %r" % node.name)
+            inputs += P.w_bytes(11, _value_info(node.name,
+                                                input_shapes[node.name]))
+    outputs = b""
+    _, out_shapes, _ = sym.infer_shape(**dict(input_shapes))
+    for name, shape in zip(sym.list_outputs(), out_shapes):
+        outputs += P.w_bytes(12, _value_info(name, shape))
+
+    graph = b"".join(P.w_bytes(1, n) for n in ex.nodes)
+    graph += P.w_str(2, model_name) + inits + inputs + outputs
+    opset = P.w_str(1, "") + P.w_varint(2, 12)
+    model = (P.w_varint(1, 7) + P.w_str(2, "mxtpu") +
+             P.w_str(3, "0.1") + P.w_bytes(7, graph) + P.w_bytes(8, opset))
+    return model
+
+
+def export_model(sym, params, aux, input_shapes, onnx_file_path,
+                 model_name: str = "mxtpu") -> str:
+    """Write the model to `onnx_file_path` and return the path
+    (reference `onnx_mxnet.export_model`)."""
+    if hasattr(sym, "_cached_symbol"):  # allow HybridBlock-ish inputs
+        sym = sym._cached_symbol
+    blob = export_symbol(sym, params, aux, input_shapes, model_name)
+    with open(onnx_file_path, "wb") as f:
+        f.write(blob)
+    return onnx_file_path

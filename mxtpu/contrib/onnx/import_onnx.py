@@ -1,0 +1,288 @@
+"""ONNX -> Symbol import (reference `contrib/onnx/onnx2mx/import_model.py`).
+
+Parses a ModelProto (via `_proto.py`) and rebuilds the graph with
+mxtpu symbols; initializers become arg_params (BatchNormalization's
+running mean/var become aux_params, matching the reference's aux
+split).  Covers the same op subset the exporter emits.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray.ndarray import array as nd_array
+from ...symbol.register import invoke_symbol
+from ...symbol.symbol import Symbol, Variable
+from . import _proto as P
+
+_NP_DT = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
+          7: np.int64, 9: np.bool_, 11: np.float64}
+
+_ACT = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+        "Softplus": "softrelu", "Softsign": "softsign"}
+_ELEMWISE = {"Add": "broadcast_add", "Mul": "broadcast_mul",
+             "Sub": "broadcast_sub", "Div": "broadcast_div"}
+_UNARY = {"Exp": "exp", "Log": "log", "Sqrt": "sqrt", "Abs": "abs",
+          "Neg": "negative", "Floor": "floor", "Ceil": "ceil",
+          "Identity": "_copy"}
+
+
+def _parse_tensor(raw: bytes) -> Tuple[str, np.ndarray]:
+    f = P.parse(raw)
+    dims: List[int] = []
+    for wire, v in f.get(1, []):  # proto3 packs repeated int64 (wire 2)
+        dims.extend(P.unpack_ints(v) if wire == 2 else [v])
+    dtype = _NP_DT[P.first(f, 2, 1)]
+    name = P.as_str(P.first(f, 8))
+    if 9 in f:
+        arr = np.frombuffer(P.first(f, 9), dtype=dtype).reshape(dims)
+    elif 4 in f:  # float_data
+        arr = np.asarray(P.every(f, 4), np.float32).reshape(dims)
+    elif 7 in f:  # int64_data (possibly packed)
+        vals = []
+        for wire, v in f[7]:
+            vals.extend(P.unpack_ints(v) if wire == 2 else [v])
+        arr = np.asarray(vals, np.int64).reshape(dims)
+    else:
+        arr = np.zeros(dims, dtype)
+    return name, arr
+
+
+def _parse_attrs(node_fields) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for raw in P.every(node_fields, 5):
+        f = P.parse(raw)
+        name = P.as_str(P.first(f, 1))
+        atype = P.first(f, 20, 0)
+        if atype == 1:
+            out[name] = P.first(f, 2)
+        elif atype == 2:
+            out[name] = P.first(f, 3)
+        elif atype == 3:
+            out[name] = P.as_str(P.first(f, 4))
+        elif atype == 4:
+            out[name] = _parse_tensor(P.first(f, 5))[1]
+        elif atype == 7:
+            vals = []
+            for wire, v in f.get(8, []):
+                vals.extend(P.unpack_ints(v) if wire == 2 else [v])
+            out[name] = tuple(vals)
+        elif atype == 6:
+            vals = []
+            for wire, v in f.get(7, []):
+                if wire == 2:  # packed fixed32 floats
+                    vals.extend(struct.unpack("<%df" % (len(v) // 4), v))
+                else:
+                    vals.append(v)
+            out[name] = tuple(vals)
+    return out
+
+
+def _pairs(t, n=2, default=1):
+    t = tuple(int(x) for x in (t or ()))
+    return t[:n] if t else (default,) * n
+
+
+def import_model(onnx_file_path: str):
+    """Load an ONNX file -> (sym, arg_params, aux_params)
+    (reference `onnx_mxnet.import_model`)."""
+    with open(onnx_file_path, "rb") as f:
+        model = P.parse(f.read())
+    graph = P.parse(P.first(model, 7, b""))
+
+    inits: Dict[str, np.ndarray] = {}
+    for raw in P.every(graph, 5):
+        name, arr = _parse_tensor(raw)
+        inits[name] = arr
+
+    tensors: Dict[str, Symbol] = {}
+    for raw in P.every(graph, 11):  # graph inputs
+        fi = P.parse(raw)
+        name = P.as_str(P.first(fi, 1))
+        if name not in inits:
+            tensors[name] = Variable(name)
+
+    arg_params: Dict[str, Any] = {}
+    aux_names: set = set()
+    consumed: set = set()  # initializers folded into attrs (not params)
+
+    def sym_in(name) -> Symbol:
+        if name not in tensors:
+            if name in inits:
+                tensors[name] = Variable(name)
+                arg_params[name] = nd_array(inits[name])
+            else:
+                raise MXNetError("ONNX import: undefined tensor %r" % name)
+        return tensors[name]
+
+    for raw in P.every(graph, 1):  # nodes, topological per spec
+        nf = P.parse(raw)
+        op = P.as_str(P.first(nf, 4))
+        name = P.as_str(P.first(nf, 3)) or op.lower()
+        ins = [P.as_str(v) for _, v in nf.get(1, [])]
+        outs = [P.as_str(v) for _, v in nf.get(2, [])]
+        a = _parse_attrs(nf)
+
+        if op == "Conv":
+            k = a.get("kernel_shape", ())
+            n = len(k)
+            w = inits.get(ins[1])
+            res = invoke_symbol("Convolution",
+                               [sym_in(x) for x in ins],
+                               {"kernel": tuple(k),
+                                "stride": _pairs(a.get("strides"), n),
+                                "dilate": _pairs(a.get("dilations"), n),
+                                "pad": _pairs(a.get("pads"), n, 0),
+                                "num_filter": int(w.shape[0]) if w is not None
+                                else 0,
+                                "num_group": int(a.get("group", 1)),
+                                "no_bias": len(ins) == 2}, name=name)
+        elif op == "Gemm":
+            if a.get("transB", 0) != 1 or a.get("transA", 0) != 0 \
+                    or a.get("alpha", 1.0) != 1.0 \
+                    or a.get("beta", 1.0) != 1.0:
+                raise MXNetError(
+                    "ONNX import: Gemm supports transB=1, transA=0, "
+                    "alpha=beta=1 only (got %r)" % (a,))
+            w = inits.get(ins[1])
+            res = invoke_symbol("FullyConnected",
+                               [sym_in(x) for x in ins],
+                               {"num_hidden": int(w.shape[0]),
+                                "no_bias": len(ins) == 2,
+                                "flatten": False}, name=name)
+        elif op == "BatchNormalization":
+            syms = [sym_in(x) for x in ins]
+            # running mean/var are AUX states
+            for nm in ins[3:5]:
+                aux_names.add(nm)
+                tensors[nm]._outputs[0][0].is_aux = True
+            res = invoke_symbol("BatchNorm", syms,
+                               {"eps": float(a.get("epsilon", 1e-5)),
+                                "momentum": float(a.get("momentum", 0.9)),
+                                "fix_gamma": False,
+                                "use_global_stats": True}, name=name)
+        elif op in _ACT:
+            res = invoke_symbol("Activation", [sym_in(ins[0])],
+                               {"act_type": _ACT[op]}, name=name)
+        elif op in ("MaxPool", "AveragePool"):
+            k = a.get("kernel_shape", ())
+            n = len(k)
+            attrs = {"kernel": tuple(k),
+                     "stride": _pairs(a.get("strides"), n),
+                     "pad": _pairs(a.get("pads"), n, 0),
+                     "pool_type": "max" if op == "MaxPool" else "avg"}
+            if a.get("ceil_mode"):
+                attrs["pooling_convention"] = "full"
+            if op == "AveragePool":
+                # ONNX default EXCLUDES padding from the average
+                attrs["count_include_pad"] = \
+                    bool(a.get("count_include_pad", 0))
+            res = invoke_symbol("Pooling", [sym_in(ins[0])], attrs,
+                                name=name)
+        elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+            res = invoke_symbol("Pooling", [sym_in(ins[0])],
+                               {"global_pool": True, "kernel": (1, 1),
+                                "pool_type": "max" if "Max" in op
+                                else "avg"}, name=name)
+        elif op == "Softmax":
+            res = invoke_symbol("softmax", [sym_in(ins[0])],
+                               {"axis": int(a.get("axis", -1))}, name=name)
+        elif op == "LogSoftmax":
+            res = invoke_symbol("log_softmax", [sym_in(ins[0])],
+                               {"axis": int(a.get("axis", -1))}, name=name)
+        elif op in _ELEMWISE:
+            # scalar initializers fold back into *_scalar ops
+            if ins[1] in inits and inits[ins[1]].ndim == 0:
+                mx_op = {"Add": "_plus_scalar", "Mul": "_mul_scalar",
+                         "Sub": "_minus_scalar", "Div": "_div_scalar"}[op]
+                consumed.add(ins[1])
+                res = invoke_symbol(mx_op, [sym_in(ins[0])],
+                                   {"scalar": float(inits[ins[1]])},
+                                   name=name)
+            else:
+                res = invoke_symbol(_ELEMWISE[op],
+                                    [sym_in(x) for x in ins], {}, name=name)
+        elif op in _UNARY:
+            res = invoke_symbol(_UNARY[op], [sym_in(ins[0])], {}, name=name)
+        elif op == "Sum":
+            res = invoke_symbol("add_n", [sym_in(x) for x in ins], {},
+                                name=name)
+        elif op == "Concat":
+            res = invoke_symbol("Concat", [sym_in(x) for x in ins],
+                               {"dim": int(a.get("axis", 1))}, name=name)
+        elif op == "Flatten":
+            res = invoke_symbol("Flatten", [sym_in(ins[0])], {}, name=name)
+        elif op == "Reshape":
+            shape = tuple(int(x) for x in inits[ins[1]])
+            consumed.add(ins[1])
+            res = invoke_symbol("Reshape", [sym_in(ins[0])],
+                               {"shape": shape}, name=name)
+        elif op == "Transpose":
+            res = invoke_symbol("transpose", [sym_in(ins[0])],
+                               {"axes": a.get("perm")}, name=name)
+        elif op == "Dropout":
+            # opset 12: ratio is an optional INPUT; older files use attr
+            ratio = 0.5
+            if len(ins) > 1 and ins[1] in inits:
+                ratio = float(np.ravel(inits[ins[1]])[0])
+                consumed.add(ins[1])
+            elif "ratio" in a:
+                ratio = float(a["ratio"])
+            res = invoke_symbol("Dropout", [sym_in(ins[0])],
+                               {"p": ratio}, name=name)
+        elif op == "LeakyRelu":
+            res = invoke_symbol("LeakyReLU", [sym_in(ins[0])],
+                               {"act_type": "leaky",
+                                "slope": float(a.get("alpha", 0.01))},
+                               name=name)
+        elif op == "Elu":
+            res = invoke_symbol("LeakyReLU", [sym_in(ins[0])],
+                               {"act_type": "elu",
+                                "slope": float(a.get("alpha", 1.0))},
+                               name=name)
+        elif op == "Clip":
+            # opset 11+: min/max are INPUTS; opset<11 used attributes;
+            # spec defaults are +-inf (no clipping on that side)
+            lo, hi = -3.4e38, 3.4e38
+            if len(ins) > 1 and ins[1] and ins[1] in inits:
+                lo = float(np.ravel(inits[ins[1]])[0])
+                consumed.add(ins[1])
+            elif "min" in a:
+                lo = float(a["min"])
+            if len(ins) > 2 and ins[2] and ins[2] in inits:
+                hi = float(np.ravel(inits[ins[2]])[0])
+                consumed.add(ins[2])
+            elif "max" in a:
+                hi = float(a["max"])
+            res = invoke_symbol("clip", [sym_in(ins[0])],
+                               {"a_min": lo, "a_max": hi}, name=name)
+        else:
+            raise MXNetError(
+                "ONNX import: no converter for op %r — extend "
+                "mxtpu/contrib/onnx/import_onnx.py" % op)
+        for i, out in enumerate(outs):
+            tensors[out] = res[i] if len(res) > 1 else res
+
+    out_syms = []
+    for raw in P.every(graph, 12):
+        fo = P.parse(raw)
+        out_syms.append(tensors[P.as_str(P.first(fo, 1))])
+    from ...symbol.symbol import Group
+
+    sym = out_syms[0] if len(out_syms) == 1 else Group(out_syms)
+
+    arg_names = set(sym.list_arguments())
+    aux_params: Dict[str, Any] = {}
+    for name, arr in inits.items():
+        if name in consumed:
+            continue
+        if name in aux_names:
+            aux_params[name] = nd_array(arr)
+        elif name in arg_names or name in tensors:
+            arg_params[name] = nd_array(arr)
+    for nm in aux_names:
+        arg_params.pop(nm, None)
+    return sym, arg_params, aux_params

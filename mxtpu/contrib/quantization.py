@@ -1,0 +1,387 @@
+"""INT8 post-training quantization: calibration + graph rewrite.
+
+TPU-native counterpart of the reference quantization workflow
+(`python/mxnet/contrib/quantization.py:423` quantize_model;
+`src/operator/quantization/quantize_graph_pass.cc`).  The reference
+rewrites the NNVM graph in C++; here the rewrite is a pure-Python pass
+over the Symbol IR that
+
+  1. runs CALIBRATION batches through the fp32 graph and records each
+     quantized op input's dynamic range — `naive` (global min/max) or
+     `entropy` (KL-optimal threshold over a histogram, reference
+     `_get_optimal_threshold`);
+  2. rebuilds the graph with `_contrib_quantize_v2` →
+     `_contrib_quantized_{conv,fully_connected}` → `_contrib_dequantize`
+     islands around every supported op (per-op dequant keeps the pass
+     simple and numerically transparent; XLA fuses the casts);
+  3. quantizes the touched parameters OFFLINE to int8 NDArrays with
+     their own recorded ranges (weights symmetric over max-abs).
+
+The int8 compute ops accumulate in int32 on the MXU
+(`mxtpu/ops/quantization.py`), so the quantized graph still rides the
+systolic array.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array as nd_array
+from ..symbol.register import invoke_symbol
+from ..symbol.symbol import Symbol, Variable
+
+__all__ = ["quantize_model", "quantize_symbol", "quantize_params",
+           "calibrate_ranges"]
+
+# ops with an int8 kernel (reference quantize_graph_pass.cc
+# quantized-op registry); value = quantized op name
+_QUANTIZABLE = {
+    "Convolution": "_contrib_quantized_conv",
+    "FullyConnected": "_contrib_quantized_fully_connected",
+}
+
+
+def _max_abs(arr: np.ndarray) -> float:
+    """Symmetric range of a tensor; never 0 (an all-zero param — e.g. a
+    freshly-initialized bias — must quantize to zeros, not NaN)."""
+    t = float(np.max(np.abs(arr))) if arr.size else 1.0
+    return t if t > 0 else 1.0
+
+
+# ---------------------------------------------------------------------------
+# Calibration
+# ---------------------------------------------------------------------------
+
+def _optimal_threshold(hist: np.ndarray, edges: np.ndarray,
+                       num_quantized_bins: int = 255,
+                       max_clip_frac: float = 0.01) -> float:
+    """KL-divergence-optimal |threshold| over a symmetric histogram
+    (reference `quantization.py _get_optimal_threshold` / TensorRT's
+    entropy calibration).  Scans candidate clip points and keeps the one
+    whose clipped+quantized distribution diverges least from the
+    original.
+
+    `max_clip_frac` bounds the calibration mass a candidate may clip:
+    the raw KL objective hides clipped mass in the edge bins, so on
+    concentrated distributions (ReLU stacks, untrained nets) it would
+    happily clip half the data — the bound keeps the search inside the
+    99th-percentile window, which is also where TensorRT-style
+    calibration lands on well-behaved data."""
+    n_bins = len(hist)
+    assert n_bins % 2 == 1  # symmetric around zero
+    zero = n_bins // 2
+    best_kl, best_t = np.inf, float(edges[-1])
+    total = hist.sum()
+    if total == 0:
+        return best_t
+    p_full = hist.astype(np.float64)
+    for width in range(num_quantized_bins // 2, zero + 1):
+        lo, hi = zero - width, zero + width + 1
+        clipped = p_full[:lo].sum() + p_full[hi:].sum()
+        if clipped > max_clip_frac * total:
+            continue
+        p = p_full[lo:hi].copy()
+        # outliers collapse into the edge bins (clipping)
+        p[0] += p_full[:lo].sum()
+        p[-1] += p_full[hi:].sum()
+        nonzero = p > 0
+        if nonzero.sum() < 2:
+            continue
+        # quantize p into num_quantized_bins, then expand back
+        # (vectorized: per-bin sums/counts via add.reduceat)
+        factor = len(p) / num_quantized_bins
+        starts = np.floor(np.arange(num_quantized_bins) * factor) \
+            .astype(np.int64)
+        bin_of = np.minimum((np.arange(len(p)) / factor).astype(np.int64),
+                            num_quantized_bins - 1)
+        sums = np.add.reduceat(p, starts)
+        counts = np.add.reduceat(nonzero.astype(np.float64), starts)
+        avg = np.where(counts > 0, sums / np.maximum(counts, 1), 0.0)
+        q = np.where(nonzero, avg[bin_of], 0.0)
+        p_n = p / p.sum()
+        q_n = q / q.sum() if q.sum() > 0 else q
+        mask = (p_n > 0) & (q_n > 0)
+        if not mask.any():
+            continue
+        kl = float(np.sum(p_n[mask] * np.log(p_n[mask] / q_n[mask])))
+        if kl < best_kl:
+            best_kl = kl
+            best_t = float(max(abs(edges[lo]), abs(edges[hi])))
+    return best_t
+
+
+class _RangeCollector(object):
+    """Accumulates per-tensor ranges over calibration batches."""
+
+    def __init__(self, mode: str, num_bins: int = 8001):
+        self.mode = mode
+        self.num_bins = num_bins
+        self.minmax: Dict[str, Tuple[float, float]] = {}
+        self.hists: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+
+    def update(self, name: str, arr: np.ndarray):
+        lo, hi = float(arr.min()), float(arr.max())
+        if name in self.minmax:
+            plo, phi = self.minmax[name]
+            self.minmax[name] = (min(lo, plo), max(hi, phi))
+        else:
+            self.minmax[name] = (lo, hi)
+        if self.mode == "entropy":
+            t = max(abs(lo), abs(hi), 1e-8)
+            if name in self.hists:
+                hist, edges = self.hists[name]
+                if t > edges[-1]:  # re-bin into the wider range
+                    new_edges = np.linspace(-t, t, self.num_bins + 1)
+                    centers = (edges[:-1] + edges[1:]) / 2
+                    new_hist, _ = np.histogram(centers, bins=new_edges,
+                                               weights=hist)
+                    hist, edges = new_hist, new_edges
+                add, _ = np.histogram(arr, bins=edges)
+                self.hists[name] = (hist + add, edges)
+            else:
+                edges = np.linspace(-t, t, self.num_bins + 1)
+                hist, _ = np.histogram(arr, bins=edges)
+                self.hists[name] = (hist, edges)
+
+    def ranges(self) -> Dict[str, Tuple[float, float]]:
+        if self.mode != "entropy":
+            return dict(self.minmax)
+        out = {}
+        for name, (hist, edges) in self.hists.items():
+            t = _optimal_threshold(hist, edges)
+            out[name] = (-t, t)
+        return out
+
+
+def calibrate_ranges(sym: Symbol, arg_params, aux_params, calib_data,
+                     data_names=("data",), label_names=("softmax_label",),
+                     num_calib_examples: Optional[int] = None,
+                     calib_mode: str = "naive",
+                     excluded_sym_names=()) -> Dict[str, Tuple[float, float]]:
+    """Run calibration batches through the fp32 graph and return
+    {internal-output-name: (min, max)} for every tensor feeding a
+    quantized op (reference `_collect_layer_statistics`)."""
+    need: List[str] = []
+    for node in sym._topo():
+        if node.is_variable or node.name in excluded_sym_names:
+            continue
+        if node.op.name in _QUANTIZABLE:
+            src, idx = node.inputs[0]
+            if not src.is_variable:
+                nm = src.name + "_output" \
+                    if src.num_outputs() == 1 \
+                    else "%s_output%d" % (src.name, idx)
+            else:
+                nm = src.name
+            need.append(nm)
+    internals = sym.get_internals()
+    outs = [internals[nm] for nm in dict.fromkeys(need)
+            if nm not in sym.list_arguments()]
+    collector = _RangeCollector(calib_mode)
+
+    if outs:
+        from ..symbol.symbol import Group
+
+        group = Group(outs)
+        from ..context import cpu
+
+        shapes = {}
+        batch0 = None
+        calib_data.reset()
+        for batch in calib_data:
+            batch0 = batch
+            break
+        if batch0 is None:
+            raise MXNetError("empty calibration data")
+        for n, d in zip(data_names, batch0.data):
+            shapes[n] = d.shape
+        exe = group.simple_bind(ctx=cpu(), grad_req="null", **shapes)
+        for k, v in (arg_params or {}).items():
+            if k in exe.arg_dict:
+                v.copyto(exe.arg_dict[k])
+        for k, v in (aux_params or {}).items():
+            if k in exe.aux_dict:
+                v.copyto(exe.aux_dict[k])
+
+        seen = 0
+        calib_data.reset()
+        names = group.list_outputs()
+        for batch in calib_data:
+            feed = {n: d for n, d in zip(data_names, batch.data)}
+            outs_nd = exe.forward(is_train=False, **feed)
+            for nm, o in zip(names, outs_nd):
+                collector.update(nm, o.asnumpy())
+            # the graph INPUT also needs a range
+            for n, d in zip(data_names, batch.data):
+                collector.update(n, d.asnumpy())
+            seen += batch.data[0].shape[0]
+            if num_calib_examples is not None and seen >= num_calib_examples:
+                break
+    else:
+        calib_data.reset()
+        seen = 0
+        for batch in calib_data:
+            for n, d in zip(data_names, batch.data):
+                collector.update(n, d.asnumpy())
+            seen += batch.data[0].shape[0]
+            if num_calib_examples is not None and seen >= num_calib_examples:
+                break
+    logging.getLogger(__name__).info(
+        "calibrated %d tensors over %d examples (%s mode)",
+        len(collector.minmax), seen, calib_mode)
+    return collector.ranges()
+
+
+# ---------------------------------------------------------------------------
+# Graph rewrite
+# ---------------------------------------------------------------------------
+
+def quantize_symbol(sym: Symbol,
+                    ranges: Optional[Dict[str, Tuple[float, float]]],
+                    excluded_sym_names=(),
+                    quantized_dtype: str = "int8") -> Tuple[Symbol, List[str]]:
+    """Rebuild `sym` with int8 islands around every quantizable op whose
+    input range was calibrated; ``ranges=None`` quantizes EVERY
+    supported op with runtime (dynamic) min/max — the calib_mode='none'
+    workflow.  Returns (qsym, names of params that `quantize_params`
+    must convert offline)."""
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 is supported (got %r)" % quantized_dtype)
+    from ..ops.registry import get_op
+    from ..symbol.symbol import SymbolNode
+
+    memo: Dict[int, Any] = {}   # id(old node) -> new SymbolNode
+    offline: List[str] = []
+
+    def var(name):
+        return SymbolNode(None, name, {}, [])
+
+    def out_name(src, idx):
+        if src.is_variable:
+            return src.name
+        if src.num_outputs() == 1:
+            return src.name + "_output"
+        return "%s_output%d" % (src.name, idx)
+
+    def map_node(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.is_variable:
+            new = SymbolNode(None, node.name, {}, [], is_aux=node.is_aux)
+            new.ext_attrs = dict(node.ext_attrs)
+            memo[id(node)] = new
+            return new
+        new_inputs = [(map_node(src), idx) for src, idx in node.inputs]
+        qop = _QUANTIZABLE.get(node.op.name)
+        in_name = out_name(*node.inputs[0])
+        dynamic = ranges is None
+        if qop is not None and node.name not in excluded_sym_names \
+                and (dynamic or in_name in ranges) \
+                and len(node.inputs) >= 2 \
+                and node.inputs[1][0].is_variable:
+            qattrs = {}
+            if not dynamic:
+                lo, hi = ranges[in_name]
+                qattrs = {"min_calib_range": float(lo),
+                          "max_calib_range": float(hi)}
+            qnode = SymbolNode(
+                get_op("_contrib_quantize_v2"), node.name + "_quantize",
+                qattrs, [new_inputs[0]])
+            wname = node.inputs[1][0].name
+            offline.append(wname)
+            qw = var(wname + "_quantize")
+            wmin, wmax = var(wname + "_min"), var(wname + "_max")
+            no_bias = node.attrs.get("no_bias", False)
+            if not no_bias and len(node.inputs) >= 3 \
+                    and node.inputs[2][0].is_variable:
+                bname = node.inputs[2][0].name
+                offline.append(bname)
+                qb, bmin, bmax = (var(bname + "_quantize"),
+                                  var(bname + "_min"), var(bname + "_max"))
+            else:
+                qb = var(node.name + "_no_bias")  # zero int8 stand-in
+                bmin, bmax = wmin, wmax  # same NODES, no duplicate vars
+            core = SymbolNode(
+                get_op(qop), node.name + "_quantized", dict(node.attrs),
+                [(qnode, 0), (qw, 0), (qb, 0),
+                 (qnode, 1), (qnode, 2), (wmin, 0), (wmax, 0),
+                 (bmin, 0), (bmax, 0)])
+            deq = SymbolNode(get_op("_contrib_dequantize"),
+                             node.name + "_dequantize", {}, [(core, 0),
+                                                             (core, 1),
+                                                             (core, 2)])
+            memo[id(node)] = deq
+            return deq
+        new = SymbolNode(node.op, node.name, dict(node.attrs), new_inputs)
+        new.ext_attrs = dict(node.ext_attrs)
+        memo[id(node)] = new
+        return new
+
+    new_entries = []
+    for n, i in sym._outputs:
+        mapped = map_node(n)
+        # a quantized op's replacement (dequantize) has ONE output
+        if mapped.op is not None and \
+                mapped.op.name == "_contrib_dequantize":
+            i = 0
+        new_entries.append((mapped, i))
+    return Symbol(new_entries), offline
+
+
+def quantize_params(qsym: Symbol, arg_params: Dict[str, NDArray],
+                    offline: List[str]) -> Dict[str, NDArray]:
+    """Offline-quantize `offline` params to int8 with symmetric max-abs
+    ranges; other params pass through (reference quantize_params)."""
+    out: Dict[str, NDArray] = {}
+    qargs = set(qsym.list_arguments())
+    for name, arr in arg_params.items():
+        if name in offline:
+            host = arr.asnumpy()
+            t = _max_abs(host)
+            qv = np.clip(np.round(host / t * 127.0), -127, 127) \
+                .astype(np.int8)
+            if name + "_quantize" in qargs:
+                out[name + "_quantize"] = nd_array(qv)
+                out[name + "_min"] = nd_array(
+                    np.asarray([-t], np.float32))
+                out[name + "_max"] = nd_array(
+                    np.asarray([t], np.float32))
+        if name in qargs:
+            out[name] = arr
+    # zero int8 stand-ins for no-bias slots
+    for name in qargs:
+        if name.endswith("_no_bias") and name not in out:
+            out[name] = nd_array(np.zeros((1,), np.int8))
+    return out
+
+
+def quantize_model(sym: Symbol, arg_params, aux_params,
+                   data_names=("data",), label_names=("softmax_label",),
+                   ctx=None, excluded_sym_names=(),
+                   calib_mode: str = "naive", calib_data=None,
+                   num_calib_examples: Optional[int] = None,
+                   quantized_dtype: str = "int8", logger=None):
+    """The reference's one-call workflow
+    (`python/mxnet/contrib/quantization.py:423`): calibrate → rewrite →
+    quantize params.  Returns (qsym, qarg_params, aux_params)."""
+    if calib_mode not in ("none", "naive", "entropy"):
+        raise MXNetError("calib_mode must be none/naive/entropy")
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError("calib_data required for calib_mode=%r"
+                             % calib_mode)
+        ranges = calibrate_ranges(
+            sym, arg_params, aux_params, calib_data,
+            data_names=data_names, label_names=label_names,
+            num_calib_examples=num_calib_examples, calib_mode=calib_mode,
+            excluded_sym_names=excluded_sym_names)
+    else:
+        ranges = None  # dynamic: runtime min/max in _contrib_quantize_v2
+    qsym, offline = quantize_symbol(
+        sym, ranges, excluded_sym_names=excluded_sym_names,
+        quantized_dtype=quantized_dtype)
+    qargs = quantize_params(qsym, arg_params or {}, offline)
+    return qsym, qargs, dict(aux_params or {})

@@ -24,31 +24,37 @@ def _quant_range(out_type="int8"):
     return -127.0, 127.0
 
 
+def _quantize_core(jnp, data, lo, hi, out_type):
+    """int8 is SYMMETRIC (reference `quantize-inl.h` int8 path: scale =
+    127/MaxAbs(min,max), zero point 0 — the int8*int8 MXU kernels and
+    `_int32_out_range` assume it); uint8 stays affine."""
+    if out_type == "uint8":
+        qmin, qmax = 0.0, 255.0
+        scale = (qmax - qmin) / jnp.maximum(hi - lo, 1e-12)
+        q = jnp.clip(jnp.round((data - lo) * scale + qmin), qmin, qmax)
+        return q.astype(np.uint8), lo, hi
+    t = jnp.maximum(jnp.maximum(jnp.abs(lo), jnp.abs(hi)), 1e-12)
+    q = jnp.clip(jnp.round(data / t * 127.0), -127, 127)
+    t32 = jnp.asarray(t, np.float32)
+    return q.astype(np.int8), -t32, t32
+
+
 @register("_contrib_quantize", num_outputs=3, differentiable=False)
 def _quantize(data, min_range, max_range, out_type="int8"):
     jnp = _jnp()
-    qmin, qmax = _quant_range(out_type)
-    scale = (qmax - qmin) / jnp.maximum(max_range - min_range, 1e-12)
-    q = jnp.clip(jnp.round((data - min_range) * scale + qmin), qmin, qmax)
-    return q.astype(np.int8 if out_type == "int8" else np.uint8), \
-        min_range, max_range
+    return _quantize_core(jnp, data, min_range, max_range, out_type)
 
 
 @register("_contrib_quantize_v2", num_outputs=3, differentiable=False)
 def _quantize_v2(data, out_type="int8", min_calib_range=None,
                  max_calib_range=None):
     jnp = _jnp()
-    lo = min_calib_range if min_calib_range is not None else float(0.0)
     if min_calib_range is None:
-        lo = data.min()
-        hi = data.max()
+        lo, hi = data.min(), data.max()
     else:
         lo = jnp.asarray(min_calib_range, data.dtype)
         hi = jnp.asarray(max_calib_range, data.dtype)
-    qmin, qmax = _quant_range(out_type)
-    scale = (qmax - qmin) / jnp.maximum(hi - lo, 1e-12)
-    q = jnp.clip(jnp.round((data - lo) * scale + qmin), qmin, qmax)
-    return q.astype(np.int8 if out_type == "int8" else np.uint8), lo, hi
+    return _quantize_core(jnp, data, lo, hi, out_type)
 
 
 @register("_contrib_dequantize", differentiable=False)
@@ -56,6 +62,10 @@ def _dequantize(data, min_range, max_range, out_type="float32"):
     jnp = _jnp()
     if data.dtype == np.uint8:
         qmin, qmax = 0.0, 255.0
+    elif data.dtype == np.int32:
+        # int8*int8 accumulators carry the +-(2^31-1)-scaled range
+        # (`_int32_out_range`); dequantize must use the SAME span
+        qmin, qmax = -(2.0 ** 31 - 1), 2.0 ** 31 - 1
     else:
         qmin, qmax = -127.0, 127.0
     scale = (max_range - min_range) / (qmax - qmin)
