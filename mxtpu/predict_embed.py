@@ -1,0 +1,96 @@
+"""Python engine behind the C predict ABI (`src/predict.cc`).
+
+The reference's predict API (`include/mxnet/c_predict_api.h:55-120`)
+lets C/C++ applications embed inference: create a predictor from
+symbol-json + a params blob, set inputs, forward, read outputs.  Here
+the C shared library embeds CPython and drives THIS module; the compute
+still runs through the same whole-graph XLA executor every Python user
+gets.  Keep this module import-light: the embedded interpreter calls
+`create` once per predictor.
+"""
+from __future__ import annotations
+
+import io
+import json
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["Predictor", "create"]
+
+
+class Predictor(object):
+    def __init__(self, symbol_json: str, param_bytes: bytes,
+                 dev_type: int, dev_id: int,
+                 input_shapes: Dict[str, tuple]):
+        import jax
+
+        if dev_type == 1:  # cpu requested: force before first device use
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+        import mxtpu as mx
+        from mxtpu.symbol.symbol import load_json
+
+        self._mx = mx
+        sym = load_json(symbol_json)
+        arg_params: Dict[str, np.ndarray] = {}
+        aux_params: Dict[str, np.ndarray] = {}
+        if param_bytes:
+            with np.load(io.BytesIO(param_bytes), allow_pickle=True) as zf:
+                keys = [str(k) for k in zf["__keys__"]] \
+                    if "__keys__" in zf.files else \
+                    [k for k in zf.files if k != "__keys__"]
+                for k in keys:
+                    if k.startswith("arg:"):
+                        arg_params[k[4:]] = zf[k]
+                    elif k.startswith("aux:"):
+                        aux_params[k[4:]] = zf[k]
+                    else:
+                        arg_params[k] = zf[k]
+
+        ctx = mx.cpu(dev_id) if dev_type == 1 else mx.tpu(dev_id)
+        shapes = dict(input_shapes)
+        shapes.update({k: tuple(v.shape) for k, v in arg_params.items()})
+        tdict = {k: v.dtype for k, v in arg_params.items()}
+        # drop label-style inputs that aren't fed (grad_req null anyway)
+        self._exec = sym.simple_bind(ctx=ctx, grad_req="null",
+                                     type_dict=tdict, **shapes)
+        for k, v in arg_params.items():
+            if k in self._exec.arg_dict:
+                self._exec.arg_dict[k][:] = v
+        for k, v in aux_params.items():
+            if k in self._exec.aux_dict:
+                self._exec.aux_dict[k][:] = v
+        self._input_names = list(input_shapes)
+        self._outputs: List[np.ndarray] = []
+
+    def set_input(self, key: str, flat: np.ndarray):
+        dst = self._exec.arg_dict[key]
+        dst[:] = np.asarray(flat, np.float32).reshape(dst.shape)
+
+    def forward(self):
+        outs = self._exec.forward(is_train=False)
+        self._outputs = [np.ascontiguousarray(o.asnumpy(), np.float32)
+                         for o in outs]
+
+    def num_outputs(self) -> int:
+        return len(self._exec.outputs or self._outputs)
+
+    def output_shape(self, index: int):
+        return list(self._outputs[index].shape)
+
+    def output_data(self, index: int) -> np.ndarray:
+        return self._outputs[index].reshape(-1)
+
+
+def create(symbol_json: str, param_bytes: bytes, dev_type: int,
+           dev_id: int, keys, indptr, shape_data) -> Predictor:
+    """Entry point matching MXTPUPredCreate's flattened-shape wire
+    format (reference MXPredCreate input_shape_indptr/data)."""
+    shapes = {}
+    for i, key in enumerate(keys):
+        shapes[key] = tuple(int(s)
+                            for s in shape_data[indptr[i]:indptr[i + 1]])
+    return Predictor(symbol_json, param_bytes, dev_type, dev_id, shapes)

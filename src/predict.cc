@@ -1,0 +1,224 @@
+/*
+ * C predict ABI — the embedding surface of the framework.
+ *
+ * Counterpart of the reference's `include/mxnet/c_predict_api.h:55-120`
+ * (MXPredCreate / SetInput / Forward / GetOutputShape / GetOutput /
+ * Free): a C shared library applications link against to run inference
+ * without writing a line of Python.  The reference backs the ABI with
+ * its C++ executor; here the library embeds CPython and drives
+ * `mxtpu.predict_embed`, so the compute path is the SAME whole-graph
+ * XLA executor — one ABI, one engine.
+ *
+ * Thread model: one global interpreter; every call takes the GIL.
+ */
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_last_error;
+std::mutex g_err_mu;
+
+void set_error(const std::string& msg) {
+  std::lock_guard<std::mutex> lk(g_err_mu);
+  g_last_error = msg;
+}
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+struct Predictor {
+  PyObject* obj;                       // mxtpu.predict_embed.Predictor
+  std::vector<uint32_t> shape_buf;     // backing store for GetOutputShape
+};
+
+bool ensure_python() {
+  if (Py_IsInitialized()) return true;
+  Py_InitializeEx(0);
+  return Py_IsInitialized();
+}
+
+/* call obj.method(args) -> new ref or nullptr (error recorded) */
+PyObject* call_method(PyObject* obj, const char* name, PyObject* args) {
+  PyObject* fn = PyObject_GetAttrString(obj, name);
+  if (!fn) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* res = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  if (!res) set_error_from_python();
+  return res;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTPUPredGetLastError() { return g_last_error.c_str(); }
+
+/* reference MXPredCreate (c_predict_api.h:78): dev_type 1=cpu 2=tpu */
+int MXTPUPredCreate(const char* symbol_json_str, const void* param_bytes,
+                    int param_size, int dev_type, int dev_id,
+                    uint32_t num_input_nodes, const char** input_keys,
+                    const uint32_t* input_shape_indptr,
+                    const uint32_t* input_shape_data, void** out) {
+  if (!ensure_python()) {
+    set_error("cannot initialize embedded python");
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = nullptr;
+  PyObject* res = nullptr;
+  do {
+    mod = PyImport_ImportModule("mxtpu.predict_embed");
+    if (!mod) {
+      set_error_from_python();
+      break;
+    }
+    PyObject* keys = PyList_New(num_input_nodes);
+    PyObject* indptr = PyList_New(num_input_nodes + 1);
+    uint32_t n_shape = input_shape_indptr[num_input_nodes];
+    PyObject* shapes = PyList_New(n_shape);
+    for (uint32_t i = 0; i < num_input_nodes; ++i)
+      PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
+    for (uint32_t i = 0; i <= num_input_nodes; ++i)
+      PyList_SetItem(indptr, i,
+                     PyLong_FromUnsignedLong(input_shape_indptr[i]));
+    for (uint32_t i = 0; i < n_shape; ++i)
+      PyList_SetItem(shapes, i,
+                     PyLong_FromUnsignedLong(input_shape_data[i]));
+    PyObject* blob = PyBytes_FromStringAndSize(
+        static_cast<const char*>(param_bytes), param_size);
+    PyObject* args = Py_BuildValue("(sOiiOOO)", symbol_json_str, blob,
+                                   dev_type, dev_id, keys, indptr, shapes);
+    Py_DECREF(blob);
+    Py_DECREF(keys);
+    Py_DECREF(indptr);
+    Py_DECREF(shapes);
+    res = call_method(mod, "create", args);
+    Py_DECREF(args);
+    if (!res) break;
+    Predictor* p = new Predictor();
+    p->obj = res;
+    res = nullptr;
+    *out = p;
+    rc = 0;
+  } while (false);
+  Py_XDECREF(mod);
+  Py_XDECREF(res);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXTPUPredSetInput(void* handle, const char* key, const float* data,
+                      uint32_t size) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* lst = PyList_New(size);
+  for (uint32_t i = 0; i < size; ++i)
+    PyList_SetItem(lst, i, PyFloat_FromDouble(data[i]));
+  PyObject* args = Py_BuildValue("(sO)", key, lst);
+  Py_DECREF(lst);
+  PyObject* res = call_method(p->obj, "set_input", args);
+  Py_DECREF(args);
+  int rc = res ? 0 : -1;
+  Py_XDECREF(res);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXTPUPredForward(void* handle) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = call_method(p->obj, "forward", nullptr);
+  int rc = res ? 0 : -1;
+  Py_XDECREF(res);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXTPUPredGetOutputShape(void* handle, uint32_t index,
+                            uint32_t** shape_data, uint32_t* shape_ndim) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(I)", index);
+  PyObject* res = call_method(p->obj, "output_shape", args);
+  Py_DECREF(args);
+  if (!res) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(res);
+  p->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    p->shape_buf[i] =
+        static_cast<uint32_t>(PyLong_AsLong(PyList_GetItem(res, i)));
+  Py_DECREF(res);
+  *shape_data = p->shape_buf.data();
+  *shape_ndim = static_cast<uint32_t>(n);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXTPUPredGetOutput(void* handle, uint32_t index, float* data,
+                       uint32_t size) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(I)", index);
+  PyObject* res = call_method(p->obj, "output_data", args);
+  Py_DECREF(args);
+  if (!res) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  /* numpy array supports the buffer protocol -> zero-copy view */
+  Py_buffer view;
+  if (PyObject_GetBuffer(res, &view, PyBUF_CONTIG_RO) != 0) {
+    set_error_from_python();
+    Py_DECREF(res);
+    PyGILState_Release(gil);
+    return -1;
+  }
+  uint32_t n = static_cast<uint32_t>(view.len / sizeof(float));
+  std::memcpy(data, view.buf,
+              sizeof(float) * (n < size ? n : size));
+  PyBuffer_Release(&view);
+  Py_DECREF(res);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXTPUPredFree(void* handle) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  if (Py_IsInitialized()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_XDECREF(p->obj);
+    PyGILState_Release(gil);
+  }
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

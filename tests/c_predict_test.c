@@ -1,0 +1,100 @@
+/*
+ * End-to-end consumer of the C predict ABI (libmxtpu_predict.so):
+ * loads symbol-json + params, feeds an input, forwards, prints outputs.
+ * The pytest harness (tests/test_c_predict.py) compiles this with gcc,
+ * runs it against a model saved from Python, and compares the printed
+ * numbers with the Python executor's — the reference's
+ * image-classification/predict-cpp smoke, minus opencv.
+ *
+ * usage: c_predict_test <symbol.json> <file.params> <input.bin> <n>
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+extern const char* MXTPUPredGetLastError(void);
+extern int MXTPUPredCreate(const char*, const void*, int, int, int,
+                           uint32_t, const char**, const uint32_t*,
+                           const uint32_t*, void**);
+extern int MXTPUPredSetInput(void*, const char*, const float*, uint32_t);
+extern int MXTPUPredForward(void*);
+extern int MXTPUPredGetOutputShape(void*, uint32_t, uint32_t**, uint32_t*);
+extern int MXTPUPredGetOutput(void*, uint32_t, float*, uint32_t);
+extern int MXTPUPredFree(void*);
+
+static char* read_file(const char* path, long* size) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 5) {
+    fprintf(stderr, "usage: %s symbol.json file.params input.bin n\n",
+            argv[0]);
+    return 2;
+  }
+  long sym_size = 0, param_size = 0, in_size = 0;
+  char* sym_json = read_file(argv[1], &sym_size);
+  char* params = read_file(argv[2], &param_size);
+  char* input = read_file(argv[3], &in_size);
+  uint32_t n = (uint32_t)atoi(argv[4]);
+  if (!sym_json || !params || !input) {
+    fprintf(stderr, "cannot read inputs\n");
+    return 2;
+  }
+
+  const char* keys[] = {"data"};
+  uint32_t indptr[] = {0, 2};
+  uint32_t shape[] = {n, (uint32_t)(in_size / sizeof(float) / n)};
+  void* pred = NULL;
+  if (MXTPUPredCreate(sym_json, params, (int)param_size, /*cpu*/ 1, 0, 1,
+                      keys, indptr, shape, &pred) != 0) {
+    fprintf(stderr, "create failed: %s\n", MXTPUPredGetLastError());
+    return 1;
+  }
+  if (MXTPUPredSetInput(pred, "data", (const float*)input,
+                        (uint32_t)(in_size / sizeof(float))) != 0 ||
+      MXTPUPredForward(pred) != 0) {
+    fprintf(stderr, "forward failed: %s\n", MXTPUPredGetLastError());
+    return 1;
+  }
+  uint32_t* oshape = NULL;
+  uint32_t ondim = 0;
+  if (MXTPUPredGetOutputShape(pred, 0, &oshape, &ondim) != 0) {
+    fprintf(stderr, "shape failed: %s\n", MXTPUPredGetLastError());
+    return 1;
+  }
+  uint32_t osize = 1;
+  printf("shape:");
+  for (uint32_t i = 0; i < ondim; ++i) {
+    printf(" %u", oshape[i]);
+    osize *= oshape[i];
+  }
+  printf("\n");
+  float* out = (float*)malloc(sizeof(float) * osize);
+  if (MXTPUPredGetOutput(pred, 0, out, osize) != 0) {
+    fprintf(stderr, "output failed: %s\n", MXTPUPredGetLastError());
+    return 1;
+  }
+  printf("data:");
+  for (uint32_t i = 0; i < osize; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+  MXTPUPredFree(pred);
+  free(out);
+  free(input);
+  free(params);
+  free(sym_json);
+  return 0;
+}
