@@ -39,11 +39,19 @@ __all__ = ["set_compute_dtype", "get_compute_dtype", "scope",
 
 _state = threading.local()
 
-# The FLOPs carriers: run these in the low-precision compute dtype
-# (reference FP16_FUNCS list, `contrib/amp/lists/symbol.py`).
+# The FLOPs/bandwidth carriers: run these in the low-precision compute
+# dtype (reference FP16_FUNCS list, `contrib/amp/lists/symbol.py`).
+# Everything NOT in either list runs in whatever dtype arrives and
+# multi-input ops promote to the widest input via jnp's promotion —
+# the reference's FP16_FP32_FUNCS + WIDEST_TYPE_CASTS behavior for
+# free, so activations stay bf16 across elementwise/activation chains.
 LOWP_OPS = {
     "Convolution", "Deconvolution", "FullyConnected", "dot", "batch_dot",
-    "RNN", "Correlation",
+    "RNN", "Correlation", "_linalg_gemm", "_linalg_gemm2",
+    # bandwidth-bound stages: keeping them bf16 halves their HBM traffic
+    "Pooling", "Pooling_v1", "_contrib_AdaptiveAvgPooling2D",
+    "UpSampling", "_contrib_BilinearResize2D", "BilinearSampler",
+    "Embedding", "Concat", "add_n",
 }
 
 # Numerically sensitive: force float32 inputs (reference FP32_FUNCS).
@@ -78,6 +86,12 @@ def scope(dtype: Optional[str]):
         set_compute_dtype(prev)
 
 
+# inputs that must NEVER be narrowed even inside a LOWP op: bf16's
+# 8-bit mantissa rounds float-typed INDEX tensors (the MXNet convention
+# stores indices as float32) above 256 to the wrong integer
+_LOWP_SKIP_INPUTS = {"Embedding": {0}}
+
+
 def cast_op_inputs(op_name: str, invals, dtype):
     """Apply the policy to one node's inputs (float arrays only — int
     index/label-ish inputs pass through untouched)."""
@@ -86,9 +100,11 @@ def cast_op_inputs(op_name: str, invals, dtype):
     dt = jnp.dtype(dtype)
     f32 = jnp.float32
     if op_name in LOWP_OPS:
+        skip = _LOWP_SKIP_INPUTS.get(op_name, ())
         return [v.astype(dt)
-                if getattr(v, "dtype", None) == f32 else v
-                for v in invals]
+                if i not in skip and getattr(v, "dtype", None) == f32
+                else v
+                for i, v in enumerate(invals)]
     if op_name in FP32_OPS:
         return [v.astype(f32)
                 if getattr(v, "dtype", None) == dt else v

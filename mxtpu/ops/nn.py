@@ -67,6 +67,30 @@ def _conv_dnums(nspatial: int):
     return ("NC" + sp, "OI" + sp, "NC" + sp)
 
 
+def _channels_last() -> bool:
+    """MXTPU_CONV_LAYOUT=NHWC runs conv internals channels-last: the
+    TPU conv engine prefers NHWC (SURVEY perf notes; VERDICT r2 ask
+    #1a), and XLA cancels the inverse transposes between adjacent
+    channels-last ops.  API layout stays NCHW either way."""
+    import os
+
+    return os.environ.get("MXTPU_CONV_LAYOUT", "").upper() == "NHWC"
+
+
+def _conv_dnums_cl(nspatial: int):
+    sp = _SPATIAL[nspatial]
+    return ("N" + sp + "C", sp + "IO", "N" + sp + "C")
+
+
+def _to_cl(x, ns):
+    # NC<sp> -> N<sp>C
+    return x.transpose((0,) + tuple(range(2, 2 + ns)) + (1,))
+
+
+def _from_cl(x, ns):
+    return x.transpose((0, 1 + ns) + tuple(range(1, 1 + ns)))
+
+
 def _norm_tuple(v, n, default):
     if not v:
         return (default,) * n
@@ -84,9 +108,18 @@ def _convolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
     stride = _norm_tuple(stride, ns, 1)
     dilate = _norm_tuple(dilate, ns, 1)
     pad = _norm_tuple(pad, ns, 0)
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dnums(ns))
+    cl = _channels_last()
+    if cl:
+        lhs = _to_cl(data, ns)
+        rhs = weight.transpose(tuple(range(2, 2 + ns)) + (1, 0))  # spIO
+        dn = lax.conv_dimension_numbers(lhs.shape, rhs.shape,
+                                        _conv_dnums_cl(ns))
+    else:
+        lhs, rhs = data, weight
+        dn = lax.conv_dimension_numbers(lhs.shape, rhs.shape,
+                                        _conv_dnums(ns))
     out = lax.conv_general_dilated(
-        data, weight,
+        lhs, rhs,
         window_strides=stride,
         padding=[(p, p) for p in pad],
         lhs_dilation=(1,) * ns,
@@ -95,9 +128,9 @@ def _convolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
         feature_group_count=num_group,
     )
     if not no_bias and maybe_bias:
-        b = maybe_bias[0].reshape((1, -1) + (1,) * ns)
-        out = out + b
-    return out
+        out = out + (maybe_bias[0] if cl
+                     else maybe_bias[0].reshape((1, -1) + (1,) * ns))
+    return _from_cl(out, ns) if cl else out
 
 
 @register("Deconvolution")

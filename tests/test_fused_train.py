@@ -157,3 +157,58 @@ def test_fused_rejects_unsupported():
                         opt_params={"learning_rate": 0.01})
     with pytest.raises(mx.MXNetError):
         mx.FusedTrainLoop(mod2)
+
+
+def test_conv_layout_flag_equivalence(monkeypatch):
+    """MXTPU_CONV_LAYOUT=NHWC changes conv internals only — training a
+    small convnet must produce identical params either way."""
+    import os
+
+    import mxtpu as mx
+    from mxtpu import sym
+    from mxtpu.io.io import DataBatch
+
+    def build_and_train():
+        data = sym.Variable("data")
+        # exercise the risky layout parameters: grouped conv, stride,
+        # dilation, rectangular kernel, asymmetric-ish padding
+        x = sym.Convolution(data=data, kernel=(3, 3), num_filter=4,
+                            pad=(1, 1), num_group=2, name="c0")
+        x = sym.Convolution(data=x, kernel=(3, 2), num_filter=4,
+                            stride=(2, 1), dilate=(1, 2), pad=(1, 0),
+                            name="c1")
+        x = sym.Activation(data=x, act_type="relu")
+        x = sym.Pooling(data=x, kernel=(2, 2), stride=(2, 2),
+                        pool_type="max")
+        x = sym.Flatten(data=x)
+        x = sym.FullyConnected(data=x, num_hidden=3, name="f1")
+        out = sym.SoftmaxOutput(data=x, label=sym.Variable("softmax_label"),
+                                name="softmax")
+        mod = mx.mod.Module(out, data_names=("data",),
+                            label_names=("softmax_label",),
+                            context=mx.cpu())
+        mod.bind(data_shapes=[("data", (4, 2, 8, 8))],
+                 label_shapes=[("softmax_label", (4,))])
+        rng = np.random.RandomState(3)
+        mod.init_params(initializer=mx.initializer.Xavier())
+        args, auxs = mod.get_params()
+        mod.set_params({k: mx.nd.array(
+            rng.randn(*v.shape).astype(np.float32) * 0.1)
+            for k, v in sorted(args.items())}, auxs, force_init=True)
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        d = mx.nd.array(rng.randn(4, 2, 8, 8).astype(np.float32))
+        l = mx.nd.array(rng.randint(0, 3, (4,)).astype(np.float32))
+        for _ in range(3):
+            mod.forward(DataBatch(data=[d], label=[l]), is_train=True)
+            mod.backward()
+            mod.update()
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    monkeypatch.delenv("MXTPU_CONV_LAYOUT", raising=False)
+    nchw = build_and_train()
+    monkeypatch.setenv("MXTPU_CONV_LAYOUT", "NHWC")
+    nhwc = build_and_train()
+    for k in nchw:
+        np.testing.assert_allclose(nchw[k], nhwc[k], rtol=1e-4,
+                                   atol=1e-5, err_msg=k)
