@@ -168,14 +168,20 @@ def main():
             extra["bf16_bs%d_mfu" % batch] = _mfu(ips)
             extra["bf16_bs%d_windows" % batch] = [round(w, 1)
                                                   for w in wins]
-        # layout A/B: channels-last conv internals (VERDICT r2 ask #1a)
+        # layout A/B: channels-last conv internals (VERDICT r2 ask #1a).
+        # Save/restore any user-set layout so (a) the baseline runs above
+        # really were that layout, (b) later measurements see it again.
+        prior_layout = os.environ.get("MXTPU_CONV_LAYOUT")
         os.environ["MXTPU_CONV_LAYOUT"] = "NHWC"
         try:
             ips_cl, _ = run_config(128, "bfloat16")
             extra["bf16_bs128_nhwc_imgs_per_sec"] = round(ips_cl, 2)
             extra["bf16_bs128_nhwc_mfu"] = _mfu(ips_cl)
         finally:
-            os.environ.pop("MXTPU_CONV_LAYOUT", None)
+            if prior_layout is None:
+                os.environ.pop("MXTPU_CONV_LAYOUT", None)
+            else:
+                os.environ["MXTPU_CONV_LAYOUT"] = prior_layout
         extra["fp32_bs%d_per_step_dispatch" % BATCH] = round(
             run_per_step_fp32(BATCH), 2)
         result["extra"] = extra

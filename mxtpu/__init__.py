@@ -40,6 +40,7 @@ from . import symbol as sym
 from .symbol import Symbol
 from .executor import Executor
 from .cached_op import CachedOp
+from . import subgraph
 from . import amp
 from . import control_flow
 # reference API surface: mx.nd.contrib.foreach / mx.sym.contrib.foreach

@@ -30,6 +30,7 @@ import numpy as np
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, array as nd_array
+from ..subgraph import SubgraphProperty as _SubgraphProperty
 from ..symbol.register import invoke_symbol
 from ..symbol.symbol import Symbol, Variable
 
@@ -239,6 +240,103 @@ def calibrate_ranges(sym: Symbol, arg_params, aux_params, calib_data,
 # Graph rewrite
 # ---------------------------------------------------------------------------
 
+class _QuantizeSelector:
+    """Single-node regions over quantizable ops (the INT8 rewrite is a
+    per-op island; no growth)."""
+
+    def __init__(self, prop):
+        self._prop = prop
+
+    def select(self, node):
+        return self._prop._quantizable(node)
+
+    def select_input(self, node, input_node):
+        return False
+
+    def select_output(self, node, output_node):
+        return False
+
+    def filter(self, candidates):
+        return candidates
+
+
+class QuantizeProperty(_SubgraphProperty):
+    """INT8 rewrite as a subgraph backend (`mxtpu.subgraph`): each
+    quantizable node is a one-node region replaced by a
+    quantize → int8-op → dequantize island.  The reference implements
+    the same rewrite as the MKLDNN_QUANTIZE subgraph property
+    (`src/operator/subgraph/mkldnn/mkldnn_subgraph_property.cc`) over
+    `quantize_graph_pass.cc`."""
+
+    needs_params = False  # params are quantized separately (offline)
+
+    def __init__(self, ranges, excluded_sym_names=()):
+        self.ranges = ranges
+        self.excluded = set(excluded_sym_names)
+        self.offline: List[str] = []
+
+    def _in_name(self, node):
+        src, idx = node.inputs[0]
+        if src.is_variable:
+            return src.name
+        if src.num_outputs() == 1:
+            return src.name + "_output"
+        return "%s_output%d" % (src.name, idx)
+
+    def _quantizable(self, node):
+        if node.is_variable or node.op.name not in _QUANTIZABLE:
+            return False
+        if node.name in self.excluded:
+            return False
+        if len(node.inputs) < 2 or not node.inputs[1][0].is_variable:
+            return False
+        return self.ranges is None or self._in_name(node) in self.ranges
+
+    def create_selector(self):
+        return _QuantizeSelector(self)
+
+    def filter_region(self, region, consumers, head_ids):
+        return region
+
+    def create_subgraph_node(self, sub_sym, region, input_names, sid):
+        node = region[0]
+        qop = _QUANTIZABLE[node.op.name]
+        qattrs = {}
+        if self.ranges is not None:
+            lo, hi = self.ranges[self._in_name(node)]
+            qattrs = {"min_calib_range": float(lo),
+                      "max_calib_range": float(hi)}
+        data_ph = Variable(input_names[0])
+        q = invoke_symbol("_contrib_quantize_v2", [data_ph], qattrs,
+                          name=node.name + "_quantize")
+        wname = node.inputs[1][0].name
+        self.offline.append(wname)
+        qw = Variable(wname + "_quantize")
+        wmin, wmax = Variable(wname + "_min"), Variable(wname + "_max")
+        no_bias = node.attrs.get("no_bias", False)
+        if not no_bias and len(node.inputs) >= 3 \
+                and node.inputs[2][0].is_variable:
+            bname = node.inputs[2][0].name
+            self.offline.append(bname)
+            qb = Variable(bname + "_quantize")
+            bmin, bmax = Variable(bname + "_min"), Variable(bname + "_max")
+        else:
+            qb = Variable(node.name + "_no_bias")  # zero int8 stand-in
+            bmin, bmax = wmin, wmax  # same vars, no duplicates
+        q_out = q  # quantize_v2 has 3 visible outputs (data, min, max)
+        core = invoke_symbol(
+            qop, [q_out[0], qw, qb, q_out[1], q_out[2],
+                  wmin, wmax, bmin, bmax],
+            dict(node.attrs), name=node.name + "_quantized")
+        deq = invoke_symbol(
+            "_contrib_dequantize", [core[0], core[1], core[2]], {},
+            name=node.name + "_dequantize")
+        return deq
+
+    def transform_params(self, applied, arg_params, aux_params):
+        return arg_params, aux_params
+
+
 def quantize_symbol(sym: Symbol,
                     ranges: Optional[Dict[str, Tuple[float, float]]],
                     excluded_sym_names=(),
@@ -247,88 +345,17 @@ def quantize_symbol(sym: Symbol,
     input range was calibrated; ``ranges=None`` quantizes EVERY
     supported op with runtime (dynamic) min/max — the calib_mode='none'
     workflow.  Returns (qsym, names of params that `quantize_params`
-    must convert offline)."""
+    must convert offline).
+
+    The rewrite itself runs through the pluggable subgraph framework
+    (`mxtpu.subgraph.partition_with_property` with `QuantizeProperty`)."""
     if quantized_dtype != "int8":
         raise MXNetError("only int8 is supported (got %r)" % quantized_dtype)
-    from ..ops.registry import get_op
-    from ..symbol.symbol import SymbolNode
+    from ..subgraph import partition_with_property
 
-    memo: Dict[int, Any] = {}   # id(old node) -> new SymbolNode
-    offline: List[str] = []
-
-    def var(name):
-        return SymbolNode(None, name, {}, [])
-
-    def out_name(src, idx):
-        if src.is_variable:
-            return src.name
-        if src.num_outputs() == 1:
-            return src.name + "_output"
-        return "%s_output%d" % (src.name, idx)
-
-    def map_node(node):
-        if id(node) in memo:
-            return memo[id(node)]
-        if node.is_variable:
-            new = SymbolNode(None, node.name, {}, [], is_aux=node.is_aux)
-            new.ext_attrs = dict(node.ext_attrs)
-            memo[id(node)] = new
-            return new
-        new_inputs = [(map_node(src), idx) for src, idx in node.inputs]
-        qop = _QUANTIZABLE.get(node.op.name)
-        in_name = out_name(*node.inputs[0])
-        dynamic = ranges is None
-        if qop is not None and node.name not in excluded_sym_names \
-                and (dynamic or in_name in ranges) \
-                and len(node.inputs) >= 2 \
-                and node.inputs[1][0].is_variable:
-            qattrs = {}
-            if not dynamic:
-                lo, hi = ranges[in_name]
-                qattrs = {"min_calib_range": float(lo),
-                          "max_calib_range": float(hi)}
-            qnode = SymbolNode(
-                get_op("_contrib_quantize_v2"), node.name + "_quantize",
-                qattrs, [new_inputs[0]])
-            wname = node.inputs[1][0].name
-            offline.append(wname)
-            qw = var(wname + "_quantize")
-            wmin, wmax = var(wname + "_min"), var(wname + "_max")
-            no_bias = node.attrs.get("no_bias", False)
-            if not no_bias and len(node.inputs) >= 3 \
-                    and node.inputs[2][0].is_variable:
-                bname = node.inputs[2][0].name
-                offline.append(bname)
-                qb, bmin, bmax = (var(bname + "_quantize"),
-                                  var(bname + "_min"), var(bname + "_max"))
-            else:
-                qb = var(node.name + "_no_bias")  # zero int8 stand-in
-                bmin, bmax = wmin, wmax  # same NODES, no duplicate vars
-            core = SymbolNode(
-                get_op(qop), node.name + "_quantized", dict(node.attrs),
-                [(qnode, 0), (qw, 0), (qb, 0),
-                 (qnode, 1), (qnode, 2), (wmin, 0), (wmax, 0),
-                 (bmin, 0), (bmax, 0)])
-            deq = SymbolNode(get_op("_contrib_dequantize"),
-                             node.name + "_dequantize", {}, [(core, 0),
-                                                             (core, 1),
-                                                             (core, 2)])
-            memo[id(node)] = deq
-            return deq
-        new = SymbolNode(node.op, node.name, dict(node.attrs), new_inputs)
-        new.ext_attrs = dict(node.ext_attrs)
-        memo[id(node)] = new
-        return new
-
-    new_entries = []
-    for n, i in sym._outputs:
-        mapped = map_node(n)
-        # a quantized op's replacement (dequantize) has ONE output
-        if mapped.op is not None and \
-                mapped.op.name == "_contrib_dequantize":
-            i = 0
-        new_entries.append((mapped, i))
-    return Symbol(new_entries), offline
+    prop = QuantizeProperty(ranges, excluded_sym_names)
+    qsym = partition_with_property(sym, prop)
+    return qsym, prop.offline
 
 
 def quantize_params(qsym: Symbol, arg_params: Dict[str, NDArray],

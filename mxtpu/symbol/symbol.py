@@ -460,16 +460,38 @@ class Symbol(object):
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
                     shared_exec=None, shared_buffer=None, **kwargs):
         from ..executor import Executor
+        from ..subgraph import apply_bind_hook
 
         Symbol._check_group2ctx(group2ctx, ctx)
-        return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs)
+        return Executor._simple_bind(apply_bind_hook(self), ctx, grad_req,
+                                     type_dict, kwargs)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from ..executor import Executor
+        from ..subgraph import apply_bind_hook
 
         Symbol._check_group2ctx(group2ctx, ctx)
-        return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states)
+        return Executor._bind(apply_bind_hook(self), ctx, args, args_grad,
+                              grad_req, aux_states)
+
+    def optimize_for(self, backend, args=None, aux=None, **kwargs):
+        """Apply a registered subgraph backend to this graph (the
+        reference's `Symbol.optimize_for` / `MXNET_SUBGRAPH_BACKEND`
+        partitioning, `src/operator/subgraph/partition_graph.cc`).
+
+        Parameter-free backends return the partitioned Symbol; backends
+        that rewrite parameter values (e.g. ``"TPU"`` Conv+BN folding)
+        require `args`/`aux` dicts and return
+        ``(symbol, new_args, new_aux)``."""
+        from ..subgraph import partition
+
+        if kwargs:
+            raise TypeError(
+                "optimize_for: unsupported backend options %s (this "
+                "build's backends take their configuration at "
+                "registration time)" % sorted(kwargs))
+        return partition(self, backend, arg_params=args, aux_params=aux)
 
     def eval(self, ctx=None, **kwargs):
         ex = self.bind(ctx=ctx, args=kwargs)

@@ -1,0 +1,265 @@
+"""Subgraph partitioning framework (`mxtpu/subgraph.py`).
+
+Covers the reference's subgraph contract
+(`src/operator/subgraph/subgraph_property.h`,
+`partition_graph.cc` BuildSubgraph): selector-driven region growth,
+convexity, generic wrapped-subgraph execution, the built-in Conv+BN
+fold backend, and the MXTPU_SUBGRAPH_BACKEND bind hook
+(reference MXNET_SUBGRAPH_BACKEND).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import sym
+from mxtpu.subgraph import (SubgraphProperty, SubgraphSelector,
+                            partition_with_property, register_backend,
+                            list_backends)
+
+
+def _conv_bn_net(with_bias=False, two_convs=False):
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           no_bias=not with_bias, name="conv0")
+    bn = sym.BatchNorm(conv, fix_gamma=False, name="bn0")
+    act = sym.Activation(bn, act_type="relu", name="relu0")
+    if two_convs:
+        conv1 = sym.Convolution(act, kernel=(1, 1), num_filter=4,
+                                no_bias=True, name="conv1")
+        bn1 = sym.BatchNorm(conv1, fix_gamma=True, name="bn1")
+        act = sym.Activation(bn1, act_type="relu", name="relu1")
+    pool = sym.Pooling(act, global_pool=True, pool_type="avg", name="pool0")
+    fc = sym.FullyConnected(pool.flatten(), num_hidden=10, name="fc0")
+    return fc
+
+
+def _random_params(net, data_shape):
+    arg_shapes, _, aux_shapes = net.infer_shape(data=data_shape)
+    rng = np.random.RandomState(0)
+    args, aux = {}, {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name == "data":
+            continue
+        args[name] = mx.nd.array(rng.uniform(-0.5, 0.5, shp)
+                                 .astype(np.float32))
+    for name, shp in zip(net.list_auxiliary_states(), aux_shapes):
+        if "var" in name:
+            aux[name] = mx.nd.array(rng.uniform(0.5, 2.0, shp)
+                                    .astype(np.float32))
+        else:
+            aux[name] = mx.nd.array(rng.uniform(-0.5, 0.5, shp)
+                                    .astype(np.float32))
+    return args, aux
+
+
+def _infer_forward(net, args, aux, x):
+    exe = net.simple_bind(ctx=mx.cpu(), grad_req="null", data=x.shape)
+    exe.copy_params_from(args, aux, allow_extra_params=False)
+    return exe.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+
+
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_conv_bn_fold_matches_inference(with_bias):
+    net = _conv_bn_net(with_bias=with_bias, two_convs=True)
+    shape = (2, 3, 8, 8)
+    args, aux = _random_params(net, shape)
+    x = np.random.RandomState(1).uniform(-1, 1, shape).astype(np.float32)
+    ref = _infer_forward(net, args, aux, x)
+
+    fsym, fargs, faux = net.optimize_for("TPU", args=args, aux=aux)
+    ops = [n.op.name for n in fsym._topo() if not n.is_variable]
+    assert "BatchNorm" not in ops, ops
+    # both BNs folded; folded conv gained a bias, BN params dropped
+    assert "bn0_gamma" not in fargs and "bn0_beta" not in fargs
+    assert not faux, sorted(faux)
+    got = _infer_forward(fsym, fargs, faux, x)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_conv_bn_fold_skipped_when_conv_shared():
+    """A conv whose output feeds BOTH a BN and another consumer must not
+    be folded (folding would change the second consumer's input)."""
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(1, 1), num_filter=4,
+                           no_bias=True, name="convS")
+    bn = sym.BatchNorm(conv, name="bnS")
+    merged = bn + conv  # second consumer of the conv output
+    net = sym.Pooling(merged, global_pool=True, pool_type="avg")
+    shape = (1, 2, 4, 4)
+    args, aux = _random_params(net, shape)
+    fsym, fargs, faux = net.optimize_for("TPU", args=args, aux=aux)
+    ops = [n.op.name for n in fsym._topo() if not n.is_variable]
+    assert "BatchNorm" in ops  # untouched
+    x = np.random.RandomState(2).uniform(-1, 1, shape).astype(np.float32)
+    np.testing.assert_allclose(_infer_forward(fsym, fargs, faux, x),
+                               _infer_forward(net, args, aux, x),
+                               rtol=1e-5, atol=1e-6)
+
+
+class _WrapActChains(SubgraphProperty):
+    """Test property: wrap Activation(+following elemwise) chains into
+    generic `_subgraph_exec` nodes."""
+
+    class _Sel(SubgraphSelector):
+        def select(self, node):
+            return node.op.name == "Activation"
+
+        def select_output(self, node, output_node):
+            return output_node.op.name in ("elemwise_add", "elemwise_mul")
+
+    def create_selector(self):
+        return self._Sel()
+
+
+def test_generic_wrap_forward_and_gradient():
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    fc = sym.FullyConnected(data, weight=w, num_hidden=6, no_bias=True,
+                            name="fcW")
+    act = sym.Activation(fc, act_type="tanh", name="actW")
+    out = sym.sum(act * act + act)
+    shape = (3, 4)
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-1, 1, shape).astype(np.float32)
+    wv = rng.uniform(-1, 1, (6, 4)).astype(np.float32)
+
+    prop = _WrapActChains()
+    psym = partition_with_property(out, prop)
+    ops = [n.op.name for n in psym._topo() if not n.is_variable]
+    assert "_subgraph_exec" in ops, ops
+    assert "Activation" not in ops
+
+    def run(s):
+        exe = s.simple_bind(ctx=mx.cpu(), grad_req="write", data=shape)
+        exe.arg_dict["w"]._set_jax(mx.nd.array(wv)._data)
+        outv = exe.forward(is_train=True, data=mx.nd.array(x))[0].asnumpy()
+        exe.backward()
+        return outv, exe.grad_dict["w"].asnumpy()
+
+    o_ref, g_ref = run(out)
+    o_got, g_got = run(psym)
+    np.testing.assert_allclose(o_got, o_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(g_got, g_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_generic_wrap_permuted_external_inputs():
+    """Region with TWO external inputs whose discovery order differs
+    from the subgraph's list_inputs() (topo) order: values must bind to
+    the right placeholders (regression: positional zip mismatch)."""
+    data = sym.Variable("data")
+    act = sym.Activation(data, act_type="relu", name="actP")
+    ext = data * 2.0  # external, shape (2, 3)
+    out = mx.sym.elemwise_add(ext, act, name="addP")
+
+    class P(SubgraphProperty):
+        class _S(SubgraphSelector):
+            def select(self, node):
+                return node.name == "actP"
+
+            def select_output(self, node, output_node):
+                return output_node.name == "addP"
+
+        def create_selector(self):
+            return self._S()
+
+    psym = partition_with_property(out, P())
+    ops = [n.op.name for n in psym._topo() if not n.is_variable]
+    assert "_subgraph_exec" in ops
+    x = np.random.RandomState(6).uniform(-1, 1, (2, 3)).astype(np.float32)
+    got = psym.bind(ctx=mx.cpu(), args={"data": mx.nd.array(x)}) \
+        .forward()[0].asnumpy()
+    np.testing.assert_allclose(got, x * 2.0 + np.maximum(x, 0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_wrapped_subgraph_survives_save_load(tmp_path):
+    data = sym.Variable("data")
+    act = sym.Activation(data, act_type="sigmoid", name="actJ")
+    out = act + act
+    psym = partition_with_property(out, _WrapActChains())
+    fn = str(tmp_path / "sg.json")
+    psym.save(fn)
+    loaded = mx.sym.load(fn)
+    x = np.random.RandomState(4).uniform(-1, 1, (2, 3)).astype(np.float32)
+    a = loaded.bind(ctx=mx.cpu(), args={"data": mx.nd.array(x)}) \
+        .forward()[0].asnumpy()
+    b = (1 / (1 + np.exp(-x))) * 2
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+class _GreedyPair(SubgraphProperty):
+    """Deliberately non-convex: grab exactly the two named nodes."""
+
+    def __init__(self, names):
+        self.names = set(names)
+
+    def create_selector(self):
+        prop = self
+
+        class S(SubgraphSelector):
+            def select(self, node):
+                return node.name in prop.names
+
+            def select_input(self, node, input_node):
+                return input_node.name in prop.names
+
+            def select_output(self, node, output_node):
+                return output_node.name in prop.names
+        return S()
+
+
+def test_non_convex_region_rejected():
+    """a -> b -> d and a -> d: region {a, d} contracted would cycle
+    through b; the driver must refuse it (and leave the graph alone)."""
+    data = sym.Variable("data")
+    a = sym.Activation(data, act_type="relu", name="nodeA")
+    b = sym.Activation(a, act_type="tanh", name="nodeB")
+    d = mx.sym.elemwise_add(a, b, name="nodeD")
+    prop = _GreedyPair(["nodeA", "nodeD"])
+    psym = partition_with_property(d, prop)
+    ops = [n.op.name for n in psym._topo() if not n.is_variable]
+    assert "_subgraph_exec" not in ops
+    x = np.random.RandomState(5).uniform(-1, 1, (2, 2)).astype(np.float32)
+    got = psym.bind(ctx=mx.cpu(), args={"data": mx.nd.array(x)}) \
+        .forward()[0].asnumpy()
+    r = np.maximum(x, 0)
+    np.testing.assert_allclose(got, r + np.tanh(r), rtol=1e-5, atol=1e-6)
+
+
+def test_backend_registry_and_bind_hook(monkeypatch):
+    assert "TPU" in list_backends()
+    # a param-free backend applied through the env hook at bind time
+    name = "TEST_WRAP_ACT"
+    if name not in list_backends():
+        register_backend(name, _WrapActChains)
+    monkeypatch.setenv("MXTPU_SUBGRAPH_BACKEND", name)
+    data = sym.Variable("data")
+    out = sym.Activation(data, act_type="relu", name="actE") * 1.0
+    exe = out.simple_bind(ctx=mx.cpu(), grad_req="null", data=(2, 2))
+    lowered = [n.op.name for n in exe._symbol._topo() if not n.is_variable]
+    assert "_subgraph_exec" in lowered
+    x = np.asarray([[-1.0, 2.0], [3.0, -4.0]], np.float32)
+    got = exe.forward(data=mx.nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(got, np.maximum(x, 0))
+    # a needs_params backend is refused by the hook (warn + passthrough)
+    monkeypatch.setenv("MXTPU_SUBGRAPH_BACKEND", "TPU")
+    exe2 = out.simple_bind(ctx=mx.cpu(), grad_req="null", data=(2, 2))
+    assert "_subgraph_exec" not in [
+        n.op.name for n in exe2._symbol._topo() if not n.is_variable]
+
+
+def test_quantization_rides_the_framework():
+    """quantize_symbol routes through partition_with_property."""
+    from mxtpu.contrib.quantization import quantize_symbol
+
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=8, name="fcQ")
+    out = sym.Activation(fc, act_type="relu")
+    qsym, offline = quantize_symbol(out, None)
+    ops = [n.op.name for n in qsym._topo() if not n.is_variable]
+    assert "_contrib_quantize_v2" in ops
+    assert "_contrib_quantized_fully_connected" in ops
+    assert "_contrib_dequantize" in ops
+    assert "fcQ_weight" in offline and "fcQ_bias" in offline
