@@ -307,7 +307,8 @@ def _record_op(opdef, nd_inputs, jax_inputs, attrs: Dict[str, Any], rng_key=None
     reference's kernel-per-op execution."""
     from .ops.registry import _jitted, canonical_attrs
 
-    if opdef.name == "Embedding" and attrs.get("sparse_grad"):
+    if (opdef.name == "Embedding" and attrs.get("sparse_grad")) or \
+            opdef.name == "_contrib_SparseEmbedding":
         return _record_embedding_sparse(opdef, nd_inputs, jax_inputs,
                                         attrs, rng_key)
     fn = _jitted(opdef.name, canonical_attrs(attrs))

@@ -276,12 +276,9 @@ class QuantizeProperty(_SubgraphProperty):
         self.offline: List[str] = []
 
     def _in_name(self, node):
-        src, idx = node.inputs[0]
-        if src.is_variable:
-            return src.name
-        if src.num_outputs() == 1:
-            return src.name + "_output"
-        return "%s_output%d" % (src.name, idx)
+        from ..subgraph import _entry_name
+
+        return _entry_name(*node.inputs[0])
 
     def _quantizable(self, node):
         if node.is_variable or node.op.name not in _QUANTIZABLE:

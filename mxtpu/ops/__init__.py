@@ -19,6 +19,8 @@ from . import linalg  # noqa: F401
 from . import rnn_op  # noqa: F401
 from . import contrib  # noqa: F401
 from . import vision  # noqa: F401
+from . import rcnn  # noqa: F401
+from . import dgl  # noqa: F401
 from . import image  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import quantization  # noqa: F401

@@ -1,0 +1,220 @@
+"""DGL graph operators, TPU-first.
+
+Covers the reference's graph-sampling corpus
+(`src/operator/contrib/dgl_graph.cc`: _contrib_edge_id,
+_contrib_dgl_adjacency, _contrib_dgl_subgraph,
+_contrib_dgl_csr_neighbor_uniform_sample,
+_contrib_dgl_csr_neighbor_non_uniform_sample,
+_contrib_dgl_graph_compact).
+
+Format: the reference operates on CSR NDArrays whose values are edge
+ids.  This build's sparse NDArrays lower to dense payloads for compute
+(`mxtpu/ndarray/sparse.py`), so these ops take a dense adjacency matrix
+``A`` of shape (V, V) with ``A[u, v] = edge_id + 1`` and ``0`` meaning
+"no edge" (the +1 keeps edge id 0 distinguishable from absence; a
+`CSRNDArray` built from raw edge ids can be shifted with ``A + (A != 0)``).
+Everything is static-shaped: sampling ops take the same
+``max_num_vertices`` bound the reference requires and pad vertex lists
+with -1, so the whole pipeline jits.
+
+Deviations from the reference (documented, by design):
+  * sampled subgraphs are VERTEX-induced — all parent edges among the
+    sampled vertices appear, not only the traversed ones;
+  * `_contrib_dgl_graph_compact` masks beyond the recorded graph size
+    instead of renumbering (dense layouts are already packed).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@register("_contrib_edge_id", differentiable=False)
+def _edge_id(data, u, v):
+    """Edge ids for (u, v) pairs; -1 where no edge exists (reference
+    `dgl_graph.cc` _contrib_edge_id)."""
+    jnp = _jnp()
+    ui = u.astype(jnp.int32)
+    vi = v.astype(jnp.int32)
+    return (data[ui, vi] - 1.0).astype(data.dtype)
+
+
+@register("_contrib_dgl_adjacency", differentiable=False)
+def _dgl_adjacency(data):
+    """Binary (1.0) adjacency from an edge-id graph (reference
+    _contrib_dgl_adjacency)."""
+    jnp = _jnp()
+    return (data != 0).astype(jnp.float32)
+
+
+def _induced(graph, vids):
+    """Induced edge-id submatrix on a -1-padded vertex list."""
+    jnp = _jnp()
+    vi = vids.astype(jnp.int32)
+    valid = vi >= 0
+    vc = jnp.clip(vi, 0, graph.shape[0] - 1)
+    sub = graph[vc[:, None], vc[None, :]]
+    mask = valid[:, None] & valid[None, :]
+    return sub * mask.astype(graph.dtype)
+
+
+@register("_contrib_dgl_subgraph",
+          num_outputs=lambda attrs: (int(attrs.get("num_args", 2)) - 1) *
+          (2 if attrs.get("return_mapping") else 1),
+          differentiable=False)
+def _dgl_subgraph(graph, *vids, num_args=2, return_mapping=False):
+    """Vertex-induced subgraphs (reference _contrib_dgl_subgraph): for
+    each -1-padded vertex-id array, the induced subgraph in subgraph
+    numbering; with return_mapping also the parent-edge-id matrix."""
+    jnp = _jnp()
+    subs = []
+    maps = []
+    for v in vids:
+        eid = _induced(graph, v)
+        subs.append((eid != 0).astype(jnp.float32))
+        if return_mapping:
+            maps.append(eid)
+    return tuple(subs + maps)
+
+
+def _neighbor_sample(key, graph, seeds, prob, num_hops, num_neighbor,
+                     max_num_vertices):
+    """Shared BFS sampler.  Per hop, every frontier vertex keeps up to
+    `num_neighbor` outgoing neighbors — uniformly when `prob` is None,
+    else weighted without replacement via exponential-race keys
+    (Efraimidis–Kirschenhofer reservoir: larger u^(1/w) wins).  Returns
+    (padded vertex list, induced subgraph, per-vertex layer)."""
+    import jax
+
+    jnp = _jnp()
+    V = graph.shape[0]
+    M = int(max_num_vertices)
+    si = seeds.astype(jnp.int32)
+    seed_valid = si >= 0
+    sc = jnp.clip(si, 0, V - 1)
+    # .max, not .set: -1 padding clamps onto index 0 and a duplicate-
+    # index .set would let its False overwrite a real seed's True
+    selected = jnp.zeros((V,), bool).at[sc].max(seed_valid)
+    layer = jnp.where(selected, 0, -1)
+    frontier = selected
+    adj = graph != 0
+    for hop in range(1, int(num_hops) + 1):
+        key, sub = jax.random.split(key)
+        r = jax.random.uniform(sub, (V, V), minval=1e-6, maxval=1.0)
+        if prob is not None:
+            w = jnp.clip(prob.astype(jnp.float32), 1e-9, None)
+            r = r ** (1.0 / w[None, :])
+        race = jnp.where(adj & frontier[:, None], r, 0.0)
+        k = min(int(num_neighbor), V)
+        vals, idx = jax.lax.top_k(race, k)            # per-row winners
+        won = vals > 0.0
+        picked = jnp.zeros((V,), bool).at[
+            jnp.where(won, idx, 0).reshape(-1)].max(won.reshape(-1))
+        newly = picked & (~selected)
+        selected = selected | newly
+        layer = jnp.where(newly, hop, layer)
+        frontier = newly
+    # vertex order: seeds first, then by (hop, id) — the reference also
+    # emits seeds before sampled neighbors
+    order_key = jnp.where(selected, layer * V + jnp.arange(V), 2 * V * V)
+    take = min(M, V)
+    verts = jnp.argsort(order_key)[:take]
+    if take < M:  # static pad up to the requested bound
+        verts = jnp.concatenate(
+            [verts, jnp.zeros((M - take,), verts.dtype)])
+        vvalid = jnp.concatenate(
+            [jnp.take(selected, verts[:take]), jnp.zeros((M - take,), bool)])
+    else:
+        vvalid = jnp.take(selected, verts)
+    verts = jnp.where(vvalid, verts, -1)
+    sub = _induced(graph, verts)
+    vlayer = jnp.where(vvalid, jnp.take(layer, verts), -1)
+    return verts.astype(jnp.int64), sub, vlayer.astype(jnp.int64)
+
+
+@register("_contrib_dgl_csr_neighbor_uniform_sample",
+          num_outputs=lambda attrs: 3 * (int(attrs.get("num_args", 2)) - 1),
+          needs_rng=True, differentiable=False)
+def _dgl_neighbor_uniform(key, graph, *seeds, num_args=2, num_hops=1,
+                          num_neighbor=2, max_num_vertices=100):
+    """Uniform neighbor sampling (reference
+    _contrib_dgl_csr_neighbor_uniform_sample): for each seed array,
+    (sampled vertices padded to max_num_vertices with -1, the sampled
+    subgraph, per-vertex hop layer)."""
+    outs = []
+    for s in seeds:
+        v, sub, lay = _neighbor_sample(key, graph, s, None, num_hops,
+                                       num_neighbor, max_num_vertices)
+        outs.append((v, sub, lay))
+    return tuple(x for trio in zip(*outs) for x in trio) if len(outs) > 1 \
+        else outs[0]
+
+
+@register("_contrib_dgl_csr_neighbor_non_uniform_sample",
+          num_outputs=lambda attrs: 4 * (int(attrs.get("num_args", 3)) - 2),
+          needs_rng=True, differentiable=False)
+def _dgl_neighbor_non_uniform(key, graph, prob, *seeds, num_args=3,
+                              num_hops=1, num_neighbor=2,
+                              max_num_vertices=100):
+    """Weighted neighbor sampling (reference
+    _contrib_dgl_csr_neighbor_non_uniform_sample): per seed array,
+    (vertices, subgraph, layer, per-vertex sampling weight)."""
+    jnp = _jnp()
+    outs = []
+    for s in seeds:
+        v, sub, lay = _neighbor_sample(key, graph, s, prob, num_hops,
+                                       num_neighbor, max_num_vertices)
+        vc = jnp.clip(v.astype(jnp.int32), 0, graph.shape[0] - 1)
+        pv = jnp.where(v >= 0, jnp.take(prob, vc), 0.0)
+        outs.append((v, sub, lay, pv))
+    return tuple(x for quad in zip(*outs) for x in quad) if len(outs) > 1 \
+        else outs[0]
+
+
+@register("_contrib_dgl_graph_compact",
+          num_outputs=lambda attrs: (int(attrs.get("num_args", 2)) - 1) *
+          (2 if attrs.get("return_mapping") else 1),
+          differentiable=False)
+def _dgl_graph_compact(*graphs, num_args=2, return_mapping=False,
+                       graph_sizes=()):
+    """Compact subgraphs to their recorded sizes (reference
+    _contrib_dgl_graph_compact).  Dense layouts are already packed, so
+    compaction masks entries beyond each graph's size; with
+    return_mapping the masked edge-id matrix is returned too."""
+    jnp = _jnp()
+    sizes = tuple(int(s) for s in (graph_sizes if graph_sizes else
+                                   (graphs[0].shape[0],) * len(graphs)))
+    outs = []
+    maps = []
+    for g, n in zip(graphs, sizes):
+        V = g.shape[0]
+        keep = (jnp.arange(V) < n)
+        mask = (keep[:, None] & keep[None, :]).astype(g.dtype)
+        outs.append((g != 0).astype(jnp.float32) * mask)
+        if return_mapping:
+            maps.append(g * mask)
+    return tuple(outs + maps)
+
+
+@register("_copyto")
+def _copyto(data):
+    """Identity copy (reference `_copyto` moves between contexts; this
+    build has one logical device per executor, so the imperative layer
+    owns placement and the op is the identity)."""
+    return data
+
+
+@register("_scatter_elemwise_div")
+def _scatter_elemwise_div(lhs, rhs):
+    """Sparse-output elementwise division (reference
+    `_scatter_elemwise_div` writes only the lhs's stored rows).  Dense
+    lowering divides everywhere; the row-sparse wrapper re-applies its
+    row structure on the way out (`mxtpu/ndarray/sparse.py`)."""
+    return lhs / rhs

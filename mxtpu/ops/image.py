@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..base import MXNetError
 from .registry import register
 
 
@@ -89,3 +90,39 @@ def _crop_img(data, x=0, y=0, width=0, height=0):
     if data.ndim == 3:
         return data[y:y + height, x:x + width]
     return data[:, y:y + height, x:x + width]
+
+
+@register("_cvimresize", differentiable=False)
+def _cvimresize(data, w=0, h=0, interp=1):
+    """OpenCV-style resize as an op (reference `src/io/image_io.cc`
+    _cvimresize; HWC uint8/float).  Host decode lives in
+    `mxtpu.image.imread/imdecode`; the resize delegates to the
+    `_image_resize` kernel above."""
+    return _resize(data, size=(int(w), int(h)), interp=interp)
+
+
+@register("_cvcopyMakeBorder", differentiable=False)
+def _cvcopy_make_border(data, top=0, bot=0, left=0, right=0, type=0,
+                        value=0.0, values=()):
+    """Border padding (reference `src/io/image_io.cc` _cvcopyMakeBorder).
+    type 0 = BORDER_CONSTANT, 1 = BORDER_REPLICATE, 2 = BORDER_REFLECT,
+    4 = BORDER_REFLECT_101 (OpenCV numbering); other modes raise."""
+    jnp = _jnp()
+    pads = [(int(top), int(bot)), (int(left), int(right)), (0, 0)]
+    if type == 0:
+        if values:
+            vals = list(values) + [values[-1]] * (data.shape[-1]
+                                                  - len(values))
+            out = jnp.stack(
+                [jnp.pad(data[..., c], pads[:2], constant_values=vals[c])
+                 for c in range(data.shape[-1])], axis=-1)
+            return out
+        return jnp.pad(data, pads, constant_values=value)
+    if type == 1:
+        return jnp.pad(data, pads, mode="edge")
+    if type == 2:
+        return jnp.pad(data, pads, mode="symmetric")
+    if type == 4:
+        return jnp.pad(data, pads, mode="reflect")
+    raise MXNetError("_cvcopyMakeBorder: unsupported border type %r"
+                     % (type,))

@@ -48,6 +48,19 @@ def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
     return jnp.take(weight, idx, axis=0)
 
 
+@register("_contrib_SparseEmbedding")
+def _sparse_embedding(data, weight, input_dim=0, output_dim=0,
+                      dtype="float32", deterministic=False):
+    """Embedding whose weight gradient is ALWAYS row-sparse (reference
+    `src/operator/tensor/indexing_op.cc` _contrib_SparseEmbedding).
+    Same lookup as Embedding; the autograd tape routes its weight
+    cotangent through the SparseCot segment-sum path
+    (`mxtpu/autograd.py`)."""
+    jnp = _jnp()
+    idx = jnp.clip(data.astype(np.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
 @register("gather_nd")
 def _gather_nd(data, indices):
     jnp = _jnp()

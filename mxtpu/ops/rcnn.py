@@ -1,0 +1,530 @@
+"""RCNN-family contrib operators, TPU-first.
+
+Covers the reference's region-proposal / deformable detection corpus:
+`src/operator/contrib/proposal.cc` (+ `proposal-inl.h` anchor math),
+`multi_proposal.cc`, `psroi_pooling.cc`,
+`deformable_psroi_pooling.cu` (the CPU file is NOT_IMPLEMENTED — the
+CUDA kernel defines the semantics), and
+`deformable_convolution.cc` over `nn/deformable_im2col.cuh`.
+
+Design: everything is static-shaped and vectorized so XLA can tile it —
+top-k + fixed-trip-count greedy NMS instead of dynamic keep lists,
+masked means over arange grids instead of per-box scalar loops, and
+flat-index bilinear gathers instead of im2col pointer walks.  The
+deformable conv builds its sampled column tensor with one fused gather
+and rides the MXU through a grouped einsum.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+# ---------------------------------------------------------------------------
+# Anchor generation (reference proposal-inl.h GenerateAnchors/_Transform;
+# pure numpy — attrs are static, so the anchor table is a compile-time
+# constant folded into the XLA program)
+# ---------------------------------------------------------------------------
+
+def _generate_anchors(feature_stride, scales, ratios):
+    base_w = base_h = float(feature_stride)
+    x_ctr = 0.5 * (base_w - 1.0)
+    y_ctr = 0.5 * (base_h - 1.0)
+    size = base_w * base_h
+    out = []
+    for r in ratios:
+        size_ratio = np.floor(size / r)
+        base = np.floor(np.sqrt(size_ratio) + 0.5)
+        for s in scales:
+            new_w = base * s
+            new_h = np.floor(base * r + 0.5) * s
+            out.append([x_ctr - 0.5 * (new_w - 1.0),
+                        y_ctr - 0.5 * (new_h - 1.0),
+                        x_ctr + 0.5 * (new_w - 1.0),
+                        y_ctr + 0.5 * (new_h - 1.0)])
+    return np.asarray(out, np.float32)
+
+
+def _greedy_nms_suppressed(boxes, thresh):
+    """Sequential greedy NMS over score-sorted boxes; returns the
+    suppression mask (reference NonMaximumSuppression, +1 pixel area
+    convention)."""
+    jnp = _jnp()
+    lax = _jax().lax
+    n = boxes.shape[0]
+    x1, y1, x2, y2 = (boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3])
+    area = (x2 - x1 + 1.0) * (y2 - y1 + 1.0)
+    idx = jnp.arange(n)
+
+    def body(i, suppressed):
+        xx1 = jnp.maximum(x1[i], x1)
+        yy1 = jnp.maximum(y1[i], y1)
+        xx2 = jnp.minimum(x2[i], x2)
+        yy2 = jnp.minimum(y2[i], y2)
+        w = jnp.maximum(xx2 - xx1 + 1.0, 0.0)
+        h = jnp.maximum(yy2 - yy1 + 1.0, 0.0)
+        inter = w * h
+        iou = inter / (area[i] + area - inter)
+        kill = (iou > thresh) & (idx > i) & (~suppressed[i])
+        return suppressed | kill
+
+    return lax.fori_loop(0, n, body, jnp.zeros((n,), bool))
+
+
+def _proposal_one_image(scores_fg, deltas, im_info, anchors, feature_stride,
+                        pre_nms_top_n, post_nms_top_n, threshold,
+                        rpn_min_size, iou_loss):
+    """One image of RPN proposal generation (reference proposal.cc
+    Forward).  scores_fg: (A, H, W) foreground scores; deltas:
+    (4A, H, W); im_info: (3,) = (height, width, scale).  Returns
+    (rois (post, 4), scores (post,))."""
+    jnp = _jnp()
+    lax = _jax().lax
+    A, H, W = scores_fg.shape
+    fs = float(feature_stride)
+
+    # shifted anchors, flattened in the reference's (h, w, a) order
+    sx = jnp.broadcast_to(jnp.arange(W, dtype=jnp.float32)[None, :] * fs,
+                          (H, W))
+    sy = jnp.broadcast_to(jnp.arange(H, dtype=jnp.float32)[:, None] * fs,
+                          (H, W))
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1)[:, :, None, :]  # H,W,1,4
+    boxes = (jnp.asarray(anchors)[None, None, :, :] + shifts) \
+        .reshape(-1, 4)  # (K, 4), K = H*W*A
+
+    d = deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+    score = scores_fg.transpose(1, 2, 0).reshape(-1)
+
+    im_h, im_w, im_scale = im_info[0], im_info[1], im_info[2]
+    width = boxes[:, 2] - boxes[:, 0] + 1.0
+    height = boxes[:, 3] - boxes[:, 1] + 1.0
+    if iou_loss:
+        # IoU-loss variant predicts corner offsets directly
+        # (proposal.cc IoUTransformInv)
+        px1 = boxes[:, 0] + d[:, 0]
+        py1 = boxes[:, 1] + d[:, 1]
+        px2 = boxes[:, 2] + d[:, 2]
+        py2 = boxes[:, 3] + d[:, 3]
+    else:
+        ctr_x = boxes[:, 0] + 0.5 * (width - 1.0)
+        ctr_y = boxes[:, 1] + 0.5 * (height - 1.0)
+        pred_ctr_x = d[:, 0] * width + ctr_x
+        pred_ctr_y = d[:, 1] * height + ctr_y
+        pred_w = jnp.exp(d[:, 2]) * width
+        pred_h = jnp.exp(d[:, 3]) * height
+        px1 = pred_ctr_x - 0.5 * (pred_w - 1.0)
+        py1 = pred_ctr_y - 0.5 * (pred_h - 1.0)
+        px2 = pred_ctr_x + 0.5 * (pred_w - 1.0)
+        py2 = pred_ctr_y + 0.5 * (pred_h - 1.0)
+    px1 = jnp.clip(px1, 0.0, im_w - 1.0)
+    py1 = jnp.clip(py1, 0.0, im_h - 1.0)
+    px2 = jnp.clip(px2, 0.0, im_w - 1.0)
+    py2 = jnp.clip(py2, 0.0, im_h - 1.0)
+
+    # anchors beyond the real (unpadded) feature extent score -1
+    hh = jnp.arange(H)[:, None, None]
+    ww = jnp.arange(W)[None, :, None]
+    real_h = jnp.ceil(im_h / fs).astype(jnp.int32)
+    real_w = jnp.ceil(im_w / fs).astype(jnp.int32)
+    oob = ((hh >= real_h) | (ww >= real_w))
+    score = jnp.where(jnp.broadcast_to(oob, (H, W, A)).reshape(-1),
+                      -1.0, score)
+
+    # min-size filter: widen the box and kill its score (FilterBox)
+    min_sz = rpn_min_size * im_scale
+    iw = px2 - px1 + 1.0
+    ih = py2 - py1 + 1.0
+    small = (iw < min_sz) | (ih < min_sz)
+    px1 = jnp.where(small, px1 - min_sz / 2, px1)
+    py1 = jnp.where(small, py1 - min_sz / 2, py1)
+    px2 = jnp.where(small, px2 + min_sz / 2, px2)
+    py2 = jnp.where(small, py2 + min_sz / 2, py2)
+    score = jnp.where(small, -1.0, score)
+    pboxes = jnp.stack([px1, py1, px2, py2], axis=1)
+
+    K = pboxes.shape[0]
+    n_pre = min(pre_nms_top_n, K) if pre_nms_top_n > 0 else K
+    top_scores, top_idx = lax.top_k(score, n_pre)
+    top_boxes = pboxes[top_idx]
+
+    suppressed = _greedy_nms_suppressed(top_boxes, threshold)
+    kept_pos = jnp.nonzero(~suppressed, size=n_pre, fill_value=0)[0]
+    out_size = jnp.maximum((~suppressed).sum(), 1)
+    i = jnp.arange(post_nms_top_n)
+    # fewer survivors than requested -> cycle them (proposal.cc fill)
+    pick = kept_pos[jnp.where(i < out_size, i % n_pre, i % out_size)]
+    return top_boxes[pick], top_scores[pick]
+
+
+@register("_contrib_Proposal",
+          num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1,
+          differentiable=False)
+def _contrib_proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                      rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                      scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                      feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposal generation (reference `proposal.cc`; batch size must
+    be 1 — `_contrib_MultiProposal` is the batched form)."""
+    if cls_prob.shape[0] != 1:
+        raise MXNetError("_contrib_Proposal requires batch 1 "
+                         "(use _contrib_MultiProposal)")
+    jnp = _jnp()
+    anchors = _generate_anchors(feature_stride, scales, ratios)
+    A = anchors.shape[0]
+    boxes, scores = _proposal_one_image(
+        cls_prob[0, A:], bbox_pred[0], im_info[0], anchors, feature_stride,
+        int(rpn_pre_nms_top_n), int(rpn_post_nms_top_n), float(threshold),
+        float(rpn_min_size), bool(iou_loss))
+    rois = jnp.concatenate(
+        [jnp.zeros((boxes.shape[0], 1), boxes.dtype), boxes], axis=1)
+    if output_score:
+        return rois, scores[:, None]
+    return rois
+
+
+@register("_contrib_MultiProposal",
+          num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1,
+          differentiable=False)
+def _contrib_multi_proposal(cls_prob, bbox_pred, im_info,
+                            rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=300,
+                            threshold=0.7, rpn_min_size=16,
+                            scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                            feature_stride=16, output_score=False,
+                            iou_loss=False):
+    """Batched RPN proposals (reference `multi_proposal.cc`): the
+    per-image pipeline vmapped over the batch; output rois are
+    (N*post_nms_top_n, 5) with the batch index in column 0."""
+    import jax
+
+    jnp = _jnp()
+    anchors = _generate_anchors(feature_stride, scales, ratios)
+    A = anchors.shape[0]
+
+    def one(scores_fg, deltas, info):
+        return _proposal_one_image(
+            scores_fg, deltas, info, anchors, feature_stride,
+            int(rpn_pre_nms_top_n), int(rpn_post_nms_top_n),
+            float(threshold), float(rpn_min_size), bool(iou_loss))
+
+    boxes, scores = jax.vmap(one)(cls_prob[:, A:], bbox_pred, im_info)
+    N, P = boxes.shape[:2]
+    bidx = jnp.broadcast_to(
+        jnp.arange(N, dtype=boxes.dtype)[:, None, None], (N, P, 1))
+    rois = jnp.concatenate([bidx, boxes], axis=2).reshape(N * P, 5)
+    if output_score:
+        return rois, scores.reshape(N * P, 1)
+    return rois
+
+
+# ---------------------------------------------------------------------------
+# Position-sensitive ROI pooling (reference psroi_pooling.cc)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_PSROIPooling")
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
+                   pooled_size=0, group_size=0):
+    """Position-sensitive ROI pooling (reference `psroi_pooling.cc`
+    PSROIPoolForwardCPU): each output bin average-pools ONE channel
+    group selected by its position.  Implemented as two masked
+    contractions over the H/W grids — no per-box loops, differentiable
+    w.r.t. `data` for free."""
+    jnp = _jnp()
+    P = int(pooled_size)
+    G = int(group_size) or P
+    od = int(output_dim)
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    f32 = jnp.float32
+
+    bidx = jnp.clip(rois[:, 0].astype(jnp.int32), 0, N - 1)
+    x1 = jnp.round(rois[:, 1]).astype(f32) * spatial_scale
+    y1 = jnp.round(rois[:, 2]).astype(f32) * spatial_scale
+    x2 = (jnp.round(rois[:, 3]) + 1.0).astype(f32) * spatial_scale
+    y2 = (jnp.round(rois[:, 4]) + 1.0).astype(f32) * spatial_scale
+    roi_w = jnp.maximum(x2 - x1, 0.1)
+    roi_h = jnp.maximum(y2 - y1, 0.1)
+    bin_h = roi_h / P  # (R,)
+    bin_w = roi_w / P
+
+    ph = jnp.arange(P, dtype=f32)
+    hstart = jnp.clip(jnp.floor(ph[None, :] * bin_h[:, None] + y1[:, None]),
+                      0, H).astype(jnp.int32)          # (R, P)
+    hend = jnp.clip(jnp.ceil((ph + 1.0)[None, :] * bin_h[:, None]
+                             + y1[:, None]), 0, H).astype(jnp.int32)
+    wstart = jnp.clip(jnp.floor(ph[None, :] * bin_w[:, None] + x1[:, None]),
+                      0, W).astype(jnp.int32)
+    wend = jnp.clip(jnp.ceil((ph + 1.0)[None, :] * bin_w[:, None]
+                             + x1[:, None]), 0, W).astype(jnp.int32)
+
+    hh = jnp.arange(H)
+    ww = jnp.arange(W)
+    mh = ((hh[None, None, :] >= hstart[:, :, None]) &
+          (hh[None, None, :] < hend[:, :, None])).astype(data.dtype)  # R,P,H
+    mw = ((ww[None, None, :] >= wstart[:, :, None]) &
+          (ww[None, None, :] < wend[:, :, None])).astype(data.dtype)  # R,P,W
+
+    data_r = data[bidx]  # (R, C, H, W)
+    s1 = jnp.einsum("rchw,rph->rcpw", data_r, mh)
+    s2 = jnp.einsum("rcpw,rqw->rcpq", s1, mw)          # (R, C, P, P)
+    cnt = jnp.einsum("rph,rqw->rpq", mh, mw)           # (R, P, P)
+
+    # channel map c = (ctop*G + gh)*G + gw with gh/gw from bin position
+    gh = np.minimum((np.arange(P) * G) // P, G - 1)
+    gw = gh
+    c_idx = ((np.arange(od)[:, None, None] * G + gh[None, :, None]) * G
+             + gw[None, None, :])                       # (od, P, P)
+    p_idx = np.arange(P)[None, :, None]
+    q_idx = np.arange(P)[None, None, :]
+    pooled = s2[:, c_idx, p_idx, q_idx]                # (R, od, P, P)
+    cnt = jnp.maximum(cnt, 1.0)[:, None, :, :]
+    return pooled / cnt
+
+
+# ---------------------------------------------------------------------------
+# Bilinear gather helper (shared by the deformable ops)
+# ---------------------------------------------------------------------------
+
+def _bilinear_flat(img_flat, W, H, y, x, chan=None):
+    """Bilinear interpolation via four flat gathers.
+
+    img_flat: (..., C*H*W) when `chan` is given, else (..., H*W);
+    y/x: sample positions broadcastable to the gather index shape;
+    chan: optional per-sample channel index.  Clamps like the reference
+    `deformable_im2col_bilinear` (edge extension inside the valid box).
+    """
+    jnp = _jnp()
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    ly = (y - y0).astype(img_flat.dtype)
+    lx = (x - x0).astype(img_flat.dtype)
+    y0i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
+    x0i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
+    y1i = jnp.clip(y0i + 1, 0, H - 1)
+    x1i = jnp.clip(x0i + 1, 0, W - 1)
+    base = 0 if chan is None else chan * (H * W)
+
+    def g(yi, xi):
+        idx = base + yi * W + xi
+        return jnp.take_along_axis(img_flat, idx, axis=-1)
+
+    v00, v01 = g(y0i, x0i), g(y0i, x1i)
+    v10, v11 = g(y1i, x0i), g(y1i, x1i)
+    return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+            v10 * ly * (1 - lx) + v11 * ly * lx)
+
+
+# ---------------------------------------------------------------------------
+# Deformable convolution (reference deformable_convolution.cc over
+# nn/deformable_im2col.cuh)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_DeformableConvolution")
+def _deformable_convolution(data, offset, weight, *maybe_bias, kernel=(),
+                            stride=(), dilate=(), pad=(), num_filter=0,
+                            num_group=1, num_deformable_group=1,
+                            no_bias=False, workspace=1024, layout=None):
+    """Deformable convolution v1 (https://arxiv.org/abs/1703.06211;
+    reference `deformable_convolution.cc`).  Each kernel tap samples at
+    `base + dilation + learned offset` with bilinear interpolation
+    (zero outside the image, reference `deformable_im2col_gpu_kernel`),
+    building the column tensor with one fused gather; the contraction
+    with the weights is a grouped einsum on the MXU."""
+    jnp = _jnp()
+    if len(kernel) != 2:
+        raise MXNetError("_contrib_DeformableConvolution supports 2D only")
+    kh, kw = kernel
+    sh, sw = stride or (1, 1)
+    dh, dw = dilate or (1, 1)
+    ph, pw = pad or (0, 0)
+    N, C, H, W = data.shape
+    DG = int(num_deformable_group)
+    Ho = (H + 2 * ph - ((kh - 1) * dh + 1)) // sh + 1
+    Wo = (W + 2 * pw - ((kw - 1) * dw + 1)) // sw + 1
+
+    h_in = jnp.arange(Ho, dtype=jnp.float32) * sh - ph     # (Ho,)
+    w_in = jnp.arange(Wo, dtype=jnp.float32) * sw - pw     # (Wo,)
+    off = offset.reshape(N, DG, kh * kw, 2, Ho, Wo)
+    taps = np.arange(kh * kw)
+    tap_dy = (taps // kw) * dh                              # (T,)
+    tap_dx = (taps % kw) * dw
+    # sample positions per (n, dg, tap, ho, wo)
+    y = (h_in[None, None, None, :, None] +
+         jnp.asarray(tap_dy, jnp.float32)[None, None, :, None, None] +
+         off[:, :, :, 0])
+    x = (w_in[None, None, None, None, :] +
+         jnp.asarray(tap_dx, jnp.float32)[None, None, :, None, None] +
+         off[:, :, :, 1])
+    valid = ((y >= 0) & (y < H) & (x >= 0) & (x < W))
+
+    Cg = C // DG
+    dflat = data.reshape(N, DG, Cg, H * W)
+    # broadcast positions over the Cg axis: (N, DG, Cg, T*Ho*Wo)
+    T = kh * kw
+    y_b = jnp.broadcast_to(y[:, :, None], (N, DG, Cg, T, Ho, Wo)) \
+        .reshape(N, DG, Cg, -1)
+    x_b = jnp.broadcast_to(x[:, :, None], (N, DG, Cg, T, Ho, Wo)) \
+        .reshape(N, DG, Cg, -1)
+    cols = _bilinear_flat(dflat, W, H, y_b, x_b)
+    cols = cols.reshape(N, DG, Cg, T, Ho, Wo) * \
+        valid[:, :, None].astype(data.dtype)
+    # (N, C, T, Ho, Wo) -> grouped (N, g, (C/g)*T, Ho*Wo)
+    g = int(num_group)
+    cols = cols.reshape(N, C, T, Ho, Wo) \
+        .reshape(N, g, (C // g) * T, Ho * Wo)
+    wmat = weight.reshape(g, num_filter // g, (C // g) * T)
+    out = jnp.einsum("gfk,ngkp->ngfp", wmat, cols) \
+        .reshape(N, num_filter, Ho, Wo)
+    if not no_bias and maybe_bias:
+        out = out + maybe_bias[0].reshape(1, -1, 1, 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Deformable PSROI pooling (reference deformable_psroi_pooling.cu —
+# the .cc CPU path is NOT_IMPLEMENTED upstream)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_DeformablePSROIPooling", num_outputs=2,
+          visible_outputs=1)
+def _deformable_psroi_pooling(data, rois, *maybe_trans, spatial_scale=1.0,
+                              output_dim=0, group_size=0, pooled_size=0,
+                              part_size=0, sample_per_part=1,
+                              trans_std=0.0, no_trans=False):
+    """Deformable position-sensitive ROI pooling
+    (https://arxiv.org/abs/1703.06211): each bin's sampling window is
+    shifted by a learned normalized offset, values come from
+    `sample_per_part`^2 bilinear taps.  Returns (out, top_count) like
+    the reference (count of in-bounds samples per bin; only `out` is
+    user-visible)."""
+    jnp = _jnp()
+    P = int(pooled_size)
+    G = int(group_size)
+    od = int(output_dim)
+    PS = int(part_size) or P
+    S = int(sample_per_part)
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    f32 = jnp.float32
+
+    bidx = jnp.clip(rois[:, 0].astype(jnp.int32), 0, N - 1)
+    x1 = jnp.round(rois[:, 1]).astype(f32) * spatial_scale - 0.5
+    y1 = jnp.round(rois[:, 2]).astype(f32) * spatial_scale - 0.5
+    x2 = (jnp.round(rois[:, 3]) + 1.0).astype(f32) * spatial_scale - 0.5
+    y2 = (jnp.round(rois[:, 4]) + 1.0).astype(f32) * spatial_scale - 0.5
+    roi_w = jnp.maximum(x2 - x1, 0.1)
+    roi_h = jnp.maximum(y2 - y1, 0.1)
+    bin_h = roi_h / P
+    bin_w = roi_w / P
+    sub_h = bin_h / S
+    sub_w = bin_w / S
+
+    if no_trans or not maybe_trans:
+        ncls = 1
+        tx = jnp.zeros((R, 1, P, P), f32)
+        ty = jnp.zeros((R, 1, P, P), f32)
+    else:
+        trans = maybe_trans[0]
+        ncls = trans.shape[1] // 2
+        part_h = np.minimum((np.arange(P) * PS) // P, PS - 1)
+        t = trans.reshape(R, ncls, 2, PS, PS)
+        tsel = t[:, :, :, part_h[:, None], part_h[None, :]]  # R,ncls,2,P,P
+        tx = tsel[:, :, 0] * trans_std
+        ty = tsel[:, :, 1] * trans_std
+
+    pgrid = jnp.arange(P, dtype=f32)
+    # window starts per (r, cls, p, q)
+    hstart = (pgrid[None, None, :, None] * bin_h[:, None, None, None] +
+              y1[:, None, None, None] + ty * roi_h[:, None, None, None])
+    wstart = (pgrid[None, None, None, :] * bin_w[:, None, None, None] +
+              x1[:, None, None, None] + tx * roi_w[:, None, None, None])
+    sgrid = jnp.arange(S, dtype=f32)
+    # sample positions (r, cls, p, q, sh, sw)
+    y = hstart[..., None, None] + \
+        sgrid[None, None, None, None, :, None] * \
+        sub_h[:, None, None, None, None, None]
+    x = wstart[..., None, None] + \
+        sgrid[None, None, None, None, None, :] * \
+        sub_w[:, None, None, None, None, None]
+    # y carries the sample index on axis -2, x on axis -1 — materialize
+    # the full (S, S) sample grid before gathering
+    y, x = jnp.broadcast_arrays(y, x)
+    inb = ((y >= -0.5) & (y <= H - 0.5) & (x >= -0.5) & (x <= W - 0.5))
+    yc = jnp.clip(y, 0.0, H - 1.0)
+    xc = jnp.clip(x, 0.0, W - 1.0)
+
+    # channel per (ctop, p, q); class per ctop
+    gh = np.minimum((np.arange(P) * G) // P, G - 1)
+    c_idx = ((np.arange(od)[:, None, None] * G + gh[None, :, None]) * G
+             + gh[None, None, :])                      # (od, P, P)
+    cls_of = np.arange(od) // max(od // ncls, 1)
+    cls_of = np.minimum(cls_of, ncls - 1)
+
+    # expand positions to ctop and flatten for one combined gather
+    yq = yc[:, cls_of]                                 # (R, od, P, P, S, S)
+    xq = xc[:, cls_of]
+    inbq = inb[:, cls_of]
+    chan = jnp.asarray(c_idx, jnp.int32)[None, :, :, :, None, None]
+    chan = jnp.broadcast_to(chan, yq.shape)
+    dflat = data.reshape(N, C * H * W)[bidx]           # (R, C*H*W)
+    shp = yq.shape
+    val = _bilinear_flat(dflat, W, H,
+                         yq.reshape(R, -1), xq.reshape(R, -1),
+                         chan=chan.reshape(R, -1)).reshape(shp)
+    val = val * inbq.astype(data.dtype)
+    cnt = inbq.astype(data.dtype).sum(axis=(-2, -1))   # (R, od, P, P)
+    out = val.sum(axis=(-2, -1)) / jnp.maximum(cnt, 1.0)
+    return out, cnt
+
+
+# ---------------------------------------------------------------------------
+# symbolic metadata (auto-created weight/bias variables + shape solving)
+# ---------------------------------------------------------------------------
+
+def _register_meta():
+    from ..symbol.op_meta import OpMeta, register_meta
+
+    def dc_inputs(attrs):
+        base = ["data", "offset", "weight"]
+        return base if attrs.get("no_bias", False) else base + ["bias"]
+
+    def dc_shapes(shapes, attrs):
+        data = shapes[0]
+        if data is None:
+            return {}
+        nf = int(attrs["num_filter"])
+        g = int(attrs.get("num_group", 1))
+        kernel = tuple(attrs["kernel"])
+        out = {2: (nf, data[1] // g) + kernel}
+        if not attrs.get("no_bias", False):
+            out[3] = (nf,)
+        return out
+
+    register_meta("_contrib_DeformableConvolution",
+                  OpMeta(dc_inputs, param_shapes=dc_shapes))
+    register_meta("_contrib_Proposal",
+                  OpMeta(["cls_prob", "bbox_pred", "im_info"]))
+    register_meta("_contrib_MultiProposal",
+                  OpMeta(["cls_prob", "bbox_pred", "im_info"]))
+    register_meta("_contrib_PSROIPooling", OpMeta(["data", "rois"]))
+    register_meta(
+        "_contrib_DeformablePSROIPooling",
+        OpMeta(lambda attrs: ["data", "rois"]
+               if attrs.get("no_trans", False)
+               else ["data", "rois", "trans"]))
+
+
+_register_meta()

@@ -197,6 +197,8 @@ def _subgraph_exec(key, *inputs, subgraph_json="", n_out=1, is_train=False):
     """
     import jax
 
+    from . import amp as _amp
+
     sym = _parse_subgraph(subgraph_json)
     names = sym.list_inputs()
     if len(names) != len(inputs):
@@ -205,11 +207,18 @@ def _subgraph_exec(key, *inputs, subgraph_json="", n_out=1, is_train=False):
     env: Dict[Tuple[int, int], Any] = {}
     by_name = dict(zip(names, inputs))
     rng_i = 0
+    compute_dtype = _amp.get_compute_dtype()
     for node in _topo_order(sym._outputs):
         if node.is_variable:
             env[(id(node), 0)] = by_name[node.name]
             continue
         invals = [env[(id(inode), idx)] for inode, idx in node.inputs]
+        if compute_dtype is not None:
+            # same per-op cast the outer executor applies
+            # (executor.py _build_graph_fn) — a wrapped region must not
+            # silently opt out of the AMP policy
+            invals = _amp.cast_op_inputs(node.op.name, invals,
+                                         compute_dtype)
         attrs = dict(node.attrs)
         if node.op.train_aware:
             attrs["is_train"] = is_train
@@ -225,6 +234,44 @@ def _subgraph_exec(key, *inputs, subgraph_json="", n_out=1, is_train=False):
             env[(id(node), i)] = o
     outs = tuple(env[(id(n), i)] for n, i in sym._outputs)
     return outs if len(outs) > 1 else outs[0]
+
+
+def _subgraph_input_names(attrs):
+    return _parse_subgraph(attrs["subgraph_json"]).list_inputs()
+
+
+def _subgraph_param_shapes(shapes, attrs):
+    """Backward shape solving THROUGH the packed subgraph: run the sub
+    symbol's own inference (which knows each inner op's param_shapes
+    hook) with whatever outer shapes are known, and surface the solved
+    variable shapes — so e.g. an auto-created fc weight inside a
+    wrapped region still binds (reference subgraph nodes delegate
+    FInferShape to the inner graph the same way)."""
+    from .symbol.symbol import _infer_graph
+
+    sub = _parse_subgraph(attrs["subgraph_json"])
+    names = sub.list_inputs()
+    known = {n: tuple(s) for n, s in zip(names, shapes) if s is not None}
+    try:
+        solved, _ = _infer_graph(sub, known, {}, partial=True)
+    except Exception:
+        return {}
+    out = {}
+    for i, n in enumerate(names):
+        if shapes[i] is None and solved.get(n) is not None:
+            out[i] = tuple(solved[n])
+    return out
+
+
+def _register_subgraph_meta():
+    from .symbol.op_meta import OpMeta, register_meta
+
+    register_meta("_subgraph_exec",
+                  OpMeta(_subgraph_input_names,
+                         param_shapes=_subgraph_param_shapes))
+
+
+_register_subgraph_meta()
 
 
 # ---------------------------------------------------------------------------

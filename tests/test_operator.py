@@ -97,6 +97,24 @@ SKIP = {
     "_cond": "control-flow: tests/test_control_flow.py",
     "Custom": "custom-op bridge: tests/test_custom_op.py",
     "RNN": "fused RNN: tests/test_gluon.py rnn layers + foreach RNN",
+    # RCNN family: numeric gold vs reference kernels in test_rcnn_dgl.py
+    "_contrib_Proposal": "rcnn: tests/test_rcnn_dgl.py (numpy gold)",
+    "_contrib_MultiProposal": "rcnn: tests/test_rcnn_dgl.py",
+    "_contrib_PSROIPooling": "rcnn: tests/test_rcnn_dgl.py (kernel gold)",
+    "_contrib_DeformablePSROIPooling": "rcnn: tests/test_rcnn_dgl.py",
+    "_contrib_DeformableConvolution": "rcnn: tests/test_rcnn_dgl.py",
+    "_contrib_SparseEmbedding":
+        "sparse-grad embedding: tests/test_rcnn_dgl.py",
+    # DGL graph ops: dense-adjacency contracts in test_rcnn_dgl.py
+    "_contrib_edge_id": "dgl: tests/test_rcnn_dgl.py",
+    "_contrib_dgl_adjacency": "dgl: tests/test_rcnn_dgl.py",
+    "_contrib_dgl_subgraph": "dgl: tests/test_rcnn_dgl.py",
+    "_contrib_dgl_csr_neighbor_uniform_sample":
+        "dgl: tests/test_rcnn_dgl.py",
+    "_contrib_dgl_csr_neighbor_non_uniform_sample":
+        "dgl: tests/test_rcnn_dgl.py",
+    "_contrib_dgl_graph_compact": "dgl: tests/test_rcnn_dgl.py",
+    "_subgraph_exec": "subgraph framework: tests/test_subgraph.py",
 }
 
 
@@ -1944,6 +1962,36 @@ def _():
             nd.array(mv), fix_gamma=False).asnumpy()
     assert_almost_equal(out, (x - mean) / np.sqrt(var + 1e-3),
                         rtol=1e-3, atol=1e-3)
+
+
+@case("_copyto")
+def _():
+    x = _a(2, 3)
+    op("_copyto", x, gold=x)
+
+
+@case("_scatter_elemwise_div")
+def _():
+    a = _a(3, 4)
+    b = _a(3, 4, lo=0.5, hi=2.0)
+    op("_scatter_elemwise_div", a, b, gold=a / b)
+
+
+@case("_cvimresize")
+def _():
+    img = _a(6, 6, 3, lo=0.0, hi=255.0)
+    out = op("_cvimresize", img, attrs={"w": 3, "h": 3})[0]
+    assert out.shape == (3, 3, 3)
+
+
+@case("_cvcopyMakeBorder")
+def _():
+    img = _a(4, 4, 3)
+    out = op("_cvcopyMakeBorder", img,
+             attrs={"top": 1, "bot": 2, "left": 3, "right": 0,
+                    "value": 7.0})[0]
+    assert out.shape == (7, 7, 3)
+    assert (out[0] == 7.0).all() and (out[:, :3] == 7.0).all()
 
 
 @case("_contrib_arange_like")

@@ -1,0 +1,489 @@
+"""RCNN-family + DGL contrib ops (`mxtpu/ops/rcnn.py`, `mxtpu/ops/dgl.py`).
+
+Numeric gold follows the reference kernels:
+proposal.cc (anchors/transform/NMS/fill), psroi_pooling.cc
+PSROIPoolForwardCPU, deformable_psroi_pooling.cu forward kernel,
+deformable_im2col.cuh sampling, dgl_graph.cc op contracts.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling vs a direct numpy transcription of the kernel contract
+# ---------------------------------------------------------------------------
+
+def _psroi_gold(data, rois, spatial_scale, output_dim, pooled_size,
+                group_size):
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    P, G = pooled_size, group_size
+    out = np.zeros((R, output_dim, P, P), np.float32)
+    for r in range(R):
+        b = int(rois[r, 0])
+        x1 = round(float(rois[r, 1])) * spatial_scale
+        y1 = round(float(rois[r, 2])) * spatial_scale
+        x2 = (round(float(rois[r, 3])) + 1.0) * spatial_scale
+        y2 = (round(float(rois[r, 4])) + 1.0) * spatial_scale
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        bh, bw = rh / P, rw / P
+        for ctop in range(output_dim):
+            for ph in range(P):
+                for pw in range(P):
+                    hs = min(max(int(np.floor(ph * bh + y1)), 0), H)
+                    he = min(max(int(np.ceil((ph + 1) * bh + y1)), 0), H)
+                    ws = min(max(int(np.floor(pw * bw + x1)), 0), W)
+                    we = min(max(int(np.ceil((pw + 1) * bw + x1)), 0), W)
+                    gh = min(max(ph * G // P, 0), G - 1)
+                    gw = min(max(pw * G // P, 0), G - 1)
+                    c = (ctop * G + gh) * G + gw
+                    if he <= hs or we <= ws:
+                        continue
+                    patch = data[b, c, hs:he, ws:we]
+                    out[r, ctop, ph, pw] = patch.sum() / patch.size
+    return out
+
+
+def test_psroi_pooling_matches_reference_kernel():
+    rng = np.random.RandomState(0)
+    od, G, P = 3, 2, 2
+    data = rng.uniform(-1, 1, (2, od * G * G, 9, 9)).astype(np.float32)
+    rois = np.array([[0, 1, 1, 6, 6],
+                     [1, 0, 2, 7, 5],
+                     [0, 3, 3, 3.4, 3.4]], np.float32)
+    got = _np(nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                      spatial_scale=1.0, output_dim=od,
+                                      pooled_size=P, group_size=G))
+    gold = _psroi_gold(data, rois, 1.0, od, P, G)
+    np.testing.assert_allclose(got, gold, rtol=1e-5, atol=1e-5)
+
+
+def test_psroi_pooling_spatial_scale_and_grad():
+    rng = np.random.RandomState(1)
+    od, G, P = 2, 3, 3
+    data = nd.array(rng.uniform(-1, 1, (1, od * G * G, 12, 12))
+                    .astype(np.float32))
+    rois = nd.array(np.array([[0, 2, 2, 20, 20]], np.float32))
+    data.attach_grad()
+    with mx.autograd.record():
+        out = nd.contrib.PSROIPooling(data, rois, spatial_scale=0.5,
+                                      output_dim=od, pooled_size=P,
+                                      group_size=G)
+        loss = out.sum()
+    loss.backward()
+    gold = _psroi_gold(_np(data), _np(rois), 0.5, od, P, P and G)
+    np.testing.assert_allclose(_np(out), gold, rtol=1e-5, atol=1e-5)
+    g = _np(data.grad)
+    assert np.abs(g).sum() > 0  # gradient flows into pooled cells
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(2)
+    x = nd.array(rng.uniform(-1, 1, (2, 4, 8, 8)).astype(np.float32))
+    w = nd.array(rng.uniform(-0.5, 0.5, (6, 4, 3, 3)).astype(np.float32))
+    b = nd.array(rng.uniform(-0.5, 0.5, (6,)).astype(np.float32))
+    off = nd.zeros((2, 2 * 9, 8, 8))
+    ref = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=6, pad=(1, 1))
+    got = nd.contrib.DeformableConvolution(x, off, w, b, kernel=(3, 3),
+                                           num_filter=6, pad=(1, 1))
+    np.testing.assert_allclose(_np(got), _np(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts_sampling():
+    """Constant integer offset (dy=0, dx=1) equals convolving the
+    x-shifted image (interior pixels)."""
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-1, 1, (1, 2, 7, 7)).astype(np.float32)
+    w = rng.uniform(-0.5, 0.5, (3, 2, 3, 3)).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 5, 5), np.float32)
+    off[:, 1::2] = 1.0  # dx = +1 for every tap
+    got = _np(nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+        num_filter=3, no_bias=True))
+    x_shift = np.zeros_like(x)
+    x_shift[..., :-1] = x[..., 1:]
+    ref = _np(nd.Convolution(nd.array(x_shift), nd.array(w),
+                             kernel=(3, 3), num_filter=3, no_bias=True))
+    # rightmost output column touches the zero-padded edge; compare rest
+    np.testing.assert_allclose(got[..., :-1], ref[..., :-1],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_groups_and_grad():
+    rng = np.random.RandomState(4)
+    x = nd.array(rng.uniform(-1, 1, (1, 4, 6, 6)).astype(np.float32))
+    w = nd.array(rng.uniform(-0.5, 0.5, (4, 2, 3, 3)).astype(np.float32))
+    off = nd.array(rng.uniform(-0.3, 0.3, (1, 2 * 2 * 9, 6, 6))
+                   .astype(np.float32))
+    for a in (x, w, off):
+        a.attach_grad()
+    with mx.autograd.record():
+        out = nd.contrib.DeformableConvolution(
+            x, off, w, kernel=(3, 3), num_filter=4, pad=(1, 1),
+            num_group=2, num_deformable_group=2, no_bias=True)
+        loss = (out * out).sum()
+    loss.backward()
+    assert out.shape == (1, 4, 6, 6)
+    for a in (x, w, off):
+        assert np.isfinite(_np(a.grad)).all()
+        assert np.abs(_np(a.grad)).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# DeformablePSROIPooling (deformable_psroi_pooling.cu kernel gold)
+# ---------------------------------------------------------------------------
+
+def _bilinear(img, h, w):
+    H, W = img.shape
+    h0, w0 = int(np.floor(h)), int(np.floor(w))
+    h1, w1 = min(h0 + 1, H - 1), min(w0 + 1, W - 1)
+    h0c, w0c = min(max(h0, 0), H - 1), min(max(w0, 0), W - 1)
+    lh, lw = h - h0, w - w0
+    return (img[h0c, w0c] * (1 - lh) * (1 - lw) +
+            img[h0c, w1] * (1 - lh) * lw +
+            img[h1, w0c] * lh * (1 - lw) + img[h1, w1] * lh * lw)
+
+
+def _dpsroi_gold(data, rois, trans, scale, od, G, P, PS, S, trans_std,
+                 no_trans):
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    ncls = 1 if no_trans else trans.shape[1] // 2
+    ceach = max(od // ncls, 1)
+    out = np.zeros((R, od, P, P), np.float32)
+    for r in range(R):
+        b = int(rois[r, 0])
+        x1 = round(float(rois[r, 1])) * scale - 0.5
+        y1 = round(float(rois[r, 2])) * scale - 0.5
+        x2 = (round(float(rois[r, 3])) + 1.0) * scale - 0.5
+        y2 = (round(float(rois[r, 4])) + 1.0) * scale - 0.5
+        rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+        bh, bw = rh / P, rw / P
+        sbh, sbw = bh / S, bw / S
+        for ctop in range(od):
+            cls = min(ctop // ceach, ncls - 1)
+            for ph in range(P):
+                for pw in range(P):
+                    part_h = min(int(np.floor(ph / P * PS)), PS - 1)
+                    part_w = min(int(np.floor(pw / P * PS)), PS - 1)
+                    tx = 0.0 if no_trans else \
+                        trans[r, cls * 2, part_h, part_w] * trans_std
+                    ty = 0.0 if no_trans else \
+                        trans[r, cls * 2 + 1, part_h, part_w] * trans_std
+                    ws = pw * bw + x1 + tx * rw
+                    hs = ph * bh + y1 + ty * rh
+                    gh = min(max(ph * G // P, 0), G - 1)
+                    gw = min(max(pw * G // P, 0), G - 1)
+                    c = (ctop * G + gh) * G + gw
+                    s, cnt = 0.0, 0
+                    for ih in range(S):
+                        for iw in range(S):
+                            w_ = ws + iw * sbw
+                            h_ = hs + ih * sbh
+                            if w_ < -0.5 or w_ > W - 0.5 or h_ < -0.5 \
+                                    or h_ > H - 0.5:
+                                continue
+                            w_ = min(max(w_, 0.0), W - 1.0)
+                            h_ = min(max(h_, 0.0), H - 1.0)
+                            s += _bilinear(data[b, c], h_, w_)
+                            cnt += 1
+                    out[r, ctop, ph, pw] = 0.0 if cnt == 0 else s / cnt
+    return out
+
+
+@pytest.mark.parametrize("no_trans", [True, False])
+def test_deformable_psroi_pooling_matches_kernel(no_trans):
+    rng = np.random.RandomState(5)
+    od, G, P, PS, S = 2, 2, 2, 2, 2
+    data = rng.uniform(-1, 1, (1, od * G * G, 10, 10)).astype(np.float32)
+    rois = np.array([[0, 1, 1, 7, 7], [0, 0, 3, 5, 8]], np.float32)
+    trans = rng.uniform(-1, 1, (2, 2, PS, PS)).astype(np.float32)
+    args = [nd.array(data), nd.array(rois)]
+    if not no_trans:
+        args.append(nd.array(trans))
+    got = nd.contrib.DeformablePSROIPooling(
+        *args, spatial_scale=0.5, output_dim=od, group_size=G,
+        pooled_size=P, part_size=PS, sample_per_part=S, trans_std=0.2,
+        no_trans=no_trans)
+    gold = _dpsroi_gold(data, rois, trans, 0.5, od, G, P, PS, S, 0.2,
+                        no_trans)
+    np.testing.assert_allclose(_np(got), gold, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_psroi_trans_gradient_flows():
+    rng = np.random.RandomState(6)
+    od, G, P = 2, 2, 2
+    data = nd.array(rng.uniform(-1, 1, (1, od * G * G, 8, 8))
+                    .astype(np.float32))
+    rois = nd.array(np.array([[0, 0, 0, 7, 7]], np.float32))
+    trans = nd.array(rng.uniform(-0.5, 0.5, (1, 2, P, P))
+                     .astype(np.float32))
+    trans.attach_grad()
+    data.attach_grad()
+    with mx.autograd.record():
+        out = nd.contrib.DeformablePSROIPooling(
+            data, rois, trans, spatial_scale=1.0, output_dim=od,
+            group_size=G, pooled_size=P, part_size=P, sample_per_part=2,
+            trans_std=0.3, no_trans=False)
+        out.sum().backward()
+    assert np.abs(_np(trans.grad)).sum() > 0
+    assert np.abs(_np(data.grad)).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# Proposal / MultiProposal (numpy gold of proposal.cc)
+# ---------------------------------------------------------------------------
+
+def _anchors_gold(fs, scales, ratios):
+    out = []
+    size = fs * fs
+    ctr = 0.5 * (fs - 1.0)
+    for r in ratios:
+        base = np.floor(np.sqrt(np.floor(size / r)) + 0.5)
+        for s in scales:
+            w, h = base * s, np.floor(base * r + 0.5) * s
+            out.append([ctr - 0.5 * (w - 1), ctr - 0.5 * (h - 1),
+                        ctr + 0.5 * (w - 1), ctr + 0.5 * (h - 1)])
+    return np.array(out, np.float32)
+
+
+def _proposal_gold(cls_prob, bbox_pred, im_info, fs, scales, ratios,
+                   pre_n, post_n, thresh, min_size):
+    A = len(scales) * len(ratios)
+    _, _, H, W = cls_prob.shape
+    anchors = _anchors_gold(fs, scales, ratios)
+    boxes, deltas, scores = [], [], []
+    for h in range(H):
+        for w in range(W):
+            for a in range(A):
+                boxes.append(anchors[a] + np.array([w * fs, h * fs,
+                                                    w * fs, h * fs]))
+                deltas.append(bbox_pred[0, a * 4:a * 4 + 4, h, w])
+                scores.append(cls_prob[0, A + a, h, w])
+    boxes = np.array(boxes)
+    deltas = np.array(deltas)
+    scores = np.array(scores, np.float32)
+    im_h, im_w, im_scale = im_info[0]
+    width = boxes[:, 2] - boxes[:, 0] + 1
+    height = boxes[:, 3] - boxes[:, 1] + 1
+    cx = boxes[:, 0] + 0.5 * (width - 1)
+    cy = boxes[:, 1] + 0.5 * (height - 1)
+    pcx = deltas[:, 0] * width + cx
+    pcy = deltas[:, 1] * height + cy
+    pw = np.exp(deltas[:, 2]) * width
+    ph = np.exp(deltas[:, 3]) * height
+    p = np.stack([pcx - 0.5 * (pw - 1), pcy - 0.5 * (ph - 1),
+                  pcx + 0.5 * (pw - 1), pcy + 0.5 * (ph - 1)], 1)
+    p[:, 0::2] = np.clip(p[:, 0::2], 0, im_w - 1)
+    p[:, 1::2] = np.clip(p[:, 1::2], 0, im_h - 1)
+    real_h, real_w = int(np.ceil(im_h / fs)), int(np.ceil(im_w / fs))
+    k = 0
+    for h in range(H):
+        for w in range(W):
+            for a in range(A):
+                if h >= real_h or w >= real_w:
+                    scores[k] = -1
+                k += 1
+    ms = min_size * im_scale
+    iw = p[:, 2] - p[:, 0] + 1
+    ih = p[:, 3] - p[:, 1] + 1
+    small = (iw < ms) | (ih < ms)
+    p[small, 0] -= ms / 2
+    p[small, 1] -= ms / 2
+    p[small, 2] += ms / 2
+    p[small, 3] += ms / 2
+    scores[small] = -1
+    n_pre = min(pre_n, len(scores))
+    order = np.argsort(-scores, kind="stable")[:n_pre]
+    p, scores = p[order], scores[order]
+    area = (p[:, 2] - p[:, 0] + 1) * (p[:, 3] - p[:, 1] + 1)
+    suppressed = np.zeros(n_pre, bool)
+    for i in range(n_pre):
+        if suppressed[i]:
+            continue
+        for j in range(i + 1, n_pre):
+            xx1 = max(p[i, 0], p[j, 0])
+            yy1 = max(p[i, 1], p[j, 1])
+            xx2 = min(p[i, 2], p[j, 2])
+            yy2 = min(p[i, 3], p[j, 3])
+            inter = max(xx2 - xx1 + 1, 0) * max(yy2 - yy1 + 1, 0)
+            if inter / (area[i] + area[j] - inter) > thresh:
+                suppressed[j] = True
+    keep = np.flatnonzero(~suppressed)
+    rois = np.zeros((post_n, 5), np.float32)
+    out_scores = np.zeros((post_n, 1), np.float32)
+    for i in range(post_n):
+        idx = keep[i] if i < len(keep) else keep[i % len(keep)]
+        rois[i, 1:] = p[idx]
+        out_scores[i, 0] = scores[idx]
+    return rois, out_scores
+
+
+def test_proposal_matches_gold():
+    rng = np.random.RandomState(7)
+    A = 2 * 2
+    H = W = 4
+    scales, ratios, fs = (8, 16), (0.5, 1.0), 16
+    cls_prob = rng.uniform(0, 1, (1, 2 * A, H, W)).astype(np.float32)
+    bbox_pred = (rng.uniform(-0.3, 0.3, (1, 4 * A, H, W))
+                 .astype(np.float32))
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    kw = dict(rpn_pre_nms_top_n=12, rpn_post_nms_top_n=6, threshold=0.7,
+              rpn_min_size=4, scales=scales, ratios=ratios,
+              feature_stride=fs)
+    rois, score = nd.contrib.Proposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        output_score=True, **kw)
+    g_rois, g_score = _proposal_gold(cls_prob, bbox_pred, im_info, fs,
+                                     scales, ratios, 12, 6, 0.7, 4)
+    np.testing.assert_allclose(_np(rois), g_rois, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(_np(score), g_score, rtol=1e-4, atol=1e-4)
+
+
+def test_multi_proposal_is_batched_proposal():
+    rng = np.random.RandomState(8)
+    A = 3  # 3 ratios x 1 scale
+    H = W = 3
+    kw = dict(rpn_pre_nms_top_n=10, rpn_post_nms_top_n=4, threshold=0.6,
+              rpn_min_size=2, scales=(8,), ratios=(0.5, 1.0, 2.0),
+              feature_stride=16)
+    cls = rng.uniform(0, 1, (2, 2 * A, H, W)).astype(np.float32)
+    bbox = rng.uniform(-0.2, 0.2, (2, 4 * A, H, W)).astype(np.float32)
+    info = np.array([[48, 48, 1.0], [40, 44, 2.0]], np.float32)
+    multi = _np(nd.contrib.MultiProposal(
+        nd.array(cls), nd.array(bbox), nd.array(info), **kw))
+    assert multi.shape == (8, 5)
+    for b in range(2):
+        single = _np(nd.contrib.Proposal(
+            nd.array(cls[b:b + 1]), nd.array(bbox[b:b + 1]),
+            nd.array(info[b:b + 1]), **kw))
+        part = multi[b * 4:(b + 1) * 4]
+        assert (part[:, 0] == b).all()
+        np.testing.assert_allclose(part[:, 1:], single[:, 1:],
+                                   rtol=1e-4, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# DGL graph ops
+# ---------------------------------------------------------------------------
+
+def _toy_graph():
+    # 6 vertices; adjacency holds edge_id + 1
+    V = 6
+    A = np.zeros((V, V), np.float32)
+    edges = [(0, 1), (0, 2), (1, 3), (2, 3), (3, 4), (4, 5), (5, 0)]
+    for eid, (u, v) in enumerate(edges):
+        A[u, v] = eid + 1
+    return A, edges
+
+
+def test_edge_id_and_adjacency():
+    A, edges = _toy_graph()
+    u = nd.array(np.array([0, 1, 2, 0], np.float32))
+    v = nd.array(np.array([1, 3, 0, 5], np.float32))
+    eid = _np(nd.contrib.edge_id(nd.array(A), u, v))
+    np.testing.assert_allclose(eid, [0, 2, -1, -1])
+    adj = _np(nd.contrib.dgl_adjacency(nd.array(A)))
+    np.testing.assert_allclose(adj, (A != 0).astype(np.float32))
+
+
+def test_dgl_subgraph_induced():
+    A, _ = _toy_graph()
+    vids = nd.array(np.array([0, 1, 3, -1], np.float32))
+    sub, mapping = nd.contrib.dgl_subgraph(
+        nd.array(A), vids, num_args=2, return_mapping=True)
+    sub, mapping = _np(sub), _np(mapping)
+    # edges among {0,1,3}: 0->1 (eid 0), 1->3 (eid 2)
+    expect = np.zeros((4, 4), np.float32)
+    expect[0, 1] = 1
+    expect[1, 2] = 1
+    np.testing.assert_allclose(sub, expect)
+    assert mapping[0, 1] == 1 and mapping[1, 2] == 3  # eid + 1
+    assert (mapping[3, :] == 0).all() and (mapping[:, 3] == 0).all()
+
+
+def test_dgl_neighbor_uniform_sample():
+    A, _ = _toy_graph()
+    mx.random.seed(11)
+    seeds = nd.array(np.array([0, -1], np.float32))
+    verts, sub, layer = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        nd.array(A), seeds, num_args=2, num_hops=1, num_neighbor=1,
+        max_num_vertices=4)
+    verts, sub, layer = _np(verts), _np(sub), _np(layer)
+    assert verts.shape == (4,) and sub.shape == (4, 4)
+    assert verts[0] == 0 and layer[0] == 0          # seed first, hop 0
+    picked = verts[verts >= 0]
+    assert len(picked) == 2                          # seed + 1 neighbor
+    assert picked[1] in (1, 2) and layer[1] == 1     # a real out-neighbor
+    # subgraph is induced on the sampled vertex set
+    u, v = 0, int(picked[1])
+    row = {1: 0, 2: 1}[v]  # eid of 0->1 is 0, of 0->2 is 1
+    assert sub[0, 1] == A[u, v]
+
+
+def test_dgl_neighbor_non_uniform_prefers_heavy_vertex():
+    A, _ = _toy_graph()
+    prob = np.array([1, 0.001, 1, 1, 1, 1], np.float32)  # avoid vertex 1
+    mx.random.seed(3)
+    hits = []
+    for _ in range(8):
+        verts, sub, layer, pv = \
+            nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+                nd.array(A), nd.array(prob),
+                nd.array(np.array([0], np.float32)),
+                num_args=3, num_hops=1, num_neighbor=1,
+                max_num_vertices=3)
+        v = _np(verts)
+        hits.append(int(v[1]))
+    assert all(h in (1, 2) for h in hits)
+    assert hits.count(2) >= 6  # overwhelmingly the heavy vertex
+
+
+def test_dgl_graph_compact():
+    A, _ = _toy_graph()
+    out, mapping = nd.contrib.dgl_graph_compact(
+        nd.array(A), num_args=2, return_mapping=True, graph_sizes=(4,))
+    out, mapping = _np(out), _np(mapping)
+    assert (out[4:, :] == 0).all() and (out[:, 4:] == 0).all()
+    assert out[0, 1] == 1.0 and mapping[2, 3] == A[2, 3]
+
+
+# ---------------------------------------------------------------------------
+# SparseEmbedding
+# ---------------------------------------------------------------------------
+
+def test_sparse_embedding_forward_and_rowsparse_grad():
+    rng = np.random.RandomState(9)
+    vocab, dim = 20, 4
+    W = rng.uniform(-1, 1, (vocab, dim)).astype(np.float32)
+    ids = np.array([[3, 7], [3, 15]], np.float32)
+    w = nd.array(W)
+    grad_buf = mx.nd.sparse.zeros("row_sparse", (vocab, dim))
+    mx.autograd.mark_variables([w], [grad_buf])
+    with mx.autograd.record():
+        out = nd.contrib.SparseEmbedding(nd.array(ids), w,
+                                         input_dim=vocab, output_dim=dim)
+        (out * 2.0).sum().backward()
+    np.testing.assert_allclose(_np(out), W[ids.astype(int)], rtol=1e-6)
+    g = w.grad
+    from mxtpu.ndarray.sparse import RowSparseNDArray
+
+    assert isinstance(g, RowSparseNDArray)
+    dense = _np(g.tostype("default"))
+    expect = np.zeros_like(W)
+    expect[3] = 4.0
+    expect[7] = 2.0
+    expect[15] = 2.0
+    np.testing.assert_allclose(dense, expect, rtol=1e-5)

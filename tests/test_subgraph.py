@@ -250,6 +250,41 @@ def test_backend_registry_and_bind_hook(monkeypatch):
         n.op.name for n in exe2._symbol._topo() if not n.is_variable]
 
 
+def test_wrapped_region_honors_amp_policy():
+    """A wrapped region must apply the same per-op AMP casts the outer
+    executor does (regression: _subgraph_exec skipped amp.cast_op_inputs,
+    silently running wrapped matmuls in fp32)."""
+
+    class WrapFC(SubgraphProperty):
+        class _S(SubgraphSelector):
+            def select(self, node):
+                return node.op.name == "FullyConnected"
+
+        def create_selector(self):
+            return self._S()
+
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=5, name="fcA")
+    psym = partition_with_property(out, WrapFC())
+    assert "_subgraph_exec" in [n.op.name for n in psym._topo()
+                                if not n.is_variable]
+    x = np.random.RandomState(7).uniform(-1, 1, (4, 8)).astype(np.float32)
+
+    def run(s):
+        with mx.amp.scope("bfloat16"):
+            exe = s.simple_bind(ctx=mx.cpu(), grad_req="null", data=(4, 8))
+            for k, v in exe.arg_dict.items():
+                if k != "data":
+                    v._set_jax(mx.nd.array(
+                        np.random.RandomState(8).uniform(-1, 1, v.shape)
+                        .astype(np.float32))._data)
+            return exe.forward(data=mx.nd.array(x))[0].asnumpy()
+
+    ref = run(out)       # unwrapped graph under bf16 policy
+    got = run(psym)      # wrapped region must see the same casts
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
 def test_quantization_rides_the_framework():
     """quantize_symbol routes through partition_with_property."""
     from mxtpu.contrib.quantization import quantize_symbol
