@@ -1,4 +1,8 @@
 """Contrib: experimental / interchange subsystems (reference
-`python/mxnet/contrib/`): INT8 quantization calibration + ONNX."""
+`python/mxnet/contrib/`): INT8 quantization calibration, ONNX
+interchange, text embeddings, SVRG optimization, TensorBoard logging."""
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
+from . import text  # noqa: F401
+from . import svrg_optimization  # noqa: F401
+from . import tensorboard  # noqa: F401

@@ -1,0 +1,229 @@
+"""SVRG training module (reference
+`python/mxnet/contrib/svrg_optimization/svrg_module.py`).
+
+Stochastic Variance-Reduced Gradient (Johnson & Zhang, NeurIPS 2013):
+every ``update_freq`` epochs a snapshot of the weights w~ is taken and
+the FULL dataset gradient mu = (1/N) sum_i grad f_i(w~) is computed;
+each minibatch step then descends along
+
+    g_svrg = grad f_B(w) - grad f_B(w~) + mu
+
+whose variance shrinks as w approaches w~, letting plain SGD use a
+constant learning rate.
+
+The reference maintains a shadow C++ module and splices a special
+kvstore optimizer; here the snapshot is a second `Module` sharing the
+same Symbol (each is ONE fused XLA step — forward+backward of batch B
+at w and at w~ are two compiled calls), and the variance-reduced
+gradient is assembled on-device before the normal optimizer update.
+"""
+from __future__ import annotations
+
+import logging
+
+from ...base import MXNetError
+from ...module.module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    """Module with SVRG gradient correction.
+
+    Parameters match `Module`, plus ``update_freq``: the number of
+    epochs between full-gradient snapshots (reference SVRGModule).
+    """
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None, update_freq=None):
+        super(SVRGModule, self).__init__(
+            symbol, data_names=data_names, label_names=label_names,
+            logger=logger, context=context,
+            work_load_list=work_load_list,
+            fixed_param_names=fixed_param_names, state_names=state_names,
+            group2ctxs=group2ctxs, compression_params=compression_params)
+        if not isinstance(update_freq, int) or update_freq < 1:
+            raise MXNetError("update_freq must be a positive int (epochs "
+                             "between full-gradient snapshots)")
+        self.update_freq = update_freq
+        # shadow module evaluating gradients at the snapshot weights w~
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, logger=logger,
+                               context=context, group2ctxs=group2ctxs)
+        self._param_dict = None   # mu: full gradient at w~, per param
+
+    # -- lifecycle --------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        super(SVRGModule, self).bind(
+            data_shapes, label_shapes, for_training, inputs_need_grad,
+            force_rebind, shared_module, grad_req)
+        if for_training:
+            self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                               inputs_need_grad, force_rebind,
+                               shared_module, grad_req)
+
+    def init_params(self, *args, **kwargs):
+        super(SVRGModule, self).init_params(*args, **kwargs)
+        if self._mod_aux.binded:
+            arg, aux = self.get_params()
+            self._mod_aux.set_params(arg, aux, allow_missing=False,
+                                     allow_extra=True)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        super(SVRGModule, self).reshape(data_shapes, label_shapes)
+        if self._mod_aux.binded:
+            self._mod_aux.reshape(data_shapes, label_shapes)
+
+    # -- per-batch path ---------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        super(SVRGModule, self).forward(data_batch, is_train)
+        if is_train and self._mod_aux.binded:
+            self._mod_aux.forward(data_batch, is_train=True)
+
+    def backward(self, out_grads=None):
+        super(SVRGModule, self).backward(out_grads)
+        if self._mod_aux.binded:
+            self._mod_aux.backward(out_grads)
+
+    def update(self):
+        if self._param_dict is not None:
+            self._update_svrg_gradients()
+        super(SVRGModule, self).update()
+
+    def _update_svrg_gradients(self):
+        """grad <- grad(w) - grad(w~) + mu, in place on the main
+        module's gradient buffers (reference
+        _svrg_grads_update_rule)."""
+        eg = self._exec_group
+        ag = self._mod_aux._exec_group
+        for name, grads, aux_grads in zip(eg.param_names, eg.grad_arrays,
+                                          ag.grad_arrays):
+            mu = self._param_dict.get(name)
+            if mu is None:
+                continue
+            ndev = sum(1 for g in grads if g is not None)
+            for g, ga in zip(grads, aux_grads):
+                if g is None or ga is None:
+                    continue
+                # mu is split across devices: per-device grads are SUMMED
+                # by the update path, and mu must appear exactly once in
+                # the aggregate
+                g._set_jax(g._data - ga._data + mu._data / ndev)
+
+    # -- snapshot ---------------------------------------------------------
+    def update_full_grads(self, train_data):
+        """Take the snapshot: copy w -> w~ and accumulate the mean full
+        gradient mu over `train_data` (reference update_full_grads)."""
+        if not self._mod_aux.binded:
+            raise MXNetError("bind(for_training=True) first")
+        arg, aux = self.get_params()
+        self._mod_aux.set_params(arg, aux, allow_missing=False,
+                                 allow_extra=True)
+        accum = {}
+        nbatch = 0
+        train_data.reset()
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            ag = self._mod_aux._exec_group
+            for name, grads in zip(ag.param_names, ag.grad_arrays):
+                g = grads[0]
+                if g is None:
+                    continue
+                total = g._data
+                for extra in grads[1:]:
+                    if extra is not None:
+                        total = total + extra._data
+                if name in accum:
+                    accum[name] = accum[name] + total
+                else:
+                    accum[name] = total
+            nbatch += 1
+        if nbatch == 0:
+            raise MXNetError("update_full_grads: empty data iterator")
+        from ...ndarray.ndarray import NDArray
+
+        self._param_dict = {
+            name: NDArray(total / float(nbatch), _committed=True)
+            for name, total in accum.items()}
+
+    # -- training loop ----------------------------------------------------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None):
+        """Module.fit with a full-gradient snapshot every
+        ``update_freq`` epochs (reference SVRGModule.fit)."""
+        from ... import metric as metric_mod
+        from ...initializer import Uniform
+
+        if num_epoch is None:
+            raise MXNetError("num_epoch is required for fit()")
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        if monitor is not None:
+            self.install_monitor(monitor)
+        self.init_params(initializer=initializer or Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        for epoch in range(begin_epoch, num_epoch):
+            if (epoch - begin_epoch) % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward(batch, is_train=True)
+                self.update_metric(eval_metric, batch.label)
+                self.backward()
+                self.update()
+                if batch_end_callback is not None:
+                    from ...model import BatchEndParam
+
+                    cbs = batch_end_callback \
+                        if isinstance(batch_end_callback, (list, tuple)) \
+                        else [batch_end_callback]
+                    param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                          eval_metric=eval_metric,
+                                          locals=locals())
+                    for cb in cbs:
+                        cb(param)
+            self.logger.info("Epoch[%d] Train-%s=%f", epoch,
+                             *eval_metric.get())
+            if epoch_end_callback is not None:
+                arg, auxp = self.get_params()
+                cbs = epoch_end_callback \
+                    if isinstance(epoch_end_callback, (list, tuple)) \
+                    else [epoch_end_callback]
+                for cb in cbs:
+                    cb(epoch, self.symbol, arg, auxp)
+            if eval_data is not None:
+                vm = validation_metric or eval_metric
+                res = self.score(eval_data, vm,
+                                 score_end_callback=eval_end_callback,
+                                 batch_end_callback=eval_batch_end_callback,
+                                 epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                     name, val)
+
+    def prepare(self, data_batch, sparse_row_id_fn=None):
+        super(SVRGModule, self).prepare(data_batch, sparse_row_id_fn)
+        if self._mod_aux.binded:
+            self._mod_aux.prepare(data_batch, sparse_row_id_fn)

@@ -1,0 +1,159 @@
+"""TensorBoard metric logging (reference
+`python/mxnet/contrib/tensorboard.py`).
+
+The reference's `LogMetricsCallback` delegates to the external
+``tensorboard`` package's SummaryWriter.  This build has no external
+dependency: `SummaryWriter` below writes genuine TensorBoard event
+files (TFRecord framing with masked CRC32C + hand-encoded
+``tensorflow.Event`` protos for scalar summaries), so the output
+directory loads in stock TensorBoard.  Only scalars are supported —
+exactly what the reference callback emits.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+__all__ = ["SummaryWriter", "LogMetricsCallback"]
+
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli, reflected poly 0x82F63B78) — required by the
+# TFRecord framing; table-based, pure python.
+# ---------------------------------------------------------------------------
+
+def _crc32c_table():
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ 0x82F63B78 if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+_TABLE = _crc32c_table()
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Minimal protobuf wire encoding for tensorflow.Event scalar summaries
+# ---------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _len_delim(field: int, payload: bytes) -> bytes:
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _scalar_summary(tag: str, value: float) -> bytes:
+    # Summary.Value: tag (field 1, string) + simple_value (field 2, float)
+    val = _len_delim(1, tag.encode("utf8")) + \
+        _varint((2 << 3) | 5) + struct.pack("<f", value)
+    # Summary: repeated value (field 1, message)
+    return _len_delim(1, val)
+
+
+def _event(wall_time: float, step: int, *, file_version: str = None,
+           summary: bytes = None) -> bytes:
+    out = _varint((1 << 3) | 1) + struct.pack("<d", wall_time)
+    out += _varint((2 << 3) | 0) + _varint(step & 0xFFFFFFFFFFFFFFFF)
+    if file_version is not None:
+        out += _len_delim(3, file_version.encode("utf8"))
+    if summary is not None:
+        out += _len_delim(5, summary)
+    return out
+
+
+class SummaryWriter(object):
+    """Scalar-only TensorBoard event writer (stand-in for the external
+    package's SummaryWriter; event files load in stock TensorBoard)."""
+
+    _instance_counter = 0
+
+    def __init__(self, logdir: str):
+        os.makedirs(logdir, exist_ok=True)
+        # pid + per-process counter keep concurrent writers on one
+        # logdir from truncating each other (the reference appends
+        # hostname + pid the same way)
+        SummaryWriter._instance_counter += 1
+        fname = "events.out.tfevents.%d.%d.%d.mxtpu" % (
+            int(time.time()), os.getpid(), SummaryWriter._instance_counter)
+        self._path = os.path.join(logdir, fname)
+        self._f = open(self._path, "wb")
+        self._write_record(_event(time.time(), 0,
+                                  file_version="brain.Event:2"))
+
+    def _write_record(self, data: bytes):
+        header = struct.pack("<Q", len(data))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(data)
+        self._f.write(struct.pack("<I", _masked_crc(data)))
+
+    def add_scalar(self, tag: str, value: float, global_step: int = 0):
+        self._write_record(_event(time.time(), int(global_step),
+                                  summary=_scalar_summary(tag,
+                                                          float(value))))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class LogMetricsCallback(object):
+    """Batch-end callback streaming metric values to TensorBoard
+    (reference contrib.tensorboard.LogMetricsCallback).
+
+    ::
+
+        tb = mx.contrib.tensorboard.LogMetricsCallback('logs/train')
+        mod.fit(train_iter, num_epoch=2, batch_end_callback=tb)
+    """
+
+    def __init__(self, logging_dir: str, prefix: str = None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)
+        self.summary_writer.flush()

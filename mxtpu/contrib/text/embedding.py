@@ -1,0 +1,246 @@
+"""Pretrained token embeddings (reference
+`python/mxnet/contrib/text/embedding.py`).
+
+A `_TokenEmbedding` is a Vocabulary plus an (N, dim) vector table held
+as an `mxtpu` NDArray.  The reference downloads GloVe/fastText files on
+demand; this build runs with zero egress, so the named formats load
+from a local ``embedding_root`` directory (same file names the
+reference would download, e.g. ``glove.6B.50d.txt``) and raise a clear
+error when the file is absent.  `CustomEmbedding` loads any
+word-per-line text file; `CompositeEmbedding` concatenates several
+tables over one vocabulary.
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...ndarray.ndarray import NDArray, array as nd_array
+from . import vocab as _vocab
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "GloVe", "FastText", "CustomEmbedding", "CompositeEmbedding"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(embedding_cls):
+    """Register a `_TokenEmbedding` subclass under its lowercase class
+    name (reference embedding.register)."""
+    _REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding by name (reference
+    embedding.create)."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise KeyError("unknown embedding %r (registered: %s)"
+                       % (embedding_name, sorted(_REGISTRY)))
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names per embedding (reference
+    get_pretrained_file_names)."""
+    if embedding_name is not None:
+        cls = _REGISTRY[embedding_name.lower()]
+        return list(cls.pretrained_file_names)
+    return {n: list(c.pretrained_file_names)
+            for n, c in _REGISTRY.items()}
+
+
+class _TokenEmbedding(_vocab.Vocabulary):
+    """Vocabulary + vector table.  Subclasses set the pretrained file
+    inventory; loading parses ``token<delim>v1<delim>...vD`` lines."""
+
+    pretrained_file_names: tuple = ()
+
+    def __init__(self, **kwargs):
+        super(_TokenEmbedding, self).__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec: Optional[NDArray] = None
+
+    # -- loading ----------------------------------------------------------
+    def _load_embedding(self, path, elem_delim, init_unknown_vec,
+                        encoding="utf8"):
+        if not os.path.isfile(path):
+            raise OSError(
+                "pretrained embedding file %r not found. This build has "
+                "no network egress: place the file there manually (the "
+                "reference would download it)" % path)
+        loaded: Dict[str, np.ndarray] = {}
+        vec_len = None
+        with io.open(path, "r", encoding=encoding) as f:
+            for lineno, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) <= 2:
+                    # fastText-style header "N dim" (or malformed line)
+                    if lineno == 0:
+                        continue
+                    logging.getLogger(__name__).warning(
+                        "skipping malformed line %d of %s", lineno, path)
+                    continue
+                token, elems = parts[0], parts[1:]
+                if vec_len is None:
+                    vec_len = len(elems)
+                elif len(elems) != vec_len:
+                    logging.getLogger(__name__).warning(
+                        "line %d of %s has %d elems (expected %d) — "
+                        "skipped", lineno, path, len(elems), vec_len)
+                    continue
+                if token in loaded:
+                    continue  # first occurrence wins (reference)
+                if token not in self._token_to_idx:
+                    self._token_to_idx[token] = len(self._idx_to_token)
+                    self._idx_to_token.append(token)
+                loaded[token] = np.asarray(elems, np.float32)
+        if vec_len is None:
+            raise ValueError("no vectors found in %r" % path)
+        self._vec_len = vec_len
+        # fill by token so pre-indexed tokens (a Vocabulary counter, the
+        # unknown token appearing in the file) get their file vectors too
+        table = np.zeros((len(self._idx_to_token), vec_len), np.float32)
+        for token, vec in loaded.items():
+            table[self._token_to_idx[token]] = vec
+        table[0] = loaded.get(self._unknown_token,
+                              init_unknown_vec(vec_len))
+        self._idx_to_vec = nd_array(table)
+
+    # -- queries ----------------------------------------------------------
+    @property
+    def vec_len(self) -> int:
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self) -> Optional[NDArray]:
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s); unknown tokens get the unknown vector
+        (optionally retrying lower-cased)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idxs = []
+        for t in toks:
+            i = self._token_to_idx.get(t, 0)
+            if i == 0 and lower_case_backup:
+                i = self._token_to_idx.get(t.lower(), 0)
+            idxs.append(i)
+        table = self._idx_to_vec.asnumpy()
+        out = table[np.asarray(idxs, np.int64)]
+        return nd_array(out[0] if single else out)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors for known tokens (reference
+        update_token_vectors; unknown tokens raise)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        vecs = new_vectors.asnumpy() if isinstance(new_vectors, NDArray) \
+            else np.asarray(new_vectors, np.float32)
+        if single or vecs.ndim == 1:
+            vecs = vecs.reshape(1, -1)
+        table = np.array(self._idx_to_vec.asnumpy())  # asnumpy is read-only
+        for t, v in zip(toks, vecs):
+            if t not in self._token_to_idx:
+                raise ValueError("token %r is not in the embedding "
+                                 "vocabulary" % t)
+            table[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd_array(table)
+
+    # -- vocabulary-restricted build (reference
+    #    _build_embedding_for_vocabulary) ---------------------------------
+    @classmethod
+    def _from_vocabulary(cls, vocabulary, source):
+        emb = _TokenEmbedding.__new__(_TokenEmbedding)
+        _vocab.Vocabulary.__init__(
+            emb, unknown_token=vocabulary.unknown_token,
+            reserved_tokens=vocabulary.reserved_tokens)
+        emb._idx_to_token = list(vocabulary.idx_to_token)
+        emb._token_to_idx = dict(vocabulary.token_to_idx)
+        emb._vec_len = source.vec_len
+        src_table = source.idx_to_vec.asnumpy()
+        rows = np.asarray([source.token_to_idx.get(t, 0)
+                           for t in emb._idx_to_token], np.int64)
+        emb._idx_to_vec = nd_array(src_table[rows])
+        return emb
+
+
+def _default_embedding_root():
+    return os.environ.get(
+        "MXTPU_EMBEDDING_ROOT",
+        os.path.join(os.path.expanduser("~"), ".mxtpu", "embedding"))
+
+
+@register
+class GloVe(_TokenEmbedding):
+    """GloVe vectors (reference contrib.text.embedding.GloVe); loads
+    ``<embedding_root>/glove/<pretrained_file_name>``."""
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=None, init_unknown_vec=np.zeros, **kwargs):
+        super(GloVe, self).__init__(**kwargs)
+        root = embedding_root or _default_embedding_root()
+        self._load_embedding(
+            os.path.join(root, "glove", pretrained_file_name), " ",
+            init_unknown_vec)
+
+
+@register
+class FastText(_TokenEmbedding):
+    """fastText vectors (reference contrib.text.embedding.FastText);
+    loads ``<embedding_root>/fasttext/<pretrained_file_name>``."""
+
+    pretrained_file_names = (
+        "wiki.en.vec", "wiki.simple.vec", "wiki.zh.vec", "wiki.fr.vec",
+        "wiki.de.vec", "wiki.es.vec", "wiki.ja.vec", "wiki.ru.vec")
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=None, init_unknown_vec=np.zeros, **kwargs):
+        super(FastText, self).__init__(**kwargs)
+        root = embedding_root or _default_embedding_root()
+        self._load_embedding(
+            os.path.join(root, "fasttext", pretrained_file_name), " ",
+            init_unknown_vec)
+
+
+@register
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding from any local ``token<delim>v...`` text file
+    (reference CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", init_unknown_vec=np.zeros, **kwargs):
+        super(CustomEmbedding, self).__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding=encoding)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenation of several token embeddings over one vocabulary
+    (reference CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        _vocab.Vocabulary.__init__(
+            self, unknown_token=vocabulary.unknown_token,
+            reserved_tokens=vocabulary.reserved_tokens)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = [_TokenEmbedding._from_vocabulary(vocabulary, e)
+                 for e in token_embeddings]
+        self._vec_len = sum(p.vec_len for p in parts)
+        self._idx_to_vec = nd_array(np.concatenate(
+            [p.idx_to_vec.asnumpy() for p in parts], axis=1))

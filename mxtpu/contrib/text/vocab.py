@@ -1,0 +1,106 @@
+"""Text token indexing (reference `python/mxnet/contrib/text/vocab.py`).
+
+A `Vocabulary` maps tokens to contiguous integer indices.  Index 0 is
+the unknown token; user-supplied reserved tokens follow; remaining
+slots are filled from a frequency counter, most-frequent first with
+ties broken lexically — the reference's ordering contract, kept so
+index assignments match across the two frameworks.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary(object):
+    """Token index built from a `collections.Counter`.
+
+    Parameters
+    ----------
+    counter : token frequencies; None builds a vocabulary holding only
+        the unknown + reserved tokens.
+    most_freq_count : cap on the number of counter-derived tokens.
+    min_freq : minimum frequency for a counter token to be indexed.
+    unknown_token : representation for out-of-vocabulary tokens
+        (always index 0).
+    reserved_tokens : tokens guaranteed an index (e.g. padding/BOS);
+        must not duplicate each other or the unknown token.
+    """
+
+    def __init__(self, counter: Optional[Counter] = None,
+                 most_freq_count: Optional[int] = None, min_freq: int = 1,
+                 unknown_token: str = "<unk>",
+                 reserved_tokens: Optional[Sequence[str]] = None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            rset = set(reserved_tokens)
+            if len(rset) != len(reserved_tokens):
+                raise ValueError("reserved_tokens must not contain "
+                                 "duplicates")
+            if unknown_token in rset:
+                raise ValueError("reserved_tokens must not contain the "
+                                 "unknown token")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens else None)
+        self._idx_to_token: List[str] = [unknown_token] + \
+            (list(reserved_tokens) if reserved_tokens else [])
+        self._token_to_idx: Dict[str, int] = {
+            t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        # most-frequent first, ties lexical (reference ordering)
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        taken = 0
+        for token, freq in pairs:
+            if freq < min_freq:
+                break
+            if most_freq_count is not None and taken >= most_freq_count:
+                break
+            if token not in self._token_to_idx:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                taken += 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self) -> Dict[str, int]:
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self) -> List[str]:
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self) -> str:
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self) -> Optional[List[str]]:
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index(es); unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        """Index(es) -> token(s); out-of-range raises ValueError."""
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError("token index %d out of range [0, %d)"
+                                 % (i, len(self._idx_to_token)))
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

@@ -1,0 +1,268 @@
+"""contrib.text / contrib.svrg_optimization / contrib.tensorboard
+(reference `python/mxnet/contrib/{text,svrg_optimization,tensorboard}`;
+test shapes mirror `tests/python/unittest/test_contrib_text.py` and
+`test_contrib_svrg_module.py`)."""
+import os
+import struct
+from collections import Counter
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu.contrib import text as ctext
+from mxtpu.contrib.svrg_optimization import SVRGModule
+from mxtpu.contrib.tensorboard import (LogMetricsCallback, SummaryWriter,
+                                       _crc32c, _masked_crc)
+
+
+# ---------------------------------------------------------------------------
+# text.vocab / text.utils
+# ---------------------------------------------------------------------------
+
+def test_count_tokens_from_str():
+    c = ctext.utils.count_tokens_from_str(" Life is great! \n life is good .\n")
+    assert c["is"] == 2 and c["Life"] == 1 and c["life"] == 1
+    c2 = ctext.utils.count_tokens_from_str("A a\nA", to_lower=True)
+    assert c2["a"] == 3
+    c3 = ctext.utils.count_tokens_from_str("b b", counter_to_update=c2)
+    assert c3 is c2 and c3["b"] == 2
+
+
+def test_vocabulary_indexing_contract():
+    counter = Counter({"c": 3, "a": 3, "b": 2, "rare": 1})
+    v = ctext.Vocabulary(counter, most_freq_count=None, min_freq=2,
+                         unknown_token="<unk>", reserved_tokens=["<pad>"])
+    # index 0 unknown, reserved next, then freq-desc with lexical ties
+    assert v.idx_to_token == ["<unk>", "<pad>", "a", "c", "b"]
+    assert len(v) == 5
+    assert v.to_indices("a") == 2
+    assert v.to_indices(["b", "nope"]) == [4, 0]
+    assert v.to_tokens([2, 4]) == ["a", "b"]
+    try:
+        v.to_tokens(99)
+        assert False
+    except ValueError:
+        pass
+    capped = ctext.Vocabulary(counter, most_freq_count=2)
+    assert len(capped) == 3  # unk + 2
+
+
+def test_vocabulary_validates_reserved():
+    import pytest
+
+    with pytest.raises(ValueError):
+        ctext.Vocabulary(reserved_tokens=["<pad>", "<pad>"])
+    with pytest.raises(ValueError):
+        ctext.Vocabulary(unknown_token="<u>", reserved_tokens=["<u>"])
+
+
+# ---------------------------------------------------------------------------
+# text.embedding
+# ---------------------------------------------------------------------------
+
+def _write_embedding(tmp_path, name="emb.txt"):
+    p = os.path.join(str(tmp_path), name)
+    with open(p, "w") as f:
+        f.write("hello 1.0 2.0 3.0\n")
+        f.write("world 4.0 5.0 6.0\n")
+        f.write("hello 9.0 9.0 9.0\n")  # duplicate: first wins
+    return p
+
+
+def test_custom_embedding_load_and_query(tmp_path):
+    p = _write_embedding(tmp_path)
+    emb = ctext.embedding.CustomEmbedding(p, init_unknown_vec=np.zeros)
+    assert emb.vec_len == 3
+    assert len(emb) == 3  # unk + hello + world
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [4, 5, 6])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["hello", "miss"]).asnumpy(),
+        [[1, 2, 3], [0, 0, 0]])
+    got = emb.get_vecs_by_tokens("WORLD", lower_case_backup=True)
+    np.testing.assert_allclose(got.asnumpy(), [4, 5, 6])
+    emb.update_token_vectors("hello", mx.nd.array([7.0, 7.0, 7.0]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [7, 7, 7])
+    import pytest
+
+    with pytest.raises(ValueError):
+        emb.update_token_vectors("absent", mx.nd.array([1.0, 1, 1]))
+
+
+def test_embedding_with_vocab_counter_gets_file_vectors(tmp_path):
+    """Tokens pre-indexed through the Vocabulary counter kwarg must
+    still receive their file vectors (regression: the loader skipped
+    already-indexed tokens, leaving zero rows)."""
+    p = _write_embedding(tmp_path, "ec.txt")
+    emb = ctext.embedding.CustomEmbedding(
+        p, counter=Counter({"hello": 5, "onlyvocab": 1}))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("onlyvocab").asnumpy(), [0, 0, 0])
+    # an unknown-token line in the file becomes the unknown vector
+    p2 = os.path.join(str(tmp_path), "eu.txt")
+    with open(p2, "w") as f:
+        f.write("<unk> 8.0 8.0\nword 1.0 2.0\n")
+    emb2 = ctext.embedding.CustomEmbedding(p2)
+    np.testing.assert_allclose(
+        emb2.get_vecs_by_tokens("never-seen").asnumpy(), [8, 8])
+
+
+def test_composite_embedding_and_registry(tmp_path):
+    p1 = _write_embedding(tmp_path, "e1.txt")
+    p2 = os.path.join(str(tmp_path), "e2.txt")
+    with open(p2, "w") as f:
+        f.write("hello 10.0 20.0\n")
+    e1 = ctext.embedding.CustomEmbedding(p1)
+    e2 = ctext.embedding.CustomEmbedding(p2)
+    vocab = ctext.Vocabulary(Counter({"hello": 2, "world": 1}))
+    comp = ctext.embedding.CompositeEmbedding(vocab, [e1, e2])
+    assert comp.vec_len == 5
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3, 10, 20])
+    # world is missing from e2 -> unknown (zeros) for that slice
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("world").asnumpy(), [4, 5, 6, 0, 0])
+    # registry surface
+    assert "glove" in ctext.embedding.get_pretrained_file_names()
+    assert "glove.6B.50d.txt" in \
+        ctext.embedding.get_pretrained_file_names("glove")
+    import pytest
+
+    with pytest.raises(OSError):
+        ctext.embedding.create("glove", embedding_root=str(tmp_path),
+                               pretrained_file_name="glove.6B.50d.txt")
+
+
+# ---------------------------------------------------------------------------
+# SVRG
+# ---------------------------------------------------------------------------
+
+def _linreg_setup(seed=0, n=64, dim=4):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-1, 1, (n, dim)).astype(np.float32)
+    true_w = np.arange(1, dim + 1, dtype=np.float32)
+    Y = X @ true_w
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=1, no_bias=True, name="fc")
+    net = mx.sym.LinearRegressionOutput(out, mx.sym.Variable("lin_label"),
+                                        name="lro")
+    it = mx.io.NDArrayIter(X, Y.reshape(-1, 1), batch_size=16,
+                           label_name="lin_label")
+    return net, it, true_w
+
+
+def test_svrg_module_api_and_snapshot():
+    net, it, _ = _linreg_setup()
+    mod = SVRGModule(net, label_names=("lin_label",), context=mx.cpu(),
+                     update_freq=2)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    # snapshot: mu exists per param and aux module mirrors the weights
+    mod.update_full_grads(it)
+    assert mod._param_dict is not None and "fc_weight" in mod._param_dict
+    w_main, _ = mod.get_params()
+    w_aux, _ = mod._mod_aux.get_params()
+    np.testing.assert_allclose(w_main["fc_weight"].asnumpy(),
+                               w_aux["fc_weight"].asnumpy())
+    # one batch step runs the corrected update without error
+    it.reset()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+
+
+def test_svrg_variance_reduction_at_snapshot():
+    """At the snapshot point (w == w~), g - g~ + mu == mu exactly: the
+    SVRG-corrected gradient equals the full gradient."""
+    net, it, _ = _linreg_setup(seed=1)
+    mod = SVRGModule(net, label_names=("lin_label",), context=mx.cpu(),
+                     update_freq=1)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.0})
+    mod.update_full_grads(it)
+    mu = mod._param_dict["fc_weight"].asnumpy()
+    it.reset()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod._update_svrg_gradients()
+    eg = mod._exec_group
+    g = eg.grad_arrays[eg.param_names.index("fc_weight")][0].asnumpy()
+    np.testing.assert_allclose(g, mu, rtol=1e-5, atol=1e-6)
+
+
+def test_svrg_fit_converges_linear_regression():
+    net, it, true_w = _linreg_setup(seed=2)
+    mod = SVRGModule(net, label_names=("lin_label",), context=mx.cpu(),
+                     update_freq=2)
+    mod.fit(it, num_epoch=30, optimizer="sgd", eval_metric="mse",
+            optimizer_params={"learning_rate": 0.2})
+    w, _ = mod.get_params()
+    np.testing.assert_allclose(w["fc_weight"].asnumpy().ravel(), true_w,
+                               rtol=0.15, atol=0.15)
+
+
+# ---------------------------------------------------------------------------
+# tensorboard
+# ---------------------------------------------------------------------------
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vector: 32 zero bytes -> 0x8A9136AA
+    assert _crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert _crc32c(b"123456789") == 0xE3069283
+
+
+def _read_records(path):
+    out = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            assert hcrc == _masked_crc(header)
+            data = f.read(length)
+            (dcrc,) = struct.unpack("<I", f.read(4))
+            assert dcrc == _masked_crc(data)
+            out.append(data)
+    return out
+
+
+def test_summary_writer_event_file(tmp_path):
+    logdir = str(tmp_path / "tb")
+    w = SummaryWriter(logdir)
+    w.add_scalar("loss", 0.5, global_step=1)
+    w.add_scalar("acc", 0.75, global_step=2)
+    w.close()
+    files = os.listdir(logdir)
+    assert len(files) == 1 and files[0].startswith("events.out.tfevents.")
+    recs = _read_records(os.path.join(logdir, files[0]))
+    assert len(recs) == 3
+    assert b"brain.Event:2" in recs[0]
+    assert b"loss" in recs[1] and struct.pack("<f", 0.5) in recs[1]
+    assert b"acc" in recs[2] and struct.pack("<f", 0.75) in recs[2]
+
+
+def test_log_metrics_callback_with_module_fit(tmp_path):
+    logdir = str(tmp_path / "tblogs")
+    net, it, _ = _linreg_setup(seed=3)
+    cb = LogMetricsCallback(logdir, prefix="train")
+    mod = mx.mod.Module(net, label_names=("lin_label",), context=mx.cpu())
+    mod.fit(it, num_epoch=2, eval_metric="mse", batch_end_callback=cb,
+            optimizer_params={"learning_rate": 0.05})
+    cb.summary_writer.close()
+    files = os.listdir(logdir)
+    assert len(files) == 1
+    recs = _read_records(os.path.join(logdir, files[0]))
+    # file_version + one record per batch (4 batches x 2 epochs)
+    assert len(recs) == 1 + 8
+    assert any(b"train-mse" in r for r in recs[1:])
