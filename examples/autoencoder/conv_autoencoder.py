@@ -1,0 +1,100 @@
+"""Convolutional autoencoder.
+
+Analog of the reference's `example/autoencoder/`: encoder convs down to
+a small code, decoder `Conv2DTranspose`s back; trained with L2 loss.
+Exercises Deconvolution through gluon + hybridize (the decoder is the
+input-dilated transposed-conv path of `mxtpu/ops/nn.py`).
+
+Run:  python conv_autoencoder.py [--epochs 5] [--code-dim 16]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+
+
+class ConvAE(gluon.nn.HybridBlock):
+    def __init__(self, code_dim=16):
+        super().__init__()
+        self.encoder = gluon.nn.HybridSequential()
+        self.encoder.add(
+            gluon.nn.Conv2D(8, 3, strides=2, padding=1,
+                            activation="relu"),     # 28 -> 14
+            gluon.nn.Conv2D(16, 3, strides=2, padding=1,
+                            activation="relu"),     # 14 -> 7
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(code_dim))
+        self.decoder_fc = gluon.nn.Dense(16 * 7 * 7, activation="relu")
+        self.decoder = gluon.nn.HybridSequential()
+        self.decoder.add(
+            gluon.nn.Conv2DTranspose(8, 4, strides=2, padding=1,
+                                     activation="relu"),  # 7 -> 14
+            gluon.nn.Conv2DTranspose(1, 4, strides=2, padding=1,
+                                     activation="sigmoid"))  # 14 -> 28
+
+    def hybrid_forward(self, F, x):
+        code = self.encoder(x)
+        h = self.decoder_fc(code)
+        h = F.Reshape(h, shape=(-1, 16, 7, 7))
+        return self.decoder(h)
+
+
+def synthetic_digits(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    base = np.zeros((n, 1, 28, 28), np.float32)
+    for i in range(n):
+        cx, cy = rng.randint(6, 22, 2)
+        r = rng.randint(3, 7)
+        yy, xx = np.mgrid[:28, :28]
+        base[i, 0] = ((yy - cy) ** 2 + (xx - cx) ** 2 < r * r)
+    return base + rng.normal(0, 0.02, base.shape).astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--code-dim", type=int, default=16)
+    p.add_argument("--lr", type=float, default=1e-3)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    net = ConvAE(args.code_dim)
+    net.initialize(mx.initializer.Xavier(), ctx=ctx)
+    net.hybridize()
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    X = synthetic_digits()
+    it = mx.io.NDArrayIter(X, batch_size=args.batch_size, shuffle=True)
+    first = last = None
+    for epoch in range(args.epochs):
+        it.reset()
+        total = n = 0.0
+        for batch in it:
+            x = batch.data[0].as_in_context(ctx)
+            with autograd.record():
+                loss = loss_fn(net(x), x)
+            loss.backward()
+            trainer.step(x.shape[0])
+            total += float(loss.mean().asnumpy())
+            n += 1
+        if first is None:
+            first = total / n
+        last = total / n
+        logging.info("epoch %d reconstruction loss %.5f", epoch, last)
+    assert last < first, "reconstruction loss should decrease"
+
+
+if __name__ == "__main__":
+    main()

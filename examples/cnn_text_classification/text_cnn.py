@@ -1,0 +1,113 @@
+"""CNN for sentence classification (Kim 2014).
+
+Analog of the reference's `example/cnn_text_classification/text_cnn.py`:
+token ids -> Embedding -> parallel Conv1D banks with widths (3, 4, 5)
+-> global max pool -> concat -> Dense.  Builds its Vocabulary with
+`mxtpu.contrib.text` and embeds with a CustomEmbedding when
+--embedding-file is given.
+
+Run:  python text_cnn.py [--epochs 6]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+from collections import Counter
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.contrib import text as ctext
+
+POS_WORDS = "good great fine excellent love nice happy best".split()
+NEG_WORDS = "bad awful poor terrible hate sad angry worst".split()
+FILLER = "the a an it this movie film was is very so and".split()
+
+
+def make_corpus(n=512, seq_len=8, seed=0):
+    rng = np.random.RandomState(seed)
+    sents, labels = [], []
+    for _ in range(n):
+        y = rng.randint(2)
+        pool = POS_WORDS if y else NEG_WORDS
+        words = [rng.choice(pool) if rng.rand() < 0.4
+                 else rng.choice(FILLER) for _ in range(seq_len)]
+        if not any(w in pool for w in words):
+            words[rng.randint(seq_len)] = rng.choice(pool)
+        sents.append(words)
+        labels.append(y)
+    return sents, np.asarray(labels, np.float32)
+
+
+class TextCNN(gluon.nn.HybridBlock):
+    def __init__(self, vocab_size, embed_dim=32, num_filter=16,
+                 widths=(3, 4, 5), num_classes=2):
+        super().__init__()
+        self.embed = gluon.nn.Embedding(vocab_size, embed_dim)
+        self.convs = []
+        for i, w in enumerate(widths):
+            conv = gluon.nn.Conv1D(num_filter, w, activation="relu")
+            setattr(self, "conv%d" % i, conv)
+            self.convs.append(conv)
+        self.pool = gluon.nn.GlobalMaxPool1D()
+        self.out = gluon.nn.Dense(num_classes)
+
+    def hybrid_forward(self, F, x):
+        e = self.embed(x)                  # (N, T, E)
+        e = F.transpose(e, axes=(0, 2, 1))  # Conv1D wants NCW
+        feats = [F.Flatten(self.pool(c(e))) for c in self.convs]
+        return self.out(F.concat(*feats, dim=1))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--embedding-file", default=None,
+                   help="optional pretrained vectors (token v1 v2 ...)")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    sents, labels = make_corpus()
+    counter = Counter(w for s in sents for w in s)
+    vocab = ctext.Vocabulary(counter, reserved_tokens=["<pad>"])
+    X = np.asarray([vocab.to_indices(s) for s in sents], np.float32)
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    net = TextCNN(len(vocab))
+    net.initialize(mx.initializer.Xavier(), ctx=ctx)
+    if args.embedding_file:
+        emb = ctext.embedding.CustomEmbedding(args.embedding_file,
+                                              counter=counter)
+        net.embed.weight.set_data(emb.idx_to_vec)
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    it = mx.io.NDArrayIter(X, labels, batch_size=args.batch_size,
+                           shuffle=True)
+    for epoch in range(args.epochs):
+        it.reset()
+        metric = mx.metric.Accuracy()
+        for batch in it:
+            x = batch.data[0].as_in_context(ctx)
+            y = batch.label[0].as_in_context(ctx)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update([y], [out])
+        logging.info("epoch %d train accuracy %.3f", epoch,
+                     metric.get()[1])
+    assert metric.get()[1] > 0.9, "sentiment CNN should fit the corpus"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Distributed data-parallel training (dist_sync kvstore).
+
+Analog of the reference's `example/distributed_training/cifar10_dist.py`:
+each worker trains a small convnet on its shard; gradients synchronize
+through the parameter-server kvstore (`dist_sync`) or, single-process,
+through the mesh-collective store (`--kvstore tpu`).
+
+Launch distributed (2 workers, 1 server):
+    python tools/launch.py -n 2 -s 1 python \
+        examples/distributed_training/cifar10_dist.py --kvstore dist_sync
+Single process:
+    python examples/distributed_training/cifar10_dist.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import sym
+
+
+def build_net(num_classes=10):
+    data = sym.Variable("data")
+    h = sym.Convolution(data, kernel=(3, 3), num_filter=16, pad=(1, 1),
+                        name="conv1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    h = sym.Convolution(h, kernel=(3, 3), num_filter=32, pad=(1, 1),
+                        name="conv2")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.Pooling(h, global_pool=True, pool_type="avg")
+    h = sym.FullyConnected(sym.Flatten(h), num_hidden=num_classes,
+                           name="fc")
+    return sym.SoftmaxOutput(h, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def make_data(rank, num_workers, n=2048, seed=7):
+    """Deterministic CIFAR-shaped synthetic set, sharded by rank the way
+    the reference shards the record file."""
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[:32, :32] / 32.0
+    templates = np.stack([
+        np.stack([np.sin(2 * np.pi * (k * xx / 10 + c / 3)) for c in
+                  range(3)]) for k in range(10)]).astype(np.float32)
+    y = rng.randint(0, 10, n)
+    X = templates[y] + rng.normal(0, 0.15, (n, 3, 32, 32)) \
+        .astype(np.float32)
+    X, y = X[rank::num_workers], y[rank::num_workers]
+    return X, y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--kvstore", default="local")
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.05)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    kv = mx.kv.create(args.kvstore)
+    logging.info("kvstore=%s rank=%d/%d", kv.type, kv.rank,
+                 kv.num_workers)
+    X, y = make_data(kv.rank, kv.num_workers)
+    it = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                           shuffle=True, label_name="softmax_label")
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    mod = mx.mod.Module(build_net(), context=ctx,
+                        label_names=("softmax_label",))
+    mod.fit(it, num_epoch=args.epochs, kvstore=kv, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": 0.9},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       10))
+    metric = mx.metric.Accuracy()
+    it.reset()
+    mod.score(it, metric)
+    logging.info("rank %d final shard accuracy: %.3f", kv.rank,
+                 metric.get()[1])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Inference throughput across the model zoo.
+
+Analog of the reference's
+`example/image-classification/benchmark_score.py`: forward-only
+images/sec for each zoo network at several batch sizes, via the
+symbolic executor (one fused XLA program per (net, batch)).
+
+Run:  python benchmark_score.py [--networks resnet18_v1,mobilenet1_0]
+      [--batch-sizes 1,32] [--iters 20]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+import time
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu.gluon.model_zoo import vision
+
+
+def score(name, batch, iters, ctx):
+    net = getattr(vision, name)(classes=1000)
+    net.initialize(ctx=ctx)
+    x = mx.nd.array(np.random.uniform(size=(batch, 3, 224, 224))
+                    .astype(np.float32), ctx=ctx)
+    net(x)  # materialize deferred shapes
+    net.hybridize()
+    net(x).wait_to_read()  # compile
+    tic = time.perf_counter()
+    for _ in range(iters):
+        out = net(x)
+    out.wait_to_read()
+    dt = time.perf_counter() - tic
+    return batch * iters / dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--networks",
+                   default="resnet18_v1,resnet50_v1,mobilenet1_0")
+    p.add_argument("--batch-sizes", default="1,32")
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    logging.info("device: %s", ctx)
+    for name in args.networks.split(","):
+        for bs in (int(b) for b in args.batch_sizes.split(",")):
+            ips = score(name.strip(), bs, args.iters, ctx)
+            logging.info("network %-16s batch %3d : %9.1f images/sec",
+                         name, bs, ips)
+
+
+if __name__ == "__main__":
+    main()

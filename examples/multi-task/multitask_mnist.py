@@ -1,0 +1,101 @@
+"""Multi-task training with a grouped Symbol.
+
+Analog of the reference's `example/multi-task/`: one shared trunk, two
+SoftmaxOutput heads (the digit class and a parity task), bound as a
+`sym.Group` through Module — the whole two-head step is still ONE fused
+XLA program.  Shows a custom multi-output metric.
+
+Run:  python multitask_mnist.py [--epochs 5]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import sym
+
+
+def build_net():
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=64, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    cls = sym.FullyConnected(h, num_hidden=10, name="fc_class")
+    cls = sym.SoftmaxOutput(cls, sym.Variable("class_label"),
+                            name="softmax_class")
+    par = sym.FullyConnected(h, num_hidden=2, name="fc_parity")
+    par = sym.SoftmaxOutput(par, sym.Variable("parity_label"),
+                            name="softmax_parity")
+    return sym.Group([cls, par])
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Per-head accuracy (reference example's Multi_Accuracy)."""
+
+    def __init__(self, num=2):
+        self.num = num
+        super().__init__("multi-accuracy")
+
+    def reset(self):
+        self.num_inst = [0] * self.num
+        self.sum_metric = [0.0] * self.num
+
+    def update(self, labels, preds):
+        for i in range(self.num):
+            pred = np.argmax(preds[i].asnumpy(), axis=1)
+            label = labels[i].asnumpy().astype(np.int64)
+            self.sum_metric[i] += (pred == label).sum()
+            self.num_inst[i] += len(label)
+
+    def get(self):
+        accs = [s / max(n, 1) for s, n in zip(self.sum_metric,
+                                              self.num_inst)]
+        return ["class-acc", "parity-acc"], accs
+
+    def get_name_value(self):
+        names, values = self.get()
+        return list(zip(names, values))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=64)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    templates = rng.uniform(0, 1, (10, 64)).astype(np.float32)
+    y = rng.randint(0, 10, 2048)
+    X = templates[y] + rng.normal(0, 0.1, (2048, 64)).astype(np.float32)
+    it = mx.io.NDArrayIter(
+        X, {"class_label": y.astype(np.float32),
+            "parity_label": (y % 2).astype(np.float32)},
+        batch_size=args.batch_size, shuffle=True)
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    mod = mx.mod.Module(build_net(), context=ctx,
+                        label_names=("class_label", "parity_label"))
+    metric = MultiAccuracy()
+    mod.fit(it, num_epoch=args.epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-3},
+            eval_metric=metric,
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, 20))
+    it.reset()
+    metric.reset()
+    mod.score(it, metric)
+    names, accs = metric.get()
+    for n, a in zip(names, accs):
+        logging.info("%s = %.3f", n, a)
+    assert all(a > 0.9 for a in accs), accs
+
+
+if __name__ == "__main__":
+    main()

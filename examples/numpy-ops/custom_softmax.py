@@ -1,0 +1,94 @@
+"""Custom operator in Python (numpy-ops).
+
+Analog of the reference's `example/numpy-ops/custom_softmax.py`: a
+softmax-with-loss implemented as a `mx.operator.CustomOp` whose
+forward/backward run HOST-side numpy through the pure_callback bridge
+(`mxtpu/ops/custom_op.py`) — the escape hatch for ops XLA can't
+express.  The surrounding network still compiles; only the custom node
+round-trips to the host.
+
+Run:  python custom_softmax.py [--epochs 5]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import sym
+
+
+class CustomSoftmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.assign(out_data[0], req[0], mx.nd.array(
+            e / e.sum(axis=1, keepdims=True)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        # SoftmaxOutput-style gradient: p - onehot(label)
+        p = out_data[0].asnumpy().copy()
+        label = in_data[1].asnumpy().astype(np.int64)
+        p[np.arange(len(label)), label] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(p / len(label)))
+
+
+@mx.operator.register("custom_softmax")
+class CustomSoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return CustomSoftmax()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=64)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    templates = rng.uniform(0, 1, (10, 64)).astype(np.float32)
+    y = rng.randint(0, 10, 1024)
+    X = templates[y] + rng.normal(0, 0.1, (1024, 64)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y.astype(np.float32),
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="softmax_label")
+
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=64, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, num_hidden=10, name="fc2")
+    out = sym.Custom(h, sym.Variable("softmax_label"),
+                     op_type="custom_softmax", name="softmax")
+    mod = mx.mod.Module(out, context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.fit(it, num_epoch=args.epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-3})
+    metric = mx.metric.Accuracy()
+    it.reset()
+    mod.score(it, metric)
+    logging.info("accuracy with host-side custom softmax: %.3f",
+                 metric.get()[1])
+    assert metric.get()[1] > 0.9
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Profiler walkthrough.
+
+Analog of the reference's `example/profiler/profiler_executor.py`:
+profile a training step and dump a chrome://tracing file plus the
+aggregate table (`mxtpu.profiler`).
+
+Run:  python profiler_demo.py [--out profile.json]
+Open the JSON in chrome://tracing or https://ui.perfetto.dev.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import json
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import sym
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="profile.json")
+    p.add_argument("--steps", type=int, default=5)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=256, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, num_hidden=10, name="fc2")
+    net = sym.SoftmaxOutput(h, sym.Variable("softmax_label"),
+                            name="softmax")
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1, 1, (1024, 128)).astype(np.float32)
+    Y = rng.randint(0, 10, 1024).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=128,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer()
+
+    mx.profiler.set_config(filename=args.out, profile_symbolic=True,
+                           profile_imperative=True, profile_memory=True)
+    mx.profiler.set_state("run")
+    for i, batch in enumerate(it):
+        if i >= args.steps:
+            break
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    mx.nd.waitall()
+    mx.profiler.set_state("stop")
+    print(mx.profiler.dumps())          # aggregate table
+    mx.profiler.dump()                  # chrome trace file
+    assert os.path.exists(args.out)
+    with open(args.out) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    logging.info("wrote %s with %d trace events", args.out, len(events))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Post-training INT8 quantization walkthrough.
+
+Analog of the reference's `example/quantization/imagenet_gen_qsym.py`:
+train (briefly), calibrate on held-out batches, rewrite the graph to
+int8 islands (`mxtpu.contrib.quantization`, riding the subgraph
+framework), and compare fp32 vs int8 top-1 agreement.
+
+Run:  python quantize_model.py [--calib-mode naive|entropy|none]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import sym
+from mxtpu.contrib.quantization import quantize_model
+
+
+def build_net():
+    data = sym.Variable("data")
+    h = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="conv1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    h = sym.FullyConnected(sym.Flatten(h), num_hidden=32, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(h, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--calib-mode", default="naive",
+                   choices=["none", "naive", "entropy"])
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    yy, xx = np.mgrid[:16, :16] / 16.0
+    templates = np.stack([
+        np.stack([np.sin(2 * np.pi * (k * xx / 8 + c / 3))
+                  for c in range(3)]) for k in range(10)]) \
+        .astype(np.float32)
+    y = rng.randint(0, 10, 1536)
+    X = templates[y] + rng.normal(0, 0.1, (1536, 3, 16, 16)) \
+        .astype(np.float32)
+    it = mx.io.NDArrayIter(X[:1024], y[:1024].astype(np.float32),
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="softmax_label")
+    calib_it = mx.io.NDArrayIter(X[1024:], y[1024:].astype(np.float32),
+                                 batch_size=args.batch_size,
+                                 label_name="softmax_label")
+
+    mod = mx.mod.Module(build_net(), context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.fit(it, num_epoch=args.epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-3})
+    arg_params, aux_params = mod.get_params()
+    net = mod.symbol
+
+    qsym, qarg, qaux = quantize_model(
+        net, arg_params, aux_params, calib_data=calib_it,
+        calib_mode=args.calib_mode, num_calib_examples=256)
+    q_ops = [n.op.name for n in qsym._topo() if not n.is_variable]
+    logging.info("quantized nodes: %d int8 islands",
+                 q_ops.count("_contrib_quantize_v2"))
+
+    def predict(s, params, aux):
+        arg_names = set(s.list_arguments())
+        # quantized params (int8 tables, min/max scalars) have shapes
+        # and dtypes infer_shape cannot derive — pass them explicitly
+        shapes = {k: tuple(v.shape) for k, v in params.items()
+                  if k in arg_names}
+        shapes["data"] = (args.batch_size, 3, 16, 16)
+        shapes["softmax_label"] = (args.batch_size,)
+        tdict = {k: v.dtype for k, v in params.items() if k in arg_names}
+        exe = s.simple_bind(ctx=mx.cpu(), grad_req="null",
+                            type_dict=tdict, **shapes)
+        exe.copy_params_from(params, aux, allow_extra_params=True)
+        preds = []
+        calib_it.reset()
+        for batch in calib_it:
+            out = exe.forward(is_train=False, data=batch.data[0])[0]
+            preds.append(out.asnumpy().argmax(axis=1))
+        return np.concatenate(preds)
+
+    p32 = predict(net, arg_params, aux_params)
+    p8 = predict(qsym, qarg, qaux)
+    agree = (p32 == p8).mean()
+    logging.info("fp32 vs int8 top-1 agreement: %.3f", agree)
+    assert agree > 0.9, "int8 predictions should track fp32"
+
+
+if __name__ == "__main__":
+    main()

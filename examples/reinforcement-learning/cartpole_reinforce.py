@@ -1,0 +1,118 @@
+"""REINFORCE policy gradient on CartPole.
+
+Analog of the reference's `example/reinforcement-learning/` family.
+No gym in this image, so a faithful 30-line CartPole (standard
+Barto-Sutton dynamics, same termination bounds) is included.  The
+policy is a gluon MLP; the REINFORCE step weights log-prob gradients by
+normalized discounted returns.
+
+Run:  python cartpole_reinforce.py [--episodes 150]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+
+
+class CartPole(object):
+    """Classic cart-pole dynamics (Euler, dt=0.02)."""
+
+    def __init__(self, seed=0):
+        self.rng = np.random.RandomState(seed)
+
+    def reset(self):
+        self.s = self.rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        return self.s.copy()
+
+    def step(self, action):
+        x, x_dot, th, th_dot = self.s
+        force = 10.0 if action == 1 else -10.0
+        cos, sin = np.cos(th), np.sin(th)
+        temp = (force + 0.05 * th_dot ** 2 * sin) / 1.1
+        th_acc = (9.8 * sin - cos * temp) / \
+            (0.5 * (4.0 / 3.0 - 0.1 * cos ** 2 / 1.1))
+        x_acc = temp - 0.05 * th_acc * cos / 1.1
+        dt = 0.02
+        self.s = np.array([x + dt * x_dot, x_dot + dt * x_acc,
+                           th + dt * th_dot, th_dot + dt * th_acc],
+                          np.float32)
+        done = bool(abs(self.s[0]) > 2.4 or abs(self.s[2]) > 0.2095)
+        return self.s.copy(), 1.0, done
+
+
+def discounted_returns(rewards, gamma):
+    out = np.zeros(len(rewards), np.float32)
+    acc = 0.0
+    for i in reversed(range(len(rewards))):
+        acc = rewards[i] + gamma * acc
+        out[i] = acc
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--episodes", type=int, default=150)
+    p.add_argument("--gamma", type=float, default=0.99)
+    p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--max-steps", type=int, default=200)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.cpu()  # per-step env interaction: host-latency bound
+    policy = gluon.nn.HybridSequential()
+    policy.add(gluon.nn.Dense(32, activation="relu"),
+               gluon.nn.Dense(2))
+    policy.initialize(mx.initializer.Xavier(), ctx=ctx)
+    trainer = gluon.Trainer(policy.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    env = CartPole()
+    rng = np.random.RandomState(1)
+    recent = []
+    for ep in range(args.episodes):
+        states, actions, rewards = [], [], []
+        s = env.reset()
+        for _ in range(args.max_steps):
+            logits = policy(nd.array(s[None], ctx=ctx)).asnumpy()[0]
+            prob = np.exp(logits - logits.max())
+            prob /= prob.sum()
+            a = rng.choice(2, p=prob)
+            states.append(s)
+            actions.append(a)
+            s, r, done = env.step(a)
+            rewards.append(r)
+            if done:
+                break
+        ret = discounted_returns(rewards, args.gamma)
+        ret = (ret - ret.mean()) / (ret.std() + 1e-6)
+        S = nd.array(np.stack(states), ctx=ctx)
+        A = nd.array(np.asarray(actions, np.float32), ctx=ctx)
+        R = nd.array(ret, ctx=ctx)
+        with autograd.record():
+            logits = policy(S)
+            logp = nd.log_softmax(logits, axis=-1)
+            chosen = nd.pick(logp, A, axis=1)
+            loss = -(chosen * R).mean()
+        loss.backward()
+        trainer.step(1)
+        recent.append(len(rewards))
+        if (ep + 1) % 25 == 0:
+            logging.info("episode %d  mean length (last 25): %.1f",
+                         ep + 1, np.mean(recent[-25:]))
+    early = np.mean(recent[:25])
+    late = np.mean(recent[-25:])
+    logging.info("mean episode length: first25=%.1f last25=%.1f",
+                 early, late)
+    assert late > early, "policy should improve with REINFORCE"
+
+
+if __name__ == "__main__":
+    main()

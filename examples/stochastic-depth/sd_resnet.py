@@ -1,0 +1,116 @@
+"""Stochastic-depth ResNet (Huang et al. 2016).
+
+Analog of the reference's `example/stochastic-depth/sd_cifar10.py`:
+residual blocks are randomly dropped during training with linearly
+decaying survival probability; at inference every block runs, scaled
+by its survival rate.  Shows mode-dependent control flow done the XLA
+way — the drop decision is a Bernoulli draw multiplied into the branch
+(no Python branching inside the compiled step).
+
+Run:  python sd_resnet.py [--epochs 4] [--death-rate 0.5]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+
+
+class SDResidual(gluon.nn.HybridBlock):
+    def __init__(self, channels, survival_p):
+        super().__init__()
+        self.survival_p = survival_p
+        self.body = gluon.nn.HybridSequential()
+        self.body.add(
+            gluon.nn.Conv2D(channels, 3, padding=1, activation="relu"),
+            gluon.nn.Conv2D(channels, 3, padding=1))
+
+    def hybrid_forward(self, F, x):
+        out = self.body(x)
+        if autograd.is_training():
+            # one Bernoulli gate per batch (paper's per-sample variant
+            # works too; per-batch matches the reference example)
+            gate = float(np.random.rand() < self.survival_p)
+            return F.Activation(x + gate * out, act_type="relu")
+        return F.Activation(x + self.survival_p * out, act_type="relu")
+
+
+class SDNet(gluon.nn.HybridBlock):
+    def __init__(self, num_blocks=6, channels=16, classes=10,
+                 death_rate=0.5):
+        super().__init__()
+        self.stem = gluon.nn.Conv2D(channels, 3, padding=1,
+                                    activation="relu")
+        self.blocks = gluon.nn.HybridSequential()
+        for i in range(num_blocks):
+            # linearly decaying survival: earlier blocks survive more
+            p = 1.0 - death_rate * (i + 1) / num_blocks
+            self.blocks.add(SDResidual(channels, p))
+        self.head = gluon.nn.HybridSequential()
+        self.head.add(gluon.nn.GlobalAvgPool2D(), gluon.nn.Flatten(),
+                      gluon.nn.Dense(classes))
+
+    def hybrid_forward(self, F, x):
+        return self.head(self.blocks(self.stem(x)))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--death-rate", type=float, default=0.5)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    # low-frequency class templates (smooth gradients survive the
+    # global average pooling head)
+    yy, xx = np.mgrid[:16, :16] / 16.0
+    templates = np.stack([
+        np.stack([np.cos(2 * np.pi * (k * yy / 10 + c / 3)) for c in
+                  range(3)]) for k in range(10)]).astype(np.float32)
+    y = rng.randint(0, 10, 1024)
+    X = templates[y] + rng.normal(0, 0.1, (1024, 3, 16, 16)) \
+        .astype(np.float32)
+    it = mx.io.NDArrayIter(X, y.astype(np.float32),
+                           batch_size=args.batch_size, shuffle=True)
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    net = SDNet(death_rate=args.death_rate)
+    net.initialize(mx.initializer.Xavier(), ctx=ctx)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    for epoch in range(args.epochs):
+        it.reset()
+        metric = mx.metric.Accuracy()
+        for batch in it:
+            x = batch.data[0].as_in_context(ctx)
+            yb = batch.label[0].as_in_context(ctx)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, yb)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update([yb], [out])
+        logging.info("epoch %d train acc %.3f", epoch, metric.get()[1])
+    # inference path (expected-depth scaling) still classifies
+    ev = mx.metric.Accuracy()
+    it.reset()
+    for batch in it:
+        ev.update([batch.label[0].as_in_context(ctx)],
+                  [net(batch.data[0].as_in_context(ctx))])
+    logging.info("inference accuracy %.3f", ev.get()[1])
+    assert ev.get()[1] > 0.6
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Multiclass SVM head (SVMOutput).
+
+Analog of the reference's `example/svm_mnist/svm_mnist.py`: same MLP,
+but the head is `SVMOutput` — hinge loss (L1 or squared L2) with
+margin, instead of softmax cross-entropy.
+
+Run:  python svm_mnist.py [--l2] [--epochs 5]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import sym
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--l2", action="store_true",
+                   help="squared hinge instead of L1 hinge")
+    p.add_argument("--margin", type=float, default=1.0)
+    p.add_argument("--reg-coeff", type=float, default=1.0)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    templates = rng.uniform(0, 1, (10, 128)).astype(np.float32)
+    y = rng.randint(0, 10, 2048)
+    X = templates[y] + rng.normal(0, 0.15, (2048, 128)) \
+        .astype(np.float32)
+    it = mx.io.NDArrayIter(X, y.astype(np.float32),
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="svm_label")
+
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, num_hidden=10, name="fc2")
+    out = sym.SVMOutput(h, sym.Variable("svm_label"),
+                        margin=args.margin,
+                        regularization_coefficient=args.reg_coeff,
+                        use_linear=not args.l2, name="svm")
+    mod = mx.mod.Module(out, context=mx.cpu(), label_names=("svm_label",))
+    mod.fit(it, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    metric = mx.metric.Accuracy()
+    it.reset()
+    mod.score(it, metric)
+    logging.info("SVM (%s hinge) accuracy: %.3f",
+                 "L1" if not args.l2 else "squared-L2", metric.get()[1])
+    assert metric.get()[1] > 0.9
+
+
+if __name__ == "__main__":
+    main()

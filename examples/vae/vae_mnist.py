@@ -1,0 +1,105 @@
+"""Variational autoencoder.
+
+Analog of the reference's `example/bayesian-methods` / `vae-gan`
+family: encoder emits (mu, log-var), the reparameterization trick
+samples the code, and the loss is reconstruction BCE + KL(q||N(0,1)).
+Exercises `mx.random.normal` inside an autograd scope (the
+reparameterized sample is differentiable through mu/sigma).
+
+Run:  python vae_mnist.py [--epochs 5] [--latent 8]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+
+
+class VAE(gluon.nn.HybridBlock):
+    def __init__(self, latent=8, hidden=128):
+        super().__init__()
+        self.latent = latent
+        self.enc = gluon.nn.HybridSequential()
+        self.enc.add(gluon.nn.Dense(hidden, activation="relu"),
+                     gluon.nn.Dense(2 * latent))
+        self.dec = gluon.nn.HybridSequential()
+        self.dec.add(gluon.nn.Dense(hidden, activation="relu"),
+                     gluon.nn.Dense(28 * 28, activation="sigmoid"))
+
+    def hybrid_forward(self, F, x, eps):
+        h = self.enc(x)
+        mu = F.slice_axis(h, axis=1, begin=0, end=self.latent)
+        logvar = F.slice_axis(h, axis=1, begin=self.latent,
+                              end=2 * self.latent)
+        z = mu + F.exp(0.5 * logvar) * eps   # reparameterization
+        return self.dec(z), mu, logvar
+
+
+def synthetic_blobs(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[:28, :28]
+    out = np.zeros((n, 784), np.float32)
+    for i in range(n):
+        cx, cy, r = rng.randint(8, 20), rng.randint(8, 20), \
+            rng.randint(3, 8)
+        out[i] = (((yy - cy) ** 2 + (xx - cx) ** 2) < r * r) \
+            .astype(np.float32).ravel()
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--latent", type=int, default=8)
+    p.add_argument("--lr", type=float, default=1e-3)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    net = VAE(args.latent)
+    net.initialize(mx.initializer.Xavier(), ctx=ctx)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    X = synthetic_blobs()
+    it = mx.io.NDArrayIter(X, batch_size=args.batch_size, shuffle=True)
+    first = last = None
+    for epoch in range(args.epochs):
+        it.reset()
+        total = n = 0.0
+        for batch in it:
+            x = batch.data[0].as_in_context(ctx)
+            eps = mx.random.normal(0, 1, (x.shape[0], args.latent),
+                                   ctx=ctx)
+            with autograd.record():
+                xhat, mu, logvar = net(x, eps)
+                bce = -(x * (xhat + 1e-7).log() +
+                        (1 - x) * (1 - xhat + 1e-7).log()).sum(axis=1)
+                kl = -0.5 * (1 + logvar - mu * mu -
+                             logvar.exp()).sum(axis=1)
+                loss = (bce + kl).mean()
+            loss.backward()
+            trainer.step(x.shape[0])
+            total += float(loss.asnumpy())
+            n += 1
+        if first is None:
+            first = total / n
+        last = total / n
+        logging.info("epoch %d ELBO loss %.2f", epoch, last)
+    assert last < first, "ELBO loss should decrease"
+    # decode a prior sample
+    z = mx.random.normal(0, 1, (4, args.latent), ctx=ctx)
+    gen = net.dec(z)
+    logging.info("prior samples decoded: %s", gen.shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,10 +49,18 @@ class SVRGModule(Module):
             raise MXNetError("update_freq must be a positive int (epochs "
                              "between full-gradient snapshots)")
         self.update_freq = update_freq
-        # shadow module evaluating gradients at the snapshot weights w~
+        # shadow module evaluating gradients at the snapshot weights w~;
+        # MUST mirror every construction option that shapes the param
+        # list, or the positional grad zip in _update_svrg_gradients
+        # pairs different parameters
         self._mod_aux = Module(symbol, data_names=data_names,
                                label_names=label_names, logger=logger,
-                               context=context, group2ctxs=group2ctxs)
+                               context=context,
+                               work_load_list=work_load_list,
+                               fixed_param_names=fixed_param_names,
+                               state_names=state_names,
+                               group2ctxs=group2ctxs,
+                               compression_params=compression_params)
         self._param_dict = None   # mu: full gradient at w~, per param
 
     # -- lifecycle --------------------------------------------------------
@@ -148,6 +156,7 @@ class SVRGModule(Module):
             nbatch += 1
         if nbatch == 0:
             raise MXNetError("update_full_grads: empty data iterator")
+        train_data.reset()  # leave the iterator ready for the epoch loop
         from ...ndarray.ndarray import NDArray
 
         self._param_dict = {
@@ -165,12 +174,16 @@ class SVRGModule(Module):
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None):
         """Module.fit with a full-gradient snapshot every
-        ``update_freq`` epochs (reference SVRGModule.fit)."""
-        from ... import metric as metric_mod
+        ``update_freq`` epochs (reference SVRGModule.fit).
+
+        The training loop itself is `BaseModule.fit`, run one epoch at a
+        time so the snapshot can be injected between epochs — no
+        duplicated loop to drift from the base implementation."""
         from ...initializer import Uniform
 
         if num_epoch is None:
             raise MXNetError("num_epoch is required for fit()")
+        # bind + init up front (the base fit calls below then no-op)
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
@@ -180,48 +193,24 @@ class SVRGModule(Module):
                          arg_params=arg_params, aux_params=aux_params,
                          allow_missing=allow_missing, force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                            optimizer_params=optimizer_params)
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+                            optimizer_params=dict(optimizer_params)
+                            if not isinstance(optimizer_params, dict)
+                            else optimizer_params)
         for epoch in range(begin_epoch, num_epoch):
             if (epoch - begin_epoch) % self.update_freq == 0:
                 self.update_full_grads(train_data)
-            eval_metric.reset()
-            train_data.reset()
-            for nbatch, batch in enumerate(train_data):
-                self.forward(batch, is_train=True)
-                self.update_metric(eval_metric, batch.label)
-                self.backward()
-                self.update()
-                if batch_end_callback is not None:
-                    from ...model import BatchEndParam
-
-                    cbs = batch_end_callback \
-                        if isinstance(batch_end_callback, (list, tuple)) \
-                        else [batch_end_callback]
-                    param = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                          eval_metric=eval_metric,
-                                          locals=locals())
-                    for cb in cbs:
-                        cb(param)
-            self.logger.info("Epoch[%d] Train-%s=%f", epoch,
-                             *eval_metric.get())
-            if epoch_end_callback is not None:
-                arg, auxp = self.get_params()
-                cbs = epoch_end_callback \
-                    if isinstance(epoch_end_callback, (list, tuple)) \
-                    else [epoch_end_callback]
-                for cb in cbs:
-                    cb(epoch, self.symbol, arg, auxp)
-            if eval_data is not None:
-                vm = validation_metric or eval_metric
-                res = self.score(eval_data, vm,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
+            super(SVRGModule, self).fit(
+                train_data, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=optimizer, optimizer_params=optimizer_params,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback,
+                initializer=initializer or Uniform(0.01),
+                arg_params=None, aux_params=None, allow_missing=False,
+                force_rebind=False, force_init=False, begin_epoch=epoch,
+                num_epoch=epoch + 1,
+                validation_metric=validation_metric, monitor=monitor)
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
         super(SVRGModule, self).prepare(data_batch, sparse_row_id_fn)

@@ -76,13 +76,23 @@ class _TokenEmbedding(_vocab.Vocabulary):
                 "reference would download it)" % path)
         loaded: Dict[str, np.ndarray] = {}
         vec_len = None
+
+        def _is_header(parts):
+            # fastText header: exactly two integer fields ("N dim")
+            if len(parts) != 2:
+                return False
+            try:
+                int(parts[0]), int(parts[1])
+                return True
+            except ValueError:
+                return False
+
         with io.open(path, "r", encoding=encoding) as f:
             for lineno, line in enumerate(f):
                 parts = line.rstrip().split(elem_delim)
-                if len(parts) <= 2:
-                    # fastText-style header "N dim" (or malformed line)
-                    if lineno == 0:
-                        continue
+                if lineno == 0 and _is_header(parts):
+                    continue
+                if len(parts) < 2:
                     logging.getLogger(__name__).warning(
                         "skipping malformed line %d of %s", lineno, path)
                     continue
@@ -104,12 +114,15 @@ class _TokenEmbedding(_vocab.Vocabulary):
             raise ValueError("no vectors found in %r" % path)
         self._vec_len = vec_len
         # fill by token so pre-indexed tokens (a Vocabulary counter, the
-        # unknown token appearing in the file) get their file vectors too
-        table = np.zeros((len(self._idx_to_token), vec_len), np.float32)
+        # unknown token appearing in the file) get their file vectors;
+        # indexed tokens ABSENT from the file get the unknown vector,
+        # consistent with index 0 and with _from_vocabulary
+        unk = np.asarray(loaded.get(self._unknown_token,
+                                    init_unknown_vec(vec_len)), np.float32)
+        table = np.tile(unk, (len(self._idx_to_token), 1))
         for token, vec in loaded.items():
             table[self._token_to_idx[token]] = vec
-        table[0] = loaded.get(self._unknown_token,
-                              init_unknown_vec(vec_len))
+        table[0] = unk
         self._idx_to_vec = nd_array(table)
 
     # -- queries ----------------------------------------------------------

@@ -90,6 +90,13 @@ class _RNNLayer(HybridBlock):
         return states
 
     def __call__(self, inputs, states=None):
+        if self._input_size == 0 and hasattr(inputs, "shape"):
+            # deferred input size (reference rnn_layer infers it on the
+            # first forward): complete the i2h weight shapes now
+            ni = inputs.shape[self._layout.find("C")]
+            if ni:
+                self._input_size = ni
+                self._finish_shape(ni)
         if states is None:
             batch = inputs.shape[self._layout.find("N")]
             states = self.begin_state(batch, ctx=inputs.ctx)

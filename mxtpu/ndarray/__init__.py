@@ -21,6 +21,9 @@ from .ndarray import (
     split,
     moveaxis,
     waitall,
+    from_dlpack,
+    to_dlpack_for_read,
+    to_dlpack_for_write,
     save,
     load,
     from_numpy,
@@ -72,3 +75,17 @@ for _name in dir(_this):
     if _name.startswith("_image_"):
         setattr(image, _name[len("_image_"):], getattr(_this, _name))
 _sys.modules[image.__name__] = image
+
+
+def _alias_late_op(_name, _opdef):
+    # keep the prefix-stripped sub-namespaces in sync with ops
+    # registered after this package imported
+    for prefix, ns in (("_contrib_", contrib), ("_linalg_", linalg),
+                       ("_image_", image)):
+        if _name.startswith(prefix):
+            setattr(ns, _name[len(prefix):], getattr(_this, _name))
+
+
+from ..ops import registry as _late_reg  # noqa: E402
+
+_late_reg.add_post_register_hook(_alias_late_op)

@@ -51,6 +51,9 @@ __all__ = [
     "load",
     "from_numpy",
     "from_jax",
+    "from_dlpack",
+    "to_dlpack_for_read",
+    "to_dlpack_for_write",
 ]
 
 
@@ -142,6 +145,29 @@ class NDArray(object):
 
     def asnumpy(self) -> np.ndarray:
         return np.asarray(self.wait_to_read()._data)
+
+    def to_dlpack_for_read(self):
+        """Zero-copy DLPack capsule over the device buffer (reference
+        `MXNDArrayToDLPackForRead`, `include/mxnet/c_api.h`).  Works
+        with any DLPack consumer, e.g.
+        ``torch.utils.dlpack.from_dlpack``."""
+        return self.wait_to_read()._data.__dlpack__()
+
+    def to_dlpack_for_write(self):
+        """Reference `MXNDArrayToDLPackForWrite`.  jax.Array buffers
+        are immutable, so writable export cannot be honored — the
+        reference's in-place-mutation contract would corrupt the XLA
+        buffer cache.  Raises with the supported alternative."""
+        raise MXNetError(
+            "to_dlpack_for_write is not supported: XLA device buffers "
+            "are immutable. Export with to_dlpack_for_read, mutate in "
+            "the consumer framework, and re-import with nd.from_dlpack")
+
+    def __dlpack__(self, **kwargs):
+        return self.wait_to_read()._data.__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self.wait_to_read()._data.__dlpack_device__()
 
     def asscalar(self):
         if self.size != 1:
@@ -787,3 +813,25 @@ def load(fname: str):
             return [array(zf[n]) for n in names_sorted]
         except ValueError:
             return {n: array(zf[n]) for n in names}
+
+
+def from_dlpack(ext_tensor) -> NDArray:
+    """Construct an NDArray from any DLPack producer — a capsule from
+    `to_dlpack_for_read`, or an object with `__dlpack__` (torch/numpy/
+    cupy tensors).  Zero-copy when the producer lives on a compatible
+    device (reference `MXNDArrayFromDLPack`)."""
+    import jax.numpy as jnp
+
+    return NDArray(jnp.from_dlpack(ext_tensor), _committed=True)
+
+
+def to_dlpack_for_read(data: NDArray):
+    """Module-level mirror of `NDArray.to_dlpack_for_read` (reference
+    `mx.nd.to_dlpack_for_read`)."""
+    return data.to_dlpack_for_read()
+
+
+def to_dlpack_for_write(data: NDArray):
+    """Module-level mirror of `NDArray.to_dlpack_for_write` — always
+    raises; see the method docstring."""
+    return data.to_dlpack_for_write()

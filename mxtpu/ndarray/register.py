@@ -41,3 +41,8 @@ def _init_op_module(target_module):
         public_name = name
         setattr(target_module, public_name, _make_ndarray_function(public_name,
                                                                   opdef))
+    # ops registered after this module initialized (late imports, user
+    # registrations) still get nd.* functions
+    _reg.add_post_register_hook(
+        lambda n, od: setattr(target_module, n,
+                              _make_ndarray_function(n, od)))

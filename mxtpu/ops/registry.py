@@ -34,6 +34,17 @@ __all__ = ["OpDef", "register", "get_op", "list_ops", "invoke_jax", "canonical_a
 
 _OP_REGISTRY: Dict[str, "OpDef"] = {}
 
+# Called with (name, opdef) for every registration AFTER the hook was
+# installed.  The nd/sym composer modules install one so ops registered
+# late — e.g. a module whose import was triggered mid-way through
+# ops/__init__, or a user registering at runtime — still get their
+# nd.*/sym.* functions.
+_POST_REGISTER_HOOKS: List[Callable[[str, "OpDef"], None]] = []
+
+
+def add_post_register_hook(hook: Callable[[str, "OpDef"], None]):
+    _POST_REGISTER_HOOKS.append(hook)
+
 
 class OpDef(object):
     """A registered operator.
@@ -127,6 +138,10 @@ def register(
             if a in _OP_REGISTRY:
                 raise MXNetError("op alias %r already registered" % a)
             _OP_REGISTRY[a] = opdef
+        for hook in _POST_REGISTER_HOOKS:
+            hook(name, opdef)
+            for a in aliases:
+                hook(a, opdef)
         return fn
 
     return deco

@@ -24,3 +24,17 @@ for _name in dir(_this):
         setattr(linalg, _name[len("_linalg_"):], getattr(_this, _name))
 _sys.modules[contrib.__name__] = contrib
 _sys.modules[linalg.__name__] = linalg
+
+
+def _alias_late_op(_name, _opdef):
+    # keep the prefix-stripped sub-namespaces in sync with ops
+    # registered after this package imported
+    if _name.startswith("_contrib_"):
+        setattr(contrib, _name[len("_contrib_"):], getattr(_this, _name))
+    elif _name.startswith("_linalg_"):
+        setattr(linalg, _name[len("_linalg_"):], getattr(_this, _name))
+
+
+from ..ops import registry as _reg  # noqa: E402
+
+_reg.add_post_register_hook(_alias_late_op)

@@ -99,3 +99,8 @@ def _init_symbol_module(target_module):
             continue
         seen.add(op_name)
         setattr(target_module, op_name, _make_symbol_function(opdef))
+    # ops registered after this module initialized (late imports, user
+    # registrations) still get composers
+    _reg.add_post_register_hook(
+        lambda name, od: setattr(target_module, name,
+                                 _make_symbol_function(od)))

@@ -108,6 +108,20 @@ def test_embedding_with_vocab_counter_gets_file_vectors(tmp_path):
     emb2 = ctext.embedding.CustomEmbedding(p2)
     np.testing.assert_allclose(
         emb2.get_vecs_by_tokens("never-seen").asnumpy(), [8, 8])
+    # vocab tokens ABSENT from the file get the configured unknown vec
+    emb3 = ctext.embedding.CustomEmbedding(
+        p, counter=Counter({"onlyvocab": 1}), init_unknown_vec=np.ones)
+    np.testing.assert_allclose(
+        emb3.get_vecs_by_tokens("onlyvocab").asnumpy(), [1, 1, 1])
+    # 1-dimensional embedding files load (2-part lines are data, not a
+    # fastText header — the header must be two integers)
+    p3 = os.path.join(str(tmp_path), "e1d.txt")
+    with open(p3, "w") as f:
+        f.write("a 0.5\nb 1.5\n")
+    emb4 = ctext.embedding.CustomEmbedding(p3)
+    assert emb4.vec_len == 1
+    np.testing.assert_allclose(
+        emb4.get_vecs_by_tokens(["a", "b"]).asnumpy(), [[0.5], [1.5]])
 
 
 def test_composite_embedding_and_registry(tmp_path):

@@ -87,3 +87,76 @@ def test_sparse_linear_classification_dist(tmp_path):
         capture_output=True, text=True, timeout=560, env=env, cwd=REPO)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert r.stdout.count("FINAL_ACCURACY") == 2
+
+
+# ---------------------------------------------------------------------------
+# breadth suite: one fast smoke per example family (SURVEY Appendix D)
+# ---------------------------------------------------------------------------
+
+def _run_example(relpath, *extra, timeout=560):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, os.path.join(REPO, "examples", relpath)] + \
+        list(extra)
+    r = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=timeout, env=env, cwd=REPO)
+    assert r.returncode == 0, "rc=%d\nstdout:%s\nstderr:%s" % (
+        r.returncode, r.stdout[-2000:], r.stderr[-2000:])
+    return r.stdout + r.stderr
+
+
+def test_example_fgsm_adversary():
+    out = _run_example("adversary/fgsm_mnist.py", "--epochs", "1",
+                       "--batch-size", "32")
+    assert "FGSM" in out
+
+
+def test_example_autoencoder():
+    out = _run_example("autoencoder/conv_autoencoder.py", "--epochs", "2",
+                       "--batch-size", "64")
+    assert "reconstruction loss" in out
+
+
+def test_example_text_cnn():
+    out = _run_example("cnn_text_classification/text_cnn.py",
+                       "--epochs", "4")
+    assert "train accuracy" in out
+
+
+def test_example_matrix_factorization():
+    out = _run_example("recommenders/matrix_factorization.py",
+                       "--epochs", "5")
+    assert "MSE" in out
+
+
+def test_example_multitask():
+    out = _run_example("multi-task/multitask_mnist.py", "--epochs", "3")
+    assert "parity-acc" in out
+
+
+def test_example_custom_softmax():
+    out = _run_example("numpy-ops/custom_softmax.py", "--epochs", "3")
+    assert "custom softmax" in out
+
+
+def test_example_model_parallel_mesh():
+    out = _run_example("model-parallel/mesh_model_parallel.py",
+                       "--steps", "6")
+    assert "per-device W1 shard shape" in out
+
+
+def test_example_svm():
+    out = _run_example("svm_mnist/svm_mnist.py", "--epochs", "3")
+    assert "SVM" in out
+
+
+def test_example_svrg():
+    out = _run_example("svrg_module/svrg_linear_regression.py",
+                       "--epochs", "12")
+    assert "SVRG final MSE" in out
+
+
+def test_example_quantization():
+    out = _run_example("quantization/quantize_model.py", "--epochs", "2")
+    assert "int8" in out

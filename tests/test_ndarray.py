@@ -178,3 +178,24 @@ def test_random_reproducible():
 def test_one_hot():
     out = nd.one_hot(nd.array([0, 2]), depth=3)
     np.testing.assert_allclose(out.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_dlpack_interchange():
+    """DLPack export/import (reference MXNDArrayToDLPackForRead /
+    MXNDArrayFromDLPack): zero-copy round trips with torch and numpy."""
+    torch = pytest.importorskip("torch")
+
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    # export -> torch
+    t = torch.utils.dlpack.from_dlpack(x.to_dlpack_for_read())
+    np.testing.assert_allclose(t.numpy(), x.asnumpy())
+    # torch -> import
+    back = mx.nd.from_dlpack(torch.arange(4, dtype=torch.float32))
+    assert isinstance(back, mx.nd.NDArray)
+    np.testing.assert_allclose(back.asnumpy(), [0, 1, 2, 3])
+    # protocol path: any __dlpack__ consumer sees the NDArray directly
+    t2 = torch.utils.dlpack.from_dlpack(x)
+    np.testing.assert_allclose(t2.numpy(), x.asnumpy())
+    # writable export is refused loudly (immutable XLA buffers)
+    with pytest.raises(mx.base.MXNetError):
+        x.to_dlpack_for_write()
