@@ -32,9 +32,67 @@ MXTPU_PEAK_TFLOPS.
 """
 import json
 import os
+import subprocess
+import sys
 import time
 
 BASELINE_TRAIN_IMGS_PER_SEC = 298.51     # 1xV100 fp32 bs=32 (training)
+_START = time.time()
+# skip remaining extra configs once this much wall time is spent — the
+# driver kills long benches; a partial JSON line beats rc=143
+BUDGET_S = float(os.environ.get("MXTPU_BENCH_BUDGET_S", "1500"))
+TPU_WAIT_S = float(os.environ.get("MXTPU_BENCH_TPU_WAIT", "900"))
+
+
+def _probe_tpu(timeout=150):
+    """Try one tiny op on the accelerator in a SUBPROCESS — a wedged
+    tunnel hangs forever in-process, a subprocess can be timed out.
+    Returns 'ok', 'no_tpu' (no accelerator platform at all — fails in
+    seconds), or 'wedged' (hung until the timeout)."""
+    code = ("import jax, sys\n"
+            "ds = jax.devices()\n"
+            "if all(d.platform == 'cpu' for d in ds):\n"
+            "    sys.exit(3)\n"
+            "import jax.numpy as jnp\n"
+            "jnp.ones((8, 8)).sum().block_until_ready()\n"
+            "print('ok')\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout)
+        if r.returncode == 0 and "ok" in r.stdout:
+            return "ok"
+        if r.returncode == 3:
+            return "no_tpu"
+        return "wedged"
+    except subprocess.TimeoutExpired:
+        return "wedged"
+
+
+def wait_for_tpu():
+    """Retry the probe until the tunnel answers or TPU_WAIT_S elapses
+    (the round-3 bench died to a transient outage; don't repeat that).
+    A host with NO accelerator platform bails immediately — only a
+    wedged/flapping tunnel is worth waiting out.  Returns True when the
+    accelerator is usable."""
+    deadline = _START + TPU_WAIT_S
+    attempt = 0
+    while True:
+        state = _probe_tpu()
+        if state == "ok":
+            return True
+        if state == "no_tpu":
+            return False
+        attempt += 1
+        if time.time() > deadline:
+            return False
+        print("# TPU probe %d failed (%s); retrying (%.0fs left)"
+              % (attempt, state, deadline - time.time()), file=sys.stderr)
+        time.sleep(min(60, max(5, deadline - time.time())))
+
+
+def _budget_left():
+    return BUDGET_S - (time.time() - _START)
 BATCH = int(os.environ.get("MXTPU_BENCH_BATCH", "32"))
 WARMUP = int(os.environ.get("MXTPU_BENCH_WARMUP", "2"))
 ITERS = int(os.environ.get("MXTPU_BENCH_ITERS", "8"))
@@ -145,6 +203,19 @@ def _mfu(ips):
 
 
 def main():
+    global SPP, ITERS, WINDOWS, WARMUP
+    tpu_ok = wait_for_tpu()
+    extra = {"steps_per_program": SPP}
+    if not tpu_ok:
+        # the accelerator tunnel is down: report a degraded CPU run
+        # rather than rc!=0 with no record (round-3 failure mode)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        SPP, ITERS, WINDOWS, WARMUP = 2, 1, 1, 1
+        extra["degraded"] = "tpu_unavailable_after_%ds_cpu_fallback" \
+            % int(TPU_WAIT_S)
+        extra["steps_per_program"] = SPP
     fp32, fp32_windows = run_config(BATCH, "float32")
     result = {
         "metric": "resnet50_train_imgs_per_sec_bs%d" % BATCH,
@@ -152,17 +223,19 @@ def main():
         "unit": "images/sec",
         "vs_baseline": round(fp32 / BASELINE_TRAIN_IMGS_PER_SEC, 3),
     }
-    if not SKIP_EXTRA:
-        extra = {
+    if not SKIP_EXTRA and tpu_ok:
+        extra.update({
             "fp32_bs%d_mfu" % BATCH: _mfu(fp32),
             "fp32_bs%d_windows" % BATCH: [round(w, 1)
                                           for w in fp32_windows],
-            "steps_per_program": SPP,
-        }
+        })
         configs = [(BATCH, "bfloat16")]
         if BATCH != 128:
             configs.append((128, "bfloat16"))
         for batch, dtype in configs:
+            if _budget_left() < 240:
+                extra["truncated_at"] = "bf16_bs%d" % batch
+                break
             ips, wins = run_config(batch, dtype)
             extra["bf16_bs%d_imgs_per_sec" % batch] = round(ips, 2)
             extra["bf16_bs%d_mfu" % batch] = _mfu(ips)
@@ -171,20 +244,24 @@ def main():
         # layout A/B: channels-last conv internals (VERDICT r2 ask #1a).
         # Save/restore any user-set layout so (a) the baseline runs above
         # really were that layout, (b) later measurements see it again.
-        prior_layout = os.environ.get("MXTPU_CONV_LAYOUT")
-        os.environ["MXTPU_CONV_LAYOUT"] = "NHWC"
-        try:
-            ips_cl, _ = run_config(128, "bfloat16")
-            extra["bf16_bs128_nhwc_imgs_per_sec"] = round(ips_cl, 2)
-            extra["bf16_bs128_nhwc_mfu"] = _mfu(ips_cl)
-        finally:
-            if prior_layout is None:
-                os.environ.pop("MXTPU_CONV_LAYOUT", None)
-            else:
-                os.environ["MXTPU_CONV_LAYOUT"] = prior_layout
-        extra["fp32_bs%d_per_step_dispatch" % BATCH] = round(
-            run_per_step_fp32(BATCH), 2)
-        result["extra"] = extra
+        if _budget_left() >= 240:
+            prior_layout = os.environ.get("MXTPU_CONV_LAYOUT")
+            os.environ["MXTPU_CONV_LAYOUT"] = "NHWC"
+            try:
+                ips_cl, _ = run_config(128, "bfloat16")
+                extra["bf16_bs128_nhwc_imgs_per_sec"] = round(ips_cl, 2)
+                extra["bf16_bs128_nhwc_mfu"] = _mfu(ips_cl)
+            finally:
+                if prior_layout is None:
+                    os.environ.pop("MXTPU_CONV_LAYOUT", None)
+                else:
+                    os.environ["MXTPU_CONV_LAYOUT"] = prior_layout
+        else:
+            extra.setdefault("truncated_at", "nhwc_ab")
+        if _budget_left() >= 180:
+            extra["fp32_bs%d_per_step_dispatch" % BATCH] = round(
+                run_per_step_fp32(BATCH), 2)
+    result["extra"] = extra
     print(json.dumps(result))
 
 

@@ -40,6 +40,40 @@ __all__ = ["Executor"]
 
 _BN_OPS = {"BatchNorm", "BatchNorm_v1", "_contrib_SyncBatchNorm"}
 
+_REMAT_POLICIES = {
+    # save matmul/conv outputs, recompute elementwise chains — the
+    # TPU-idiomatic middle ground (FLOPs are cheap, HBM is not)
+    "dots": "dots_saveable",
+    "dots_no_batch": "dots_with_no_batch_dims_saveable",
+    # recompute EVERYTHING in backward (max memory savings)
+    "full": None,
+}
+
+
+def _maybe_remat(fn):
+    """Gradient-checkpoint the whole-graph function when
+    MXTPU_BACKWARD_DO_MIRROR / MXNET_BACKWARD_DO_MIRROR is set — the
+    analog of the reference's mirror pass
+    (`src/executor/graph_executor.cc:134-283`), built on `jax.checkpoint`
+    so XLA rematerializes activations during the backward instead of
+    holding them in HBM.  MXTPU_REMAT_POLICY picks what IS saved:
+    'full' (default; save nothing), 'dots', or 'dots_no_batch'."""
+    import os
+
+    flag = os.environ.get("MXTPU_BACKWARD_DO_MIRROR",
+                          os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0"))
+    if flag not in ("1", "true", "True"):
+        return fn
+    import jax
+
+    policy_name = os.environ.get("MXTPU_REMAT_POLICY", "full")
+    if policy_name not in _REMAT_POLICIES:
+        raise MXNetError("MXTPU_REMAT_POLICY must be one of %s"
+                         % sorted(_REMAT_POLICIES))
+    attr = _REMAT_POLICIES[policy_name]
+    policy = getattr(jax.checkpoint_policies, attr) if attr else None
+    return jax.checkpoint(fn, policy=policy)
+
 
 def _build_graph_fn(symbol: Symbol, arg_names: List[str],
                     aux_names: List[str], is_train: bool):
@@ -57,7 +91,7 @@ def _build_graph_fn(symbol: Symbol, arg_names: List[str],
     arg_pos = {n: i for i, n in enumerate(arg_names)}
     aux_pos = {n: i for i, n in enumerate(aux_names)}
 
-    def graph_fn(arg_vals, aux_vals, key):
+    def graph_fn_impl(arg_vals, aux_vals, key):
         env: Dict[Tuple[int, int], Any] = {}
         aux_new = list(aux_vals)
         rng_i = 0
@@ -119,7 +153,9 @@ def _build_graph_fn(symbol: Symbol, arg_names: List[str],
             outputs = [env[(id(n), i)] for n, i in symbol._outputs]
         return outputs, aux_new
 
-    return graph_fn
+    # the mirror/remat hook lives HERE so every consumer of the training
+    # graph fn (Executor, CachedOp, FusedTrainLoop) honors it uniformly
+    return _maybe_remat(graph_fn_impl) if is_train else graph_fn_impl
 
 
 class Executor(object):

@@ -21,6 +21,7 @@ from . import contrib  # noqa: F401
 from . import vision  # noqa: F401
 from . import rcnn  # noqa: F401
 from . import dgl  # noqa: F401
+from . import pallas_attention  # noqa: F401
 from . import image  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import quantization  # noqa: F401

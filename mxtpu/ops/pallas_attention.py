@@ -1,0 +1,248 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The framework's hottest non-conv op.  XLA's generic softmax-attention
+materializes the (T, T) score matrix in HBM; this kernel streams K/V
+blocks through VMEM with the online log-sum-exp rescaling of flash
+attention (Dao et al. 2022), so HBM traffic is O(T·d) instead of
+O(T²).  The grid is (batch·heads, q_blocks, k_blocks) with the k axis
+innermost — TPU grids execute sequentially, so VMEM scratch
+(accumulator + running max/sum) carries state across the k sweep and
+the output block is written once on the last k step.
+
+`flash_attention` is the public entry: it pads ragged sequence lengths
+to the block size, runs the kernel on TPU (or in interpreter mode for
+CPU tests — `MXTPU_PALLAS_INTERPRET=1`), and falls back to a fused
+jnp reference implementation elsewhere.  The backward pass is a
+`jax.custom_vjp` using the standard recomputation formulation (XLA
+fuses it well; a Pallas backward is a further optimization, not a
+correctness need).
+
+Registered as `_contrib_flash_attention` (q, k, v of shape
+(batch, heads, seq, head_dim)).  `mxtpu.parallel`'s blockwise /
+ring attention can route its local-chunk compute here with
+MXTPU_USE_PALLAS=1.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+from ..base import MXNetError
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+def _use_pallas():
+    if os.environ.get("MXTPU_PALLAS_INTERPRET", "0") == "1":
+        return True
+    if os.environ.get("MXTPU_NO_PALLAS", "0") == "1":
+        return False
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _interpret():
+    return os.environ.get("MXTPU_PALLAS_INTERPRET", "0") == "1"
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  sm_scale, causal, block_q, block_k):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)          # q block
+    j = pl.program_id(2)          # k block (innermost, sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * sm_scale   # (bq, d)
+        k = k_ref[0].astype(jnp.float32)              # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T)                           # (bq, bk) on MXU
+        if causal:
+            q_idx = jnp.arange(block_q)[:, None] + i * block_q
+            k_idx = jnp.arange(block_k)[None, :] + j * block_k
+            s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
+        m_prev = m_ref[:, 0:1]                        # (bq, 1)
+        l_prev = l_ref[:, 0:1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)     # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                        # (bq, bk)
+        alpha = jnp.exp(m_prev - m_new)               # rescale old state
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(p, v)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # skip k blocks entirely above the causal diagonal
+        pl.when(j * block_k <= (i + 1) * block_q - 1)(_step)
+    else:
+        _step()
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finish():
+        l = l_ref[:, 0:1]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)) \
+            .astype(o_ref.dtype)
+
+
+import jax  # noqa: E402  (module level: custom_vjp decorates at import)
+
+
+def _flash_forward_pallas(q, k, v, sm_scale, causal, block_q, block_k):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    grid = (bh, pl.cdiv(tq, block_q), pl.cdiv(tk, block_k))
+    kernel = functools.partial(_flash_kernel, sm_scale=sm_scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),     # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+def _reference_attention(q, k, v, sm_scale, causal):
+    """Fused jnp reference (also the CPU/GPU fallback path)."""
+    import jax.numpy as jnp
+
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        tq, tk = s.shape[-2:]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)) \
+        .astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k):
+    if _use_pallas():
+        tq, tk = q.shape[1], k.shape[1]
+        pq = (-tq) % block_q
+        pk = (-tk) % block_k
+        # INVARIANT: the kernel never sees padded KEY positions (a
+        # padded key would need per-position masking inside the
+        # kernel); ragged K lengths take the fused reference path.
+        # Ragged Q is safe — padded query rows are sliced off.
+        if pk:
+            return _reference_attention(q, k, v, sm_scale, causal)
+        if pq:
+            import jax.numpy as jnp
+
+            qp = jnp.pad(q, ((0, 0), (0, pq), (0, 0)))
+            out = _flash_forward_pallas(qp, k, v, sm_scale, causal,
+                                        block_q, block_k)
+            return out[:, :tq]
+        return _flash_forward_pallas(q, k, v, sm_scale, causal,
+                                     block_q, block_k)
+    return _reference_attention(q, k, v, sm_scale, causal)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    out = _flash(q, k, v, sm_scale, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
+    """Standard recompute backward (flash attention paper, eqs. 13-16):
+    XLA fuses the recomputation; activations are never stored."""
+    import jax.numpy as jnp
+
+    q, k, v = res
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        tq, tk = s.shape[-2:]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    g32 = g.astype(jnp.float32)
+    dv = jnp.einsum("bqk,bqd->bkd", p, g32)
+    dp = jnp.einsum("bqd,bkd->bqk", g32, v.astype(jnp.float32))
+    delta = jnp.sum(p * dp, axis=-1, keepdims=True)
+    ds = p * (dp - delta) * sm_scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, sm_scale=None, causal=False, block_q=128,
+                    block_k=128):
+    """Multi-head attention, flash-style.
+
+    q/k/v: (batch, heads, seq, head_dim) or (batch*heads, seq,
+    head_dim).  Returns the same layout as the input.
+    """
+    import jax.numpy as jnp
+
+    squeeze4 = q.ndim == 4
+    if squeeze4:
+        b, h, t, d = q.shape
+        q = q.reshape(b * h, t, d)
+        k = k.reshape(b * h, k.shape[2], d)
+        v = v.reshape(b * h, v.shape[2], d)
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    # clamp blocks to the sequence lengths (tiny test shapes)
+    block_q = int(min(block_q, q.shape[1]))
+    block_k = int(min(block_k, k.shape[1]))
+    out = _flash(q, k, v, float(sm_scale), bool(causal), block_q,
+                 block_k)
+    if squeeze4:
+        out = out.reshape(b, h, t, d)
+    return out
+
+
+@register("_contrib_flash_attention")
+def _contrib_flash_attention(q, k, v, sm_scale=None, causal=False,
+                             block_q=128, block_k=128):
+    """Flash attention op over (batch, heads, seq, head_dim) inputs
+    (kernel above; reference has no analog — attention in MXNet 1.5 is
+    composed from batch_dot/softmax, which materializes the score
+    matrix)."""
+    if q.ndim != 4:
+        raise MXNetError("_contrib_flash_attention expects "
+                         "(batch, heads, seq, head_dim)")
+    return flash_attention(q, k, v, sm_scale=sm_scale, causal=causal,
+                           block_q=block_q, block_k=block_k)

@@ -79,6 +79,16 @@ def blockwise_attention(q, k, v, block_size: int = 512,
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    # opt-in Pallas kernel for the square self-attention case (the
+    # kernel's causal mask assumes aligned q/k positions; the decode
+    # and shard_map-collective paths keep the jnp formulation)
+    import os
+
+    if Tq == Tk and os.environ.get("MXTPU_USE_PALLAS", "0") == "1":
+        from ..ops.pallas_attention import flash_attention
+
+        return flash_attention(q, k, v, sm_scale=scale, causal=causal,
+                               block_k=block_size)
     block_size = min(block_size, Tk)
     n_blocks = (Tk + block_size - 1) // block_size
     pad = n_blocks * block_size - Tk

@@ -212,3 +212,56 @@ def test_conv_layout_flag_equivalence(monkeypatch):
     for k in nchw:
         np.testing.assert_allclose(nchw[k], nhwc[k], rtol=1e-4,
                                    atol=1e-5, err_msg=k)
+
+
+def test_backward_do_mirror_remat_equivalence(monkeypatch):
+    """MXTPU_BACKWARD_DO_MIRROR=1 gradient-checkpoints the fused step
+    (reference MXNET_BACKWARD_DO_MIRROR mirror pass,
+    graph_executor.cc:134-283): numerics must match the non-remat path
+    exactly — only the backward's memory/compute schedule changes."""
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import sym
+
+    def run():
+        data = sym.Variable("data")
+        h = sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                            pad=(1, 1), name="c1")
+        h = sym.Activation(h, act_type="relu")
+        h = sym.FullyConnected(sym.Flatten(h), num_hidden=8, name="f1")
+        out = sym.SoftmaxOutput(h, sym.Variable("softmax_label"),
+                                name="softmax")
+        exe = out.simple_bind(ctx=mx.cpu(), grad_req="write",
+                              data=(2, 3, 8, 8), softmax_label=(2,))
+        rng = np.random.RandomState(0)
+        for name, arr in exe.arg_dict.items():
+            if name not in ("data", "softmax_label"):
+                arr._set_jax(mx.nd.array(
+                    rng.uniform(-0.5, 0.5, arr.shape)
+                    .astype(np.float32))._data)
+        x = rng.uniform(-1, 1, (2, 3, 8, 8)).astype(np.float32)
+        y = np.array([1.0, 3.0], np.float32)
+        outs = exe.forward(is_train=True, data=mx.nd.array(x),
+                           softmax_label=mx.nd.array(y))
+        exe.backward()
+        return (outs[0].asnumpy(),
+                {k: v.asnumpy() for k, v in exe.grad_dict.items()
+                 if v is not None})
+
+    # the baseline must really be the non-remat path even if the shell
+    # exports the mirror flag
+    for var in ("MXTPU_BACKWARD_DO_MIRROR", "MXNET_BACKWARD_DO_MIRROR",
+                "MXTPU_REMAT_POLICY"):
+        monkeypatch.delenv(var, raising=False)
+    base_out, base_grads = run()
+    monkeypatch.setenv("MXTPU_BACKWARD_DO_MIRROR", "1")
+    for policy in ("full", "dots"):
+        monkeypatch.setenv("MXTPU_REMAT_POLICY", policy)
+        got_out, got_grads = run()
+        np.testing.assert_allclose(got_out, base_out, rtol=1e-6,
+                                   atol=1e-7)
+        for k in base_grads:
+            np.testing.assert_allclose(got_grads[k], base_grads[k],
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg="%s/%s" % (policy, k))

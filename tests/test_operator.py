@@ -115,6 +115,8 @@ SKIP = {
         "dgl: tests/test_rcnn_dgl.py",
     "_contrib_dgl_graph_compact": "dgl: tests/test_rcnn_dgl.py",
     "_subgraph_exec": "subgraph framework: tests/test_subgraph.py",
+    "_contrib_flash_attention":
+        "pallas kernel: tests/test_pallas_attention.py",
 }
 
 
