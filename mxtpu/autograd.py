@@ -122,15 +122,22 @@ class TapeNode(object):
         "out_avals",
         "n_outputs",
         "saved",
+        "fwd",
     )
 
-    def __init__(self, op_name, vjp_fn, input_entries, out_avals):
+    def __init__(self, op_name, vjp_fn, input_entries, out_avals,
+                 fwd=None):
         self.op_name = op_name
         self.vjp_fn = vjp_fn
         self.input_entries = input_entries
         self.out_avals = out_avals  # list of (shape, dtype)
         self.n_outputs = len(out_avals)
         self.saved = None
+        # (tupled_fn, jax_inputs): the primal computation, kept so
+        # grad(create_graph=True) can REPLAY the subgraph as a pure jax
+        # function and differentiate the differentiation (the closures
+        # hold no more than the vjp residuals already do)
+        self.fwd = fwd
 
 
 def _record_fn(name, tupled_fn, nd_inputs, jax_inputs):
@@ -159,7 +166,8 @@ def _record_fn(name, tupled_fn, nd_inputs, jax_inputs):
         return outs, None
 
     out_avals = [(tuple(o.shape), o.dtype) for o in outs]
-    node = TapeNode(name, vjp_fn, entries, out_avals)
+    node = TapeNode(name, vjp_fn, entries, out_avals,
+                    fwd=(tupled_fn, tuple(jax_inputs)))
     return outs, node
 
 
@@ -294,7 +302,9 @@ def _record_embedding_sparse(opdef, nd_inputs, jax_inputs, attrs, rng_key):
     if not tracked:
         return (out,), None
     node = TapeNode(opdef.name, vjp_fn, entries,
-                    [(tuple(out.shape), out.dtype)])
+                    [(tuple(out.shape), out.dtype)],
+                    fwd=(lambda d, w: (_emb_fwd_jit()(d, w),),
+                         (data, weight)))
     return (out,), node
 
 
@@ -404,15 +414,133 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             var._grad._set_jax(g.astype(var._grad.dtype) if g.dtype != var._grad.dtype else g)
 
 
+def _build_replay(heads, variables):
+    """Rebuild the recorded subgraph from its leaves to `heads` as a
+    PURE jax function.  Returns (replay, other_leaves): replay takes
+    (var_vals, other_vals) — values for `variables` and for every OTHER
+    tracked leaf of the subgraph.  Keeping the other leaves as function
+    arguments (not captured constants) is what lets the outer backward
+    differentiate the gradient w.r.t. them (e.g. a gradient penalty's
+    dependence on the weights).  Powers grad(create_graph=True)."""
+    head_nodes = [h._entry[0] for h in heads
+                  if getattr(h, "_entry", None) is not None]
+    order = _toposort(head_nodes)
+    for node in order:
+        if node.fwd is None:
+            raise MXNetError(
+                "create_graph=True: op %r was recorded without a "
+                "replayable forward (or its graph was already freed by "
+                "a retain_graph=False backward)" % node.op_name)
+    var_pos = {id(v): i for i, v in enumerate(variables)}
+    # an INTERMEDIATE variable (has a producer entry) is treated as an
+    # independent input at every consumption site — d(head)/d(t) holds
+    # t's producers fixed, matching the plain path's semantics
+    var_entry_pos = {}
+    for i, v in enumerate(variables):
+        ent = getattr(v, "_entry", None)
+        if ent is not None:
+            var_entry_pos[(id(ent[0]), ent[1])] = i
+    other_leaves = []
+    other_pos = {}
+    for node in order:
+        for ent in node.input_entries:
+            if ent is not None and ent[0] == "leaf":
+                v = ent[1]
+                if id(v) not in var_pos and id(v) not in other_pos:
+                    other_pos[id(v)] = len(other_leaves)
+                    other_leaves.append(v)
+
+    def replay(var_vals, other_vals):
+        env = {}
+
+        def entry_val(ent, captured):
+            if ent is None:
+                return captured
+            if ent[0] == "leaf":
+                v = ent[1]
+                if id(v) in var_pos:
+                    return var_vals[var_pos[id(v)]]
+                return other_vals[other_pos[id(v)]]
+            _, producer, idx = ent
+            vpos = var_entry_pos.get((id(producer), idx))
+            if vpos is not None:
+                return var_vals[vpos]
+            return env[id(producer)][idx]
+
+        for node in order:
+            fwd_fn, captured = node.fwd
+            vals = [entry_val(e, c)
+                    for e, c in zip(node.input_entries, captured)]
+            env[id(node)] = fwd_fn(*vals)
+        outs = []
+        for h in heads:
+            ent = getattr(h, "_entry", None)
+            if ent is None:
+                outs.append(var_vals[var_pos[id(h)]])
+            else:
+                vpos = var_entry_pos.get((id(ent[0]), ent[1]))
+                outs.append(var_vals[vpos] if vpos is not None
+                            else env[id(ent[0])][ent[1]])
+        return tuple(outs)
+
+    return replay, other_leaves
+
+
+def _grad_create_graph(heads, variables, head_grads):
+    """Differentiable gradients: replay the subgraph, vjp it, and TAPE
+    the whole gradient computation as one node — so the returned
+    gradients can themselves be backprop'd (higher-order autograd,
+    reference tests/python/unittest/test_higher_order_grad.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray import NDArray
+
+    for h in heads:
+        if getattr(h, "_entry", None) is None \
+                and not getattr(h, "_marked", False):
+            raise MXNetError(
+                "cannot differentiate a head that was not computed "
+                "under autograd.record()")
+    replay, other_leaves = _build_replay(heads, variables)
+    n_var = len(variables)
+    n_other = len(other_leaves)
+    # head_grads ride as traced ARGUMENTS (not captured constants) so a
+    # seed that itself depends on tracked values keeps its gradient
+    # path in the outer backward
+    hg_arrays = [hg for hg in head_grads if hg is not None]
+
+    def grad_fn(*vals):
+        var_vals = vals[:n_var]
+        other_vals = vals[n_var:n_var + n_other]
+        hg_vals = list(vals[n_var + n_other:])
+        seeds = tuple(
+            (hg_vals.pop(0) if hg is not None
+             else jnp.ones(h.shape, dtype=h.dtype))
+            for h, hg in zip(heads, head_grads))
+        _, vjp = jax.vjp(lambda *vv: replay(vv, other_vals), *var_vals)
+        return tuple(vjp(seeds))
+
+    all_inputs = list(variables) + list(other_leaves) + hg_arrays
+    outs, node = _record_fn("_grad", grad_fn, all_inputs,
+                            [v._data for v in all_inputs])
+    result = []
+    for i, g in enumerate(outs):
+        arr = NDArray(g, ctx=variables[i].ctx, _committed=True)
+        if node is not None:
+            arr._entry = (node, i)
+        result.append(arr)
+    return result
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """Return gradients of heads w.r.t. variables without touching ``.grad``
-    (reference: `python/mxnet/autograd.py:270`).  ``create_graph`` (higher
-    order) is not yet supported on the tape path."""
+    (reference: `python/mxnet/autograd.py:270`).  With ``create_graph``
+    the gradient computation itself is taped (replay + vjp), so the
+    results support another backward — higher-order autograd."""
     from .ndarray import NDArray
 
-    if create_graph:
-        raise MXNetError("create_graph=True is not supported yet")
     if isinstance(variables, NDArray):
         variables = [variables]
     for v in variables:
@@ -421,6 +549,16 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
                 "one of the variables was not used in the graph or not marked "
                 "with attach_grad/mark_variables"
             )
+    if create_graph:
+        if isinstance(heads, NDArray):
+            heads = [heads]
+        if head_grads is None:
+            head_grads = [None] * len(heads)
+        elif isinstance(head_grads, NDArray):
+            head_grads = [head_grads]
+        if len(heads) != len(head_grads):
+            raise MXNetError("heads and head_grads length mismatch")
+        return _grad_create_graph(heads, variables, head_grads)
     gmap = _run_backward(heads, head_grads,
                          retain_graph=bool(retain_graph),
                          extra_vars=variables)
@@ -505,6 +643,7 @@ def _run_backward(heads, head_grads=None, retain_graph=False, extra_vars=None):
                 add_leaf(ent[1], g)
         if not retain_graph:
             node.vjp_fn = None  # free residuals
+            node.fwd = None     # and the replay closure's pinned inputs
 
     if extra_vars is not None:
         from .ndarray import NDArray as _ND

@@ -1,5 +1,6 @@
 """Autograd tape tests (reference analog: tests/python/unittest/test_autograd.py)."""
 import numpy as np
+import pytest
 
 import mxtpu as mx
 from mxtpu import nd, autograd
@@ -172,3 +173,122 @@ def test_pooling_grad():
     z2.backward()
     np.testing.assert_allclose(x2.grad.asnumpy(), np.full(x2.shape, 0.25),
                                rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# higher-order autograd (create_graph) — reference
+# tests/python/unittest/test_higher_order_grad.py
+# ---------------------------------------------------------------------------
+
+def test_create_graph_second_derivative():
+    x = nd.array([2.0, -1.5, 0.3])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        (dy,) = autograd.grad(y, [x], create_graph=True)
+        z = (dy * dy).sum()           # sum (3x^2)^2
+    z.backward()
+    np.testing.assert_allclose(
+        x.grad.asnumpy(), 36 * np.array([2.0, -1.5, 0.3]) ** 3,
+        rtol=1e-5)
+
+
+def test_create_graph_through_unary_chain():
+    x = nd.array([0.7, -0.2])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sin(x) * nd.exp(x)
+        (g1,) = autograd.grad(y, [x], create_graph=True)
+    g1.backward()  # d2/dx2 sin(x)e^x = 2cos(x)e^x
+    xv = np.array([0.7, -0.2])
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               2 * np.cos(xv) * np.exp(xv), rtol=1e-5)
+
+
+def test_create_graph_gradient_penalty_training():
+    """WGAN-GP-style: the gradient PENALTY term backprops through the
+    input gradient into the weights."""
+    rng = np.random.RandomState(0)
+    w = nd.array(rng.uniform(-0.5, 0.5, (1, 4)).astype(np.float32))
+    w.attach_grad()
+    x = nd.array(rng.uniform(-1, 1, (8, 4)).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        score = nd.FullyConnected(x, w, num_hidden=1,
+                                  no_bias=True).sum()
+        (gx,) = autograd.grad(score, [x], create_graph=True)
+        penalty = ((nd.sqrt((gx * gx).sum(axis=1)) - 1.0) ** 2).mean()
+    penalty.backward()
+    gw = w.grad.asnumpy()
+    # analytic: gx rows are all w; penalty = (||w|| - 1)^2 ->
+    # d/dw = 2(||w|| - 1) * w/||w||
+    wv = w.asnumpy().ravel()
+    nrm = np.linalg.norm(wv)
+    expect = 2 * (nrm - 1.0) * wv / nrm
+    np.testing.assert_allclose(gw.ravel(), expect, rtol=1e-4)
+
+
+def test_create_graph_multiple_vars_and_head_grads():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    for v in (a, b):
+        v.attach_grad()
+    hg = nd.array([1.0, 0.5])
+    with autograd.record():
+        y = a * a * b
+        ga, gb = autograd.grad(y, [a, b], head_grads=hg,
+                               create_graph=True)
+        loss = (ga * gb).sum()  # (2ab*s)*(a^2*s) = 2 a^3 b s^2
+    loss.backward()
+    av, bv = np.array([1.0, 2.0]), np.array([3.0, 4.0])
+    s = np.array([1.0, 0.5])
+    np.testing.assert_allclose(a.grad.asnumpy(), 6 * av**2 * bv * s**2,
+                               rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(), 2 * av**3 * s**2,
+                               rtol=1e-5)
+
+
+def test_create_graph_intermediate_variable():
+    """grad w.r.t. an INTERMEDIATE value (regression: replay mapped
+    only leaves, returning silent zeros for t)."""
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        t = x * 2.0
+        y = t * t
+        (gt,) = autograd.grad(y, [t], create_graph=True)
+    np.testing.assert_allclose(gt.asnumpy(), [4.0, 8.0])  # 2t
+    gt.backward()  # d(2t)/dx = 2 * dt/dx = 4
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0, 4.0])
+
+
+def test_create_graph_tracked_head_grads():
+    """A head_grads seed that depends on tracked values must keep its
+    gradient path (regression: seeds were baked as constants)."""
+    x = nd.array([1.5])
+    w = nd.array([0.5])
+    for v in (x, w):
+        v.attach_grad()
+    with autograd.record():
+        y = x * x          # dy/dx = 2x
+        seed = w * 3.0     # tracked seed
+        (g,) = autograd.grad(y, [x], head_grads=seed,
+                             create_graph=True)
+        # g = 2x * 3w -> d g/dw = 6x
+        g.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), [6.0 * 1.5])
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0 * 3.0 * 0.5])
+
+
+def test_create_graph_error_paths():
+    x = nd.array([1.0])
+    x.attach_grad()
+    never_recorded = nd.array([2.0])
+    with autograd.record():
+        y = x * x
+    with pytest.raises(mx.base.MXNetError):
+        autograd.grad(never_recorded, [x], create_graph=True)
+    with pytest.raises(mx.base.MXNetError):
+        autograd.grad([y], [x], head_grads=[nd.array([1.0]),
+                                            nd.array([1.0])],
+                      create_graph=True)
