@@ -135,8 +135,11 @@ class TapeNode(object):
         self.saved = None
         # (tupled_fn, jax_inputs): the primal computation, kept so
         # grad(create_graph=True) can REPLAY the subgraph as a pure jax
-        # function and differentiate the differentiation (the closures
-        # hold no more than the vjp residuals already do)
+        # function and differentiate the differentiation.  Trade-off:
+        # this pins input buffers that cheap-op vjps (add/reshape/...)
+        # would not retain; backward(retain_graph=False) frees it with
+        # the residuals, and the node dies with its output NDArrays
+        # otherwise
         self.fwd = fwd
 
 
@@ -431,7 +434,12 @@ def _build_replay(heads, variables):
                 "create_graph=True: op %r was recorded without a "
                 "replayable forward (or its graph was already freed by "
                 "a retain_graph=False backward)" % node.op_name)
-    var_pos = {id(v): i for i, v in enumerate(variables)}
+    # duplicates in `variables` share ONE replay slot (the first); the
+    # caller-facing gradient is replicated per position afterwards —
+    # the plain path gives every duplicate the full gradient
+    var_pos = {}
+    for i, v in enumerate(variables):
+        var_pos.setdefault(id(v), i)
     # an INTERMEDIATE variable (has a producer entry) is treated as an
     # independent input at every consumption site — d(head)/d(t) holds
     # t's producers fixed, matching the plain path's semantics
@@ -439,7 +447,7 @@ def _build_replay(heads, variables):
     for i, v in enumerate(variables):
         ent = getattr(v, "_entry", None)
         if ent is not None:
-            var_entry_pos[(id(ent[0]), ent[1])] = i
+            var_entry_pos.setdefault((id(ent[0]), ent[1]), i)
     other_leaves = []
     other_pos = {}
     for node in order:
@@ -476,7 +484,15 @@ def _build_replay(heads, variables):
         for h in heads:
             ent = getattr(h, "_entry", None)
             if ent is None:
-                outs.append(var_vals[var_pos[id(h)]])
+                # a marked-leaf head: differentiable iff it IS one of
+                # the variables; otherwise a constant (zero gradients,
+                # matching the plain path)
+                if id(h) in var_pos:
+                    outs.append(var_vals[var_pos[id(h)]])
+                elif id(h) in other_pos:
+                    outs.append(other_vals[other_pos[id(h)]])
+                else:
+                    outs.append(h._data)
             else:
                 vpos = var_entry_pos.get((id(ent[0]), ent[1]))
                 outs.append(var_vals[vpos] if vpos is not None
@@ -510,6 +526,11 @@ def _grad_create_graph(heads, variables, head_grads):
     # path in the outer backward
     hg_arrays = [hg for hg in head_grads if hg is not None]
 
+    canon = {}
+    for i, v in enumerate(variables):
+        canon.setdefault(id(v), i)
+    canon_of = [canon[id(v)] for v in variables]
+
     def grad_fn(*vals):
         var_vals = vals[:n_var]
         other_vals = vals[n_var:n_var + n_other]
@@ -519,7 +540,10 @@ def _grad_create_graph(heads, variables, head_grads):
              else jnp.ones(h.shape, dtype=h.dtype))
             for h, hg in zip(heads, head_grads))
         _, vjp = jax.vjp(lambda *vv: replay(vv, other_vals), *var_vals)
-        return tuple(vjp(seeds))
+        gs = vjp(seeds)
+        # duplicates: every position of the same variable reports the
+        # full gradient (replay routed all reads to the canonical slot)
+        return tuple(gs[canon_of[i]] for i in range(n_var))
 
     all_inputs = list(variables) + list(other_leaves) + hg_arrays
     outs, node = _record_fn("_grad", grad_fn, all_inputs,

@@ -10,3 +10,4 @@ from . import rnn
 from . import data
 from . import utils
 from . import model_zoo
+from . import contrib

@@ -1,0 +1,4 @@
+"""Experimental gluon API (reference `python/mxnet/gluon/contrib/`)."""
+from . import data  # noqa: F401
+from . import nn  # noqa: F401
+from . import rnn  # noqa: F401

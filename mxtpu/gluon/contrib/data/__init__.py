@@ -1,0 +1,3 @@
+"""Experimental data utilities (reference gluon/contrib/data)."""
+from . import sampler  # noqa: F401
+from .sampler import IntervalSampler  # noqa: F401

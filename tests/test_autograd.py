@@ -292,3 +292,22 @@ def test_create_graph_error_paths():
         autograd.grad([y], [x], head_grads=[nd.array([1.0]),
                                             nd.array([1.0])],
                       create_graph=True)
+
+
+def test_create_graph_leaf_head_and_duplicates():
+    """Parity details vs the plain path (review regressions): a marked
+    leaf head not in variables gives zeros (not KeyError); duplicate
+    variables each get the full gradient."""
+    x = nd.array([1.0])
+    w = nd.array([3.0])
+    for v in (x, w):
+        v.attach_grad()
+    with autograd.record():
+        y = x * w
+        (gw,) = autograd.grad(x, [w], create_graph=True)  # head = leaf x
+    np.testing.assert_allclose(gw.asnumpy(), [0.0])
+    with autograd.record():
+        y = x * x * x
+        g1, g2 = autograd.grad(y, [x, x], create_graph=True)
+    np.testing.assert_allclose(g1.asnumpy(), [3.0])  # 3x^2 at x=1
+    np.testing.assert_allclose(g2.asnumpy(), [3.0])

@@ -1,0 +1,168 @@
+"""gluon.contrib (reference `python/mxnet/gluon/contrib/`,
+`tests/python/unittest/test_gluon_contrib.py`)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+from mxtpu.gluon import contrib
+
+
+def test_concurrent_and_identity():
+    for cls, hybrid in ((contrib.nn.Concurrent, False),
+                        (contrib.nn.HybridConcurrent, True)):
+        block = cls(axis=1)
+        block.add(gluon.nn.Dense(3))
+        block.add(contrib.nn.Identity())
+        block.add(gluon.nn.Dense(2))
+        block.initialize(ctx=mx.cpu())
+        x = nd.array(np.random.RandomState(0).rand(4, 5)
+                     .astype(np.float32))
+        out = block(x)
+        assert out.shape == (4, 3 + 5 + 2)
+        # identity branch passes x through untouched
+        np.testing.assert_allclose(out.asnumpy()[:, 3:8], x.asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_sparse_embedding_block():
+    emb = contrib.nn.SparseEmbedding(50, 6)
+    emb.initialize(ctx=mx.cpu())
+    ids = nd.array(np.array([[1, 4], [1, 30]], np.float32))
+    with autograd.record():
+        out = emb(ids)
+        out.sum().backward()
+    assert out.shape == (2, 2, 6)
+    g = emb.weight.grad()
+    from mxtpu.ndarray.sparse import RowSparseNDArray
+
+    assert isinstance(g, RowSparseNDArray)
+    dense = g.tostype("default").asnumpy()
+    assert (dense[1] == 2).all() and (dense[4] == 1).all()
+    assert dense[2].sum() == 0
+
+
+def test_sync_batchnorm_layer():
+    bn = contrib.nn.SyncBatchNorm(num_devices=1)
+    bn.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(1).rand(4, 3, 5, 5)
+                 .astype(np.float32))
+    with autograd.record():
+        y = bn(x)
+    assert y.shape == x.shape
+    yv = y.asnumpy()
+    np.testing.assert_allclose(yv.mean(axis=(0, 2, 3)), 0, atol=1e-4)
+
+
+def test_pixelshuffle2d():
+    ps = contrib.nn.PixelShuffle2D(2)
+    x = np.arange(1 * 8 * 3 * 3, dtype=np.float32) \
+        .reshape(1, 8, 3, 3)
+    out = ps(nd.array(x)).asnumpy()
+    assert out.shape == (1, 2, 6, 6)
+    # gold: the standard depth-to-space on channel blocks of r^2
+    r = 2
+    gold = x.reshape(1, 2, r, r, 3, 3).transpose(0, 1, 4, 2, 5, 3) \
+        .reshape(1, 2, 6, 6)
+    np.testing.assert_allclose(out, gold)
+
+
+@pytest.mark.parametrize("cls,gates", [
+    (contrib.rnn.Conv2DRNNCell, 1),
+    (contrib.rnn.Conv2DLSTMCell, 4),
+    (contrib.rnn.Conv2DGRUCell, 3),
+])
+def test_conv_rnn_cells_2d(cls, gates):
+    cell = cls(input_shape=(3, 8, 8), hidden_channels=4,
+               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(0).rand(2, 3, 8, 8)
+                 .astype(np.float32))
+    states = cell.begin_state(batch_size=2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 4, 8, 8)
+    assert len(new_states) == len(states)
+    # a second step consumes the produced state
+    out2, _ = cell(x, new_states)
+    assert np.isfinite(out2.asnumpy()).all()
+    # unroll over time
+    cell.reset()
+    seq = nd.array(np.random.RandomState(1).rand(2, 3, 3, 8, 8)
+                   .astype(np.float32))
+    outs, _ = cell.unroll(3, seq, layout="NTC", merge_outputs=False)
+    assert len(outs) == 3 and outs[0].shape == (2, 4, 8, 8)
+
+
+def test_conv_rnn_1d_and_3d():
+    c1 = contrib.rnn.Conv1DLSTMCell(input_shape=(2, 10),
+                                    hidden_channels=3, i2h_kernel=3,
+                                    h2h_kernel=3, i2h_pad=1)
+    c1.initialize(ctx=mx.cpu())
+    out, st = c1(nd.array(np.random.rand(2, 2, 10).astype(np.float32)),
+                 c1.begin_state(batch_size=2))
+    assert out.shape == (2, 3, 10)
+    c3 = contrib.rnn.Conv3DRNNCell(input_shape=(1, 4, 4, 4),
+                                   hidden_channels=2, i2h_kernel=3,
+                                   h2h_kernel=3, i2h_pad=1)
+    c3.initialize(ctx=mx.cpu())
+    out3, _ = c3(nd.array(np.random.rand(1, 1, 4, 4, 4)
+                          .astype(np.float32)),
+                 c3.begin_state(batch_size=1))
+    assert out3.shape == (1, 2, 4, 4, 4)
+
+
+def test_conv_rnn_h2h_kernel_must_be_odd():
+    with pytest.raises(ValueError):
+        contrib.rnn.Conv2DRNNCell(input_shape=(3, 8, 8),
+                                  hidden_channels=4, i2h_kernel=3,
+                                  h2h_kernel=2)
+
+
+def test_lstmp_cell_projection():
+    cell = contrib.rnn.LSTMPCell(hidden_size=16, projection_size=5,
+                                 input_size=8)
+    cell.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(2).rand(4, 8).astype(np.float32))
+    out, (r, c) = cell(x, cell.begin_state(batch_size=4))
+    assert out.shape == (4, 5)       # projected
+    assert r.shape == (4, 5) and c.shape == (4, 16)
+    outs, _ = cell.unroll(3, nd.array(
+        np.random.rand(4, 3, 8).astype(np.float32)), layout="NTC",
+        merge_outputs=False)
+    assert outs[-1].shape == (4, 5)
+
+
+def test_variational_dropout_cell_locked_masks():
+    base = gluon.rnn.RNNCell(hidden_size=6, input_size=4)
+    cell = contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.5,
+                                              drop_outputs=0.5)
+    cell.initialize(ctx=mx.cpu())
+    mx.random.seed(7)
+    x = nd.ones((2, 4))
+    states = cell.begin_state(batch_size=2)
+    with autograd.record(train_mode=True):
+        o1, s = cell(x, states)
+        o2, s = cell(x, s)
+    # the LOCKED input mask: zeroed input columns are identical across
+    # steps (the mask is drawn once per sequence)
+    m1 = cell._masks["inputs"].asnumpy()
+    assert set(np.unique(m1)).issubset({0.0, 2.0})
+    cell.reset()
+    with autograd.record(train_mode=True):
+        cell(x, cell.begin_state(batch_size=2))
+    m2 = cell._masks["inputs"].asnumpy()
+    assert m1.shape == m2.shape
+    # inference: no dropout at all
+    o_inf, _ = cell(x, cell.begin_state(batch_size=2))
+    assert np.isfinite(o_inf.asnumpy()).all()
+
+
+def test_interval_sampler():
+    s = contrib.data.IntervalSampler(6, 2)
+    assert list(s) == [0, 2, 4, 1, 3, 5]
+    assert len(s) == 6
+    s2 = contrib.data.IntervalSampler(7, 3, rollover=False)
+    assert list(s2) == [0, 3, 6]
+    assert len(s2) == 3
+    with pytest.raises(ValueError):
+        contrib.data.IntervalSampler(3, 5)
