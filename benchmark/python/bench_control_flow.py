@@ -1,0 +1,75 @@
+"""Control-flow op micro-benchmarks (reference
+`benchmark/python/control_flow/`): foreach (lax.scan) vs a python
+unrolled loop at growing sequence length — the compile-once win.
+
+Usage: python benchmark/python/bench_control_flow.py [--lengths 32,128]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def bench_foreach(T, hidden, iters):
+    x = nd.array(np.random.RandomState(0).rand(T, 32, hidden)
+                 .astype(np.float32))
+    h0 = nd.zeros((32, hidden))
+    w = nd.array(np.random.RandomState(1).rand(hidden, hidden)
+                 .astype(np.float32) * 0.01)
+
+    def body(inp, state):
+        nh = nd.tanh(nd.dot(inp, w) + state[0])
+        return nh, [nh]
+
+    out, _ = mx.nd.contrib.foreach(body, x, [h0])
+    out.wait_to_read()
+    tic = time.perf_counter()
+    for _ in range(iters):
+        out, _ = mx.nd.contrib.foreach(body, x, [h0])
+    out.wait_to_read()
+    return iters / (time.perf_counter() - tic)
+
+
+def bench_unrolled(T, hidden, iters):
+    x = nd.array(np.random.RandomState(0).rand(T, 32, hidden)
+                 .astype(np.float32))
+    w = nd.array(np.random.RandomState(1).rand(hidden, hidden)
+                 .astype(np.float32) * 0.01)
+
+    def run():
+        h = nd.zeros((32, hidden))
+        for t in range(T):
+            h = nd.tanh(nd.dot(x[t], w) + h)
+        return h
+
+    run().wait_to_read()
+    tic = time.perf_counter()
+    for _ in range(iters):
+        out = run()
+    out.wait_to_read()
+    return iters / (time.perf_counter() - tic)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--lengths", default="32,128")
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+    for T in (int(t) for t in args.lengths.split(",")):
+        f = bench_foreach(T, args.hidden, args.iters)
+        u = bench_unrolled(T, args.hidden, args.iters)
+        print("T=%-4d foreach(scan) %8.2f it/s   unrolled %8.2f it/s   "
+              "speedup %.1fx" % (T, f, u, f / u))
+
+
+if __name__ == "__main__":
+    main()

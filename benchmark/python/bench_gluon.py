@@ -1,0 +1,66 @@
+"""Gluon layer micro-benchmarks (reference
+`benchmark/python/gluon/benchmark_gluon.py`): forward / forward+backward
+images-per-second for model-zoo nets at several batch sizes.
+
+Usage: python benchmark/python/bench_gluon.py [--networks resnet18_v1]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.gluon.model_zoo import vision
+
+
+def bench(name, batch, train, iters, ctx):
+    net = getattr(vision, name)(classes=1000)
+    net.initialize(ctx=ctx)
+    x = mx.nd.array(np.random.uniform(size=(batch, 3, 224, 224))
+                    .astype(np.float32), ctx=ctx)
+    net(x)
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    y = mx.nd.array(np.zeros(batch, np.float32), ctx=ctx)
+
+    def step():
+        if train:
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            return loss
+        return net(x)
+
+    step().wait_to_read()
+    tic = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = step()
+    out.wait_to_read()
+    return batch * iters / (time.perf_counter() - tic)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--networks", default="resnet18_v1,mobilenet1_0")
+    p.add_argument("--batch-sizes", default="1,32")
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    print("device:", ctx)
+    for name in args.networks.split(","):
+        for bs in (int(b) for b in args.batch_sizes.split(",")):
+            fwd = bench(name, bs, False, args.iters, ctx)
+            bwd = bench(name, bs, True, args.iters, ctx)
+            print("%-16s bs=%-3d  fwd %9.1f img/s   fwd+bwd %9.1f img/s"
+                  % (name, bs, fwd, bwd))
+
+
+if __name__ == "__main__":
+    main()

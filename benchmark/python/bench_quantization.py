@@ -1,0 +1,59 @@
+"""INT8 vs fp32 inference micro-benchmark (reference
+`benchmark/python/quantization/benchmark_op.py`).
+
+Usage: python benchmark/python/bench_quantization.py
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def bench_fc(batch, in_dim, out_dim, iters):
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.uniform(-1, 1, (batch, in_dim)).astype(np.float32))
+    w = nd.array(rng.uniform(-1, 1, (out_dim, in_dim))
+                 .astype(np.float32))
+    b = nd.array(np.zeros(out_dim, np.float32))
+
+    def run_fp32():
+        return nd.FullyConnected(x, w, b, num_hidden=out_dim)
+
+    qx = nd.contrib.quantize_v2(x)
+    qw = nd.contrib.quantize_v2(w)
+    qb = nd.array(np.zeros(out_dim, np.int8))
+
+    def run_int8():
+        return nd.contrib.quantized_fully_connected(
+            qx[0], qw[0], qb, qx[1], qx[2], qw[1], qw[2],
+            qw[1], qw[2], num_hidden=out_dim)
+
+    for fn, name in ((run_fp32, "fp32"), (run_int8, "int8")):
+        fn()[0].wait_to_read()
+        tic = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        out[0].wait_to_read()
+        rate = iters / (time.perf_counter() - tic)
+        print("FC %dx%d->%d  %s: %9.1f it/s"
+              % (batch, in_dim, out_dim, name, rate))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=30)
+    args = p.parse_args()
+    bench_fc(64, 1024, 1024, args.iters)
+    bench_fc(32, 4096, 4096, max(args.iters // 3, 5))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Sparse op micro-benchmarks (reference
+`benchmark/python/sparse/sparse_end2end.py`): row-sparse embedding
+gradient vs dense at growing vocab — the wire/compute win sparse exists
+for.
+
+Usage: python benchmark/python/bench_sparse.py [--vocabs 10000,100000]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+
+
+def bench(vocab, dim, batch, iters, sparse):
+    rng = np.random.RandomState(0)
+    w = nd.array(rng.uniform(-1, 1, (vocab, dim)).astype(np.float32))
+    if sparse:
+        gbuf = mx.nd.sparse.zeros("row_sparse", (vocab, dim))
+        mx.autograd.mark_variables([w], [gbuf])
+    else:
+        w.attach_grad()
+    ids = nd.array(rng.randint(0, vocab, (batch, 16)).astype(np.float32))
+
+    def step():
+        with autograd.record():
+            e = nd.Embedding(ids, w, input_dim=vocab, output_dim=dim,
+                             sparse_grad=sparse)
+            loss = (e * e).sum()
+        loss.backward()
+        return w.grad
+
+    g = step()
+    (g.tostype("default") if sparse else g).wait_to_read()
+    tic = time.perf_counter()
+    for _ in range(iters):
+        g = step()
+    (g.tostype("default") if sparse else g).wait_to_read()
+    return iters / (time.perf_counter() - tic)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--vocabs", default="10000,100000,1000000")
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+    for vocab in (int(v) for v in args.vocabs.split(",")):
+        d = bench(vocab, args.dim, args.batch, args.iters, False)
+        s = bench(vocab, args.dim, args.batch, args.iters, True)
+        print("vocab=%-8d dense %8.1f steps/s   row_sparse %8.1f "
+              "steps/s   speedup %.2fx" % (vocab, d, s, s / d))
+
+
+if __name__ == "__main__":
+    main()

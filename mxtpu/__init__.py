@@ -20,6 +20,25 @@ Typical use, identical to the reference apart from the context:
 """
 __version__ = "0.1.0"
 
+# Honor the user's JAX_PLATFORMS even when a site plugin rewrote the
+# jax config at interpreter start (the axon sitecustomize sets
+# platforms to "axon,cpu", discarding the env var — so
+# `JAX_PLATFORMS=cpu python script.py` would still try, and hang on, a
+# wedged accelerator tunnel).  Re-asserting here is safe: backends are
+# not initialized until the first device use.
+import os as _os
+
+_user_platforms = _os.environ.get("JAX_PLATFORMS")
+if _user_platforms:
+    try:
+        import jax as _jax
+
+        if _jax.config.jax_platforms != _user_platforms:
+            _jax.config.update("jax_platforms", _user_platforms)
+    except Exception:  # backends already initialized — leave them be
+        pass
+del _os, _user_platforms
+
 from .base import MXNetError, MXTPUError
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, cpu_shared,
                       current_context, num_tpus, num_gpus)

@@ -12,8 +12,8 @@ class IntervalSampler(Sampler):
     IntervalSampler): for length 6, interval 2 yields 0,2,4,1,3,5."""
 
     def __init__(self, length, interval, rollover=True):
-        if interval >= length:
-            raise ValueError("interval (%d) must be < length (%d)"
+        if interval > length:  # interval == length is legal (reference)
+            raise ValueError("interval (%d) must be <= length (%d)"
                              % (interval, length))
         self._length = length
         self._interval = interval

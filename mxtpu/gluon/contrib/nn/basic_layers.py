@@ -74,10 +74,16 @@ class SparseEmbedding(Block):
 
 class SyncBatchNorm(nn.BatchNorm):
     """Cross-device synchronized BatchNorm (reference
-    contrib.nn.SyncBatchNorm).  Under a `shard_map`/pjit program the
-    batch statistics are psum'd over the data-parallel axis by the
-    `_contrib_SyncBatchNorm` op; outside a mesh program it degrades to
-    plain BatchNorm (one device = already synchronized)."""
+    contrib.nn.SyncBatchNorm).
+
+    TPU-native mechanics: under the SPMD executor the batch axis is
+    SHARDED, and XLA turns the batch-mean/var reductions into global
+    collectives automatically — plain BatchNorm *is* synchronized
+    BatchNorm in a pjit program, so this class shares its parent's
+    compute path (no per-device statistics exist to diverge).  The
+    `num_devices` argument is accepted for API parity and ignored;
+    manual `shard_map` programs with explicit axis names should psum
+    their own statistics (see `mxtpu.parallel`)."""
 
     def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
                  epsilon=1e-5, center=True, scale=True,

@@ -121,12 +121,6 @@ class _ConvLSTMCell(_ConvCellBase):
     def _alias(self):
         return "conv_lstm"
 
-    def state_info(self, batch_size=0):
-        shape = (batch_size, self._hidden_channels) + self._state_spatial
-        layout = "NC" + "DHW"[-self._dims:]
-        return [{"shape": shape, "__layout__": layout},
-                {"shape": shape, "__layout__": layout}]
-
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
         prev_h, prev_c = states

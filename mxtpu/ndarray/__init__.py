@@ -21,6 +21,8 @@ from .ndarray import (
     split,
     moveaxis,
     waitall,
+    maximum,
+    minimum,
     from_dlpack,
     to_dlpack_for_read,
     to_dlpack_for_write,

@@ -51,6 +51,8 @@ __all__ = [
     "load",
     "from_numpy",
     "from_jax",
+    "maximum",
+    "minimum",
     "from_dlpack",
     "to_dlpack_for_read",
     "to_dlpack_for_write",
@@ -835,3 +837,31 @@ def to_dlpack_for_write(data: NDArray):
     """Module-level mirror of `NDArray.to_dlpack_for_write` — always
     raises; see the method docstring."""
     return data.to_dlpack_for_write()
+
+
+def maximum(lhs, rhs):
+    """Elementwise max of arrays or scalars (reference
+    `mx.nd.maximum`)."""
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return imperative_invoke("_maximum", lhs, rhs)[0]
+    if isinstance(lhs, NDArray):
+        return imperative_invoke("_maximum_scalar", lhs,
+                                 scalar=float(rhs))[0]
+    if isinstance(rhs, NDArray):
+        return imperative_invoke("_maximum_scalar", rhs,
+                                 scalar=float(lhs))[0]
+    return max(lhs, rhs)
+
+
+def minimum(lhs, rhs):
+    """Elementwise min of arrays or scalars (reference
+    `mx.nd.minimum`)."""
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return imperative_invoke("_minimum", lhs, rhs)[0]
+    if isinstance(lhs, NDArray):
+        return imperative_invoke("_minimum_scalar", lhs,
+                                 scalar=float(rhs))[0]
+    if isinstance(rhs, NDArray):
+        return imperative_invoke("_minimum_scalar", rhs,
+                                 scalar=float(lhs))[0]
+    return min(lhs, rhs)

@@ -241,9 +241,12 @@ def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, 
 def _sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                      momentum=0.9, fix_gamma=True, use_global_stats=False,
                      output_mean_var=False, ndev=1, key=None, is_train=False):
-    """Cross-device BatchNorm.  Inside pjit/shard_map the mean/var reduce
-    is a `psum` over the data axis (see mxtpu.parallel); single-device path
-    equals BatchNorm (reference `src/operator/contrib/sync_batch_norm.cc`)."""
+    """Cross-device BatchNorm (reference
+    `src/operator/contrib/sync_batch_norm.cc`).  Under pjit with a
+    SHARDED batch axis, XLA lowers the batch mean/var reductions to
+    global collectives — synchronization is automatic, so the body is
+    exactly BatchNorm.  (Manual shard_map programs must psum their own
+    statistics; this op cannot know the axis name.)"""
     from .nn import _batch_norm
 
     return _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=eps,

@@ -160,3 +160,14 @@ def test_example_svrg():
 def test_example_quantization():
     out = _run_example("quantization/quantize_model.py", "--epochs", "2")
     assert "int8" in out
+
+
+def test_example_ssd_multibox_family():
+    out = _run_example("ssd/ssd_mini.py", "--epochs", "4",
+                       "--det-threshold", "0.05")
+    assert "detections per image" in out
+
+
+def test_example_ctc_ocr():
+    out = _run_example("ctc/ocr_ctc.py", "--epochs", "8", timeout=560)
+    assert "exact-sequence accuracy" in out

@@ -164,5 +164,7 @@ def test_interval_sampler():
     s2 = contrib.data.IntervalSampler(7, 3, rollover=False)
     assert list(s2) == [0, 3, 6]
     assert len(s2) == 3
+    # interval == length is legal (reference parity)
+    assert list(contrib.data.IntervalSampler(3, 3)) == [0, 1, 2]
     with pytest.raises(ValueError):
         contrib.data.IntervalSampler(3, 5)

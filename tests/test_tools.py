@@ -1,0 +1,68 @@
+"""tools/: im2rec packing, parse_log, diagnose (reference `tools/`)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run([sys.executable] + args, capture_output=True,
+                       text=True, env=env, cwd=REPO, timeout=timeout)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    return r.stdout
+
+
+def test_im2rec_list_pack_consume(tmp_path):
+    PIL = pytest.importorskip("PIL.Image")
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            arr = np.random.RandomState(i).randint(
+                0, 255, (20, 24, 3), dtype=np.uint8)
+            PIL.fromarray(arr).save(str(root / cls / ("%d.jpg" % i)))
+    prefix = str(tmp_path / "data")
+    out = _run(["tools/im2rec.py", "--list", prefix, str(root)])
+    assert "6 entries" in out and os.path.exists(prefix + ".lst")
+    _run(["tools/im2rec.py", prefix, str(root), "--resize", "16"])
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+
+    import mxtpu as mx
+
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               path_imgidx=prefix + ".idx",
+                               data_shape=(3, 16, 16), batch_size=6)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (6, 3, 16, 16)
+    labels = set(batch.label[0].asnumpy().tolist())
+    assert labels == {0.0, 1.0}
+
+
+def test_parse_log(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO:root:Epoch[0] Train-accuracy=0.5\n"
+        "INFO:root:Epoch[0] Time cost=2.5\n"
+        "INFO:root:Epoch[0] Validation-accuracy=0.4\n"
+        "INFO:root:Epoch[1] Train-accuracy=0.8\n")
+    out = _run(["tools/parse_log.py", str(log), "--format", "csv"])
+    lines = out.strip().splitlines()
+    assert lines[0] == "epoch,time,train-accuracy,validation-accuracy"
+    assert lines[1] == "0,2.5,0.5,0.4"
+    assert lines[2].startswith("1,nan,0.8")
+    md = _run(["tools/parse_log.py", str(log)])
+    assert "epoch" in md and "|" in md
+
+
+def test_diagnose_runs():
+    out = _run(["tools/diagnose.py", "--timeout", "5"], timeout=200)
+    assert "registered ops:" in out
+    assert "Accelerator" in out

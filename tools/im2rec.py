@@ -1,0 +1,115 @@
+"""Pack an image directory into recordio (reference `tools/im2rec.py`).
+
+Two modes, same as the reference:
+  --list  : walk an image root and write a `.lst` index
+            (index \t label \t relative-path per line); class labels are
+            assigned from sorted sub-directory names.
+  (pack)  : read a `.lst` + image root and write `prefix.rec` +
+            `prefix.idx` that `mxtpu.io.ImageRecordIter` consumes
+            (wire-compatible record framing, `mxtpu/recordio.py`).
+
+Usage:
+    python tools/im2rec.py --list prefix image_root
+    python tools/im2rec.py prefix image_root [--resize 256] [--quality 95]
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix, root, shuffle=True, train_ratio=1.0, seed=0):
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    label_of = {c: i for i, c in enumerate(classes)}
+    entries = []
+    if classes:
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(EXTS):
+                    entries.append((label_of[c], os.path.join(c, fn)))
+    else:  # flat directory: label 0
+        for fn in sorted(os.listdir(root)):
+            if fn.lower().endswith(EXTS):
+                entries.append((0, fn))
+    if shuffle:
+        random.Random(seed).shuffle(entries)
+    n_train = int(len(entries) * train_ratio)
+    splits = [("", entries[:n_train])]
+    if train_ratio < 1.0:
+        splits = [("_train", entries[:n_train]),
+                  ("_val", entries[n_train:])]
+    for suffix, rows in splits:
+        path = prefix + suffix + ".lst"
+        with open(path, "w") as f:
+            for i, (label, rel) in enumerate(rows):
+                f.write("%d\t%f\t%s\n" % (i, label, rel))
+        print("wrote %s (%d entries, %d classes)"
+              % (path, len(rows), max(len(classes), 1)))
+
+
+def pack(prefix, root, resize=0, quality=95, color=1):
+    import io as _io
+
+    from PIL import Image
+
+    from mxtpu import recordio
+
+    lst = prefix + ".lst"
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    with open(lst) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            img_path = os.path.join(root, parts[-1])
+            img = Image.open(img_path)
+            img = img.convert("RGB" if color else "L")
+            if resize:
+                w, h = img.size
+                scale = resize / min(w, h)
+                img = img.resize((max(1, round(w * scale)),
+                                  max(1, round(h * scale))))
+            buf = _io.BytesIO()
+            img.save(buf, format="JPEG", quality=quality)
+            label = labels[0] if len(labels) == 1 else labels
+            header = recordio.IRHeader(0, label, idx, 0)
+            rec.write_idx(idx, recordio.pack(header, buf.getvalue()))
+            n += 1
+    rec.close()
+    print("packed %d images -> %s.rec / %s.idx" % (n, prefix, prefix))
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="image folder -> .lst / recordio packer")
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--list", action="store_true",
+                   help="generate the .lst index instead of packing")
+    p.add_argument("--no-shuffle", action="store_true")
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter side to this many pixels")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--gray", action="store_true")
+    args = p.parse_args()
+    if args.list:
+        make_list(args.prefix, args.root, shuffle=not args.no_shuffle,
+                  train_ratio=args.train_ratio)
+    else:
+        pack(args.prefix, args.root, resize=args.resize,
+             quality=args.quality, color=0 if args.gray else 1)
+
+
+if __name__ == "__main__":
+    main()

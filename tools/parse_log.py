@@ -1,0 +1,71 @@
+"""Parse training logs into a table (reference `tools/parse_log.py`).
+
+Extracts per-epoch train/validation metrics and epoch time from the
+logging format Module.fit / Speedometer emit:
+
+    Epoch[3] Train-accuracy=0.91
+    Epoch[3] Time cost=12.3
+    Epoch[3] Validation-accuracy=0.87
+
+Usage: python tools/parse_log.py logfile [--format markdown|csv]
+"""
+import argparse
+import re
+import sys
+
+EPOCH_RE = re.compile(
+    r"Epoch\[(\d+)\]\s+(Train|Validation)-([\w-]+)=([0-9.eE+-]+)")
+TIME_RE = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([0-9.]+)")
+
+
+def parse(path):
+    rows = {}
+    with open(path) as f:
+        for line in f:
+            m = EPOCH_RE.search(line)
+            if m:
+                epoch = int(m.group(1))
+                key = "%s-%s" % (m.group(2).lower(), m.group(3))
+                rows.setdefault(epoch, {})[key] = float(m.group(4))
+                continue
+            m = TIME_RE.search(line)
+            if m:
+                rows.setdefault(int(m.group(1)), {})["time"] = \
+                    float(m.group(2))
+    return rows
+
+
+def render(rows, fmt):
+    if not rows:
+        print("no epoch records found", file=sys.stderr)
+        return
+    cols = sorted({k for r in rows.values() for k in r})
+    header = ["epoch"] + cols
+    table = [[str(e)] + ["%.6g" % rows[e].get(c, float("nan"))
+                         for c in cols]
+             for e in sorted(rows)]
+    if fmt == "csv":
+        print(",".join(header))
+        for row in table:
+            print(",".join(row))
+    else:
+        widths = [max(len(h), *(len(r[i]) for r in table))
+                  for i, h in enumerate(header)]
+        line = " | ".join(h.ljust(w) for h, w in zip(header, widths))
+        print(line)
+        print("-|-".join("-" * w for w in widths))
+        for row in table:
+            print(" | ".join(c.ljust(w) for c, w in zip(row, widths)))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("logfile")
+    p.add_argument("--format", choices=("markdown", "csv"),
+                   default="markdown")
+    args = p.parse_args()
+    render(parse(args.logfile), args.format)
+
+
+if __name__ == "__main__":
+    main()
