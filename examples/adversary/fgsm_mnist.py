@@ -83,6 +83,7 @@ def main():
     p.add_argument("--mnist-dir", default=None)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
 
     ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
     net = build_net()

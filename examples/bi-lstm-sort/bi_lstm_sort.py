@@ -52,6 +52,7 @@ def main():
     p.add_argument("--seq-len", type=int, default=5)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
 
     X, Y = make_data(seq_len=args.seq_len)
     ctx = mx.tpu() if mx.num_tpus() else mx.cpu()

@@ -73,6 +73,7 @@ def main():
                    help="optional pretrained vectors (token v1 v2 ...)")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
 
     sents, labels = make_corpus()
     counter = Counter(w for s in sents for w in s)

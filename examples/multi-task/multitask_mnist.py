@@ -69,6 +69,7 @@ def main():
     p.add_argument("--batch-size", type=int, default=64)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
 
     rng = np.random.RandomState(0)
     templates = rng.uniform(0, 1, (10, 64)).astype(np.float32)

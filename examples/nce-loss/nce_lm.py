@@ -58,6 +58,7 @@ def main():
     p.add_argument("--num-noise", type=int, default=8)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
 
     ctxs, targets = make_bigrams(args.vocab)
     ctx = mx.tpu() if mx.num_tpus() else mx.cpu()

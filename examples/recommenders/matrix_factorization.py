@@ -58,6 +58,7 @@ def main():
     p.add_argument("--lr", type=float, default=0.05)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
 
     users, items, ratings = synth_ratings()
     ctx = mx.tpu() if mx.num_tpus() else mx.cpu()

@@ -69,6 +69,7 @@ def main():
     p.add_argument("--death-rate", type=float, default=0.5)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
 
     rng = np.random.RandomState(0)
     # low-frequency class templates (smooth gradients survive the

@@ -31,6 +31,7 @@ def main():
     p.add_argument("--reg-coeff", type=float, default=1.0)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
 
     rng = np.random.RandomState(0)
     templates = rng.uniform(0, 1, (10, 128)).astype(np.float32)

@@ -63,6 +63,7 @@ def main():
     p.add_argument("--lr", type=float, default=1e-3)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
 
     ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
     net = VAE(args.latent)

@@ -839,29 +839,18 @@ def to_dlpack_for_write(data: NDArray):
     return data.to_dlpack_for_write()
 
 
-def maximum(lhs, rhs):
-    """Elementwise max of arrays or scalars (reference
-    `mx.nd.maximum`)."""
-    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
-        return imperative_invoke("_maximum", lhs, rhs)[0]
-    if isinstance(lhs, NDArray):
-        return imperative_invoke("_maximum_scalar", lhs,
-                                 scalar=float(rhs))[0]
-    if isinstance(rhs, NDArray):
-        return imperative_invoke("_maximum_scalar", rhs,
-                                 scalar=float(lhs))[0]
-    return max(lhs, rhs)
+def _commutative_binary(op_ew, op_sc, host_fn):
+    def fn(lhs, rhs):
+        if isinstance(lhs, NDArray):
+            return lhs._binary(rhs, op_ew, op_sc)
+        if isinstance(rhs, NDArray):  # commutative: swap is free
+            return rhs._binary(lhs, op_ew, op_sc)
+        return host_fn(lhs, rhs)
+    return fn
 
 
-def minimum(lhs, rhs):
-    """Elementwise min of arrays or scalars (reference
-    `mx.nd.minimum`)."""
-    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
-        return imperative_invoke("_minimum", lhs, rhs)[0]
-    if isinstance(lhs, NDArray):
-        return imperative_invoke("_minimum_scalar", lhs,
-                                 scalar=float(rhs))[0]
-    if isinstance(rhs, NDArray):
-        return imperative_invoke("_minimum_scalar", rhs,
-                                 scalar=float(lhs))[0]
-    return min(lhs, rhs)
+#: Elementwise max/min of arrays or scalars (reference `mx.nd.maximum`/
+#: `mx.nd.minimum`); dispatch (incl. broadcasting) rides NDArray._binary.
+maximum = _commutative_binary("_maximum", "_maximum_scalar", max)
+minimum = _commutative_binary("_minimum", "_minimum_scalar", min)
+maximum.__name__, minimum.__name__ = "maximum", "minimum"
