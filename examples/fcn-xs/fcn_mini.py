@@ -1,0 +1,126 @@
+"""Fully-convolutional segmentation, miniature.
+
+Analog of the reference's `example/fcn-xs/`: a conv encoder, a 1x1
+score head, and a stride-2 Deconvolution (bilinear-initialized, the
+FCN trick) upsampling back to input resolution; per-pixel softmax
+cross-entropy.  Exercises dense prediction + transposed-conv
+upsampling end to end.
+
+Run:  python fcn_mini.py [--epochs 6]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+
+NUM_CLASSES = 3  # background, square, cross
+
+
+def bilinear_kernel(channels, k):
+    """FCN's bilinear upsampling initialization."""
+    factor = (k + 1) // 2
+    center = factor - 1 if k % 2 == 1 else factor - 0.5
+    og = np.ogrid[:k, :k]
+    filt = (1 - abs(og[0] - center) / factor) * \
+        (1 - abs(og[1] - center) / factor)
+    w = np.zeros((channels, channels, k, k), np.float32)
+    for c in range(channels):
+        w[c, c] = filt
+    return w
+
+
+class MiniFCN(gluon.nn.HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.features = gluon.nn.HybridSequential()
+        self.features.add(
+            gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),                       # 16 -> 8
+            gluon.nn.Conv2D(32, 3, padding=1, activation="relu"))
+        self.score = gluon.nn.Conv2D(NUM_CLASSES, 1)
+        self.up = gluon.nn.Conv2DTranspose(NUM_CLASSES, 4, strides=2,
+                                           padding=1)
+
+    def hybrid_forward(self, F, x):
+        return self.up(self.score(self.features(x)))     # back to 16
+
+
+def make_data(n, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, 1, 16, 16), np.float32)
+    Y = np.zeros((n, 16, 16), np.float32)
+    for i in range(n):
+        c = rng.randint(1, NUM_CLASSES)
+        size = rng.randint(5, 8)
+        r0, c0 = rng.randint(0, 16 - size, 2)
+        if c == 1:
+            X[i, 0, r0:r0 + size, c0:c0 + size] = 1.0
+            Y[i, r0:r0 + size, c0:c0 + size] = 1
+        else:
+            X[i, 0, r0 + size // 2, c0:c0 + size] = 1.0
+            X[i, 0, r0:r0 + size, c0 + size // 2] = 1.0
+            Y[i, r0 + size // 2, c0:c0 + size] = 2
+            Y[i, r0:r0 + size, c0 + size // 2] = 2
+        X[i] += rng.normal(0, 0.05, X[i].shape)
+    return X, Y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--batch-size", type=int, default=32)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    net = MiniFCN()
+    net.initialize(mx.initializer.Xavier(), ctx=ctx)
+    X, Y = make_data(256)
+    net(nd.array(X[:1], ctx=ctx))  # materialize shapes
+    net.up.weight.set_data(nd.array(bilinear_kernel(NUM_CLASSES, 4)))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+    it = mx.io.NDArrayIter(X, Y.reshape(len(Y), -1),
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="label")
+    for epoch in range(args.epochs):
+        it.reset()
+        tot = n = 0.0
+        for batch in it:
+            x = batch.data[0].as_in_context(ctx)
+            y = batch.label[0].reshape((-1, 16, 16)).as_in_context(ctx)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            tot += float(loss.mean().asnumpy())
+            n += 1
+        logging.info("epoch %d pixel CE %.4f", epoch, tot / n)
+
+    pred = net(nd.array(X[:64], ctx=ctx)).asnumpy().argmax(axis=1)
+    piou = []
+    for c in range(1, NUM_CLASSES):
+        inter = ((pred == c) & (Y[:64] == c)).sum()
+        union = ((pred == c) | (Y[:64] == c)).sum()
+        if union:
+            piou.append(inter / union)
+    miou = float(np.mean(piou))
+    pix_acc = float((pred == Y[:64]).mean())
+    logging.info("pixel accuracy %.3f   mIoU(fg) %.3f", pix_acc, miou)
+    assert pix_acc > 0.9, "dense prediction should fit the shapes"
+
+
+if __name__ == "__main__":
+    main()

@@ -839,18 +839,28 @@ def to_dlpack_for_write(data: NDArray):
     return data.to_dlpack_for_write()
 
 
-def _commutative_binary(op_ew, op_sc, host_fn):
+def _commutative_binary(name, op_ew, op_sc, host_fn):
     def fn(lhs, rhs):
-        if isinstance(lhs, NDArray):
-            return lhs._binary(rhs, op_ew, op_sc)
-        if isinstance(rhs, NDArray):  # commutative: swap is free
-            return rhs._binary(lhs, op_ew, op_sc)
-        return host_fn(lhs, rhs)
+        if not isinstance(lhs, NDArray) and not isinstance(rhs, NDArray):
+            return host_fn(lhs, rhs)
+        if isinstance(rhs, NDArray) and not isinstance(lhs, NDArray):
+            lhs, rhs = rhs, lhs  # commutative: swap is free
+        if not isinstance(rhs, (NDArray, int, float, np.generic)):
+            rhs = array(np.asarray(rhs))  # lists/np arrays coerce
+        out = lhs._binary(rhs, op_ew, op_sc)
+        if out is NotImplemented:
+            raise TypeError("%s: unsupported operand type %r"
+                            % (name, type(rhs)))
+        return out
+
+    fn.__name__ = fn.__qualname__ = name
+    fn.__doc__ = ("Elementwise %s of arrays or scalars (reference "
+                  "`mx.nd.%s`); dispatch incl. broadcasting rides "
+                  "NDArray._binary." % (name, name))
     return fn
 
 
-#: Elementwise max/min of arrays or scalars (reference `mx.nd.maximum`/
-#: `mx.nd.minimum`); dispatch (incl. broadcasting) rides NDArray._binary.
-maximum = _commutative_binary("_maximum", "_maximum_scalar", max)
-minimum = _commutative_binary("_minimum", "_minimum_scalar", min)
-maximum.__name__, minimum.__name__ = "maximum", "minimum"
+maximum = _commutative_binary("maximum", "_maximum", "_maximum_scalar",
+                              max)
+minimum = _commutative_binary("minimum", "_minimum", "_minimum_scalar",
+                              min)

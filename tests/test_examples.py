@@ -93,10 +93,11 @@ def test_sparse_linear_classification_dist(tmp_path):
 # breadth suite: one fast smoke per example family (SURVEY Appendix D)
 # ---------------------------------------------------------------------------
 
-def _run_example(relpath, *extra, timeout=560):
+def _run_example(relpath, *extra, timeout=560, env_extra=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
     cmd = [sys.executable, os.path.join(REPO, "examples", relpath)] + \
         list(extra)
     r = subprocess.run(cmd, capture_output=True, text=True,
@@ -171,3 +172,17 @@ def test_example_ssd_multibox_family():
 def test_example_ctc_ocr():
     out = _run_example("ctc/ocr_ctc.py", "--epochs", "8", timeout=560)
     assert "exact-sequence accuracy" in out
+
+
+def test_example_fcn_segmentation():
+    out = _run_example("fcn-xs/fcn_mini.py", "--epochs", "5")
+    assert "pixel accuracy" in out
+
+
+def test_example_remat_composes_with_training():
+    """MXTPU_BACKWARD_DO_MIRROR composes with the Module train path in a
+    real script (gradient checkpointing smoke)."""
+    out = _run_example("svm_mnist/svm_mnist.py", "--epochs", "3",
+                       env_extra={"MXTPU_BACKWARD_DO_MIRROR": "1",
+                                  "MXTPU_REMAT_POLICY": "dots"})
+    assert "accuracy" in out

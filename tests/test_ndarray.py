@@ -199,3 +199,20 @@ def test_dlpack_interchange():
     # writable export is refused loudly (immutable XLA buffers)
     with pytest.raises(mx.base.MXNetError):
         x.to_dlpack_for_write()
+
+
+def test_nd_maximum_minimum_dispatch():
+    a = mx.nd.array([[1.0, 5.0], [0.0, 2.0]])
+    b = mx.nd.array([3.0, 2.0])
+    np.testing.assert_allclose(mx.nd.maximum(a, b).asnumpy(),
+                               [[3, 5], [3, 2]])  # broadcast
+    np.testing.assert_allclose(mx.nd.minimum(a, 3).asnumpy(),
+                               [[1, 3], [0, 2]])
+    np.testing.assert_allclose(mx.nd.maximum(0, a).asnumpy(),
+                               [[1, 5], [0, 2]])
+    # numpy/list operands coerce instead of leaking NotImplemented
+    np.testing.assert_allclose(
+        mx.nd.maximum(a, np.array([3.0, 2.0], np.float32)).asnumpy(),
+        [[3, 5], [3, 2]])
+    assert mx.nd.maximum(2, 3) == 3  # host scalars
+    assert "maximum" in (mx.nd.maximum.__doc__ or "")
