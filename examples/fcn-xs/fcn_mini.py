@@ -119,7 +119,11 @@ def main():
     miou = float(np.mean(piou))
     pix_acc = float((pred == Y[:64]).mean())
     logging.info("pixel accuracy %.3f   mIoU(fg) %.3f", pix_acc, miou)
+    # both bars matter: pixel accuracy alone is satisfiable by an
+    # all-background predictor (~90% of pixels); foreground IoU proves
+    # the upsampled head actually localizes objects
     assert pix_acc > 0.9, "dense prediction should fit the shapes"
+    assert miou > 0.1, "foreground IoU must beat a degenerate predictor"
 
 
 if __name__ == "__main__":

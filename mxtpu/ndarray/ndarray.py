@@ -846,12 +846,12 @@ def _commutative_binary(name, op_ew, op_sc, host_fn):
         if isinstance(rhs, NDArray) and not isinstance(lhs, NDArray):
             lhs, rhs = rhs, lhs  # commutative: swap is free
         if not isinstance(rhs, (NDArray, int, float, np.generic)):
-            rhs = array(np.asarray(rhs))  # lists/np arrays coerce
-        out = lhs._binary(rhs, op_ew, op_sc)
-        if out is NotImplemented:
-            raise TypeError("%s: unsupported operand type %r"
-                            % (name, type(rhs)))
-        return out
+            try:
+                rhs = array(rhs)  # lists/np arrays coerce (f32 default)
+            except Exception:
+                raise TypeError("%s: unsupported operand type %r"
+                                % (name, type(rhs))) from None
+        return lhs._binary(rhs, op_ew, op_sc)
 
     fn.__name__ = fn.__qualname__ = name
     fn.__doc__ = ("Elementwise %s of arrays or scalars (reference "

@@ -1,0 +1,91 @@
+"""Generate the operator API reference from the live registry
+(reference: the sphinx op docs built from NNVM registry docstrings).
+
+Usage: python tools/gen_op_docs.py [-o docs/api/ops.md]
+"""
+import argparse
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-o", "--out", default="docs/api/ops.md")
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from mxtpu.ops import registry
+
+    seen = {}
+    aliases = {}
+    for name, opdef in registry._OP_REGISTRY.items():
+        if opdef.name not in seen:
+            seen[opdef.name] = opdef
+        if name != opdef.name:
+            aliases.setdefault(opdef.name, []).append(name)
+
+    groups = {}
+    for name, opdef in sorted(seen.items()):
+        mod = opdef.fn.__module__.rsplit(".", 1)[-1]
+        groups.setdefault(mod, []).append((name, opdef))
+
+    lines = ["# Operator reference",
+             "",
+             "Generated from the live registry by `tools/gen_op_docs.py`"
+             " — every op is a pure JAX emitter; gradients come from"
+             " `jax.vjp`, shapes from `jax.eval_shape`"
+             " (`mxtpu/ops/registry.py`).",
+             "",
+             "Total: %d ops (+%d aliases)."
+             % (len(seen), sum(len(v) for v in aliases.values())),
+             ""]
+    for mod in sorted(groups):
+        lines.append("## %s (%d ops)" % (mod, len(groups[mod])))
+        lines.append("")
+        for name, opdef in groups[mod]:
+            try:
+                sig = str(inspect.signature(opdef.fn))
+            except (TypeError, ValueError):
+                sig = "(...)"
+            flags = []
+            if not opdef.differentiable:
+                flags.append("non-differentiable")
+            if opdef.needs_rng:
+                flags.append("rng")
+            if opdef.train_aware:
+                flags.append("train-aware")
+            if callable(opdef.num_outputs) or opdef.num_outputs != 1:
+                flags.append("multi-output")
+            header = "### `%s%s`" % (name, sig)
+            lines.append(header)
+            meta = []
+            if flags:
+                meta.append("*%s*" % ", ".join(flags))
+            if name in aliases:
+                meta.append("aliases: %s" %
+                            ", ".join("`%s`" % a for a in aliases[name]))
+            if meta:
+                lines.append(" — ".join(meta))
+                lines.append("")
+            doc = (opdef.doc or "").strip()
+            if doc:
+                first = doc.split("\n\n")[0].strip()
+                lines.append(first)
+            lines.append("")
+    out_dir = os.path.dirname(args.out)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines))
+    print("wrote %s: %d ops in %d modules"
+          % (args.out, len(seen), len(groups)))
+
+
+if __name__ == "__main__":
+    main()
