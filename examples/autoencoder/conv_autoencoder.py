@@ -68,6 +68,7 @@ def main():
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(0)
+    np.random.seed(0)
 
     ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
     net = ConvAE(args.code_dim)

@@ -53,6 +53,7 @@ def main():
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(0)
+    np.random.seed(0)
 
     X, Y = make_data(seq_len=args.seq_len)
     ctx = mx.tpu() if mx.num_tpus() else mx.cpu()

@@ -74,6 +74,7 @@ def main():
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(0)
+    np.random.seed(0)
 
     sents, labels = make_corpus()
     counter = Counter(w for s in sents for w in s)

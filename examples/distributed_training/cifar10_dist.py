@@ -65,6 +65,7 @@ def main():
     p.add_argument("--lr", type=float, default=0.05)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    np.random.seed(0)
 
     kv = mx.kv.create(args.kvstore)
     logging.info("kvstore=%s rank=%d/%d", kv.type, kv.rank,

@@ -97,6 +97,7 @@ def main():
     ap.add_argument("--gpus", default="")  # parity flag; contexts below
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
+    np.random.seed(0)
 
     image_shape = (784,) if args.network == "mlp" else (1, 28, 28)
     net = mlp_symbol() if args.network == "mlp" else lenet_symbol()

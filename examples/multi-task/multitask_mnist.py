@@ -70,6 +70,7 @@ def main():
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(0)
+    np.random.seed(0)
 
     rng = np.random.RandomState(0)
     templates = rng.uniform(0, 1, (10, 64)).astype(np.float32)

@@ -59,6 +59,7 @@ def main():
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(0)
+    np.random.seed(0)
 
     ctxs, targets = make_bigrams(args.vocab)
     ctx = mx.tpu() if mx.num_tpus() else mx.cpu()

@@ -65,6 +65,7 @@ def main():
     logging.basicConfig(level=logging.INFO)
 
     mx.random.seed(0)
+    np.random.seed(0)
     rng = np.random.RandomState(0)
     templates = rng.uniform(0, 1, (10, 64)).astype(np.float32)
     y = rng.randint(0, 10, 1024)

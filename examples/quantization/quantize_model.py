@@ -44,6 +44,7 @@ def main():
     p.add_argument("--batch-size", type=int, default=64)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    np.random.seed(0)
 
     rng = np.random.RandomState(0)
     yy, xx = np.mgrid[:16, :16] / 16.0

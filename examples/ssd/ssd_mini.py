@@ -78,6 +78,7 @@ def main():
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(0)
+    np.random.seed(0)
 
     ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
     sizes, ratios = (0.3, 0.5), (1.0, 2.0)

@@ -52,6 +52,7 @@ def main():
     p.add_argument("--update-freq", type=int, default=2)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    np.random.seed(0)
 
     X, Y, true_w = make_data()
     net = build(X.shape[1])

@@ -137,7 +137,7 @@ def test_example_multitask():
 
 
 def test_example_custom_softmax():
-    out = _run_example("numpy-ops/custom_softmax.py", "--epochs", "3")
+    out = _run_example("numpy-ops/custom_softmax.py", "--epochs", "5")
     assert "custom softmax" in out
 
 
