@@ -1,0 +1,121 @@
+"""Neural style transfer, miniature.
+
+Analog of the reference's `example/neural-style/`: optimize the INPUT
+image so its conv features match a content image while its Gram
+matrices match a style image (Gatys et al. 2015).  The distinctive
+pattern here is gradient descent on pixels — `x.attach_grad()` plus a
+manual Adam loop over the input, not the parameters.
+
+Run:  python neural_style_mini.py [--steps 60]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+
+
+class FeatureNet(gluon.nn.HybridBlock):
+    """Small fixed (randomly-initialized) feature extractor — random
+    conv features carry enough structure for toy style transfer."""
+
+    def __init__(self):
+        super().__init__()
+        self.c1 = gluon.nn.Conv2D(8, 3, padding=1, activation="relu")
+        self.c2 = gluon.nn.Conv2D(16, 3, padding=1, activation="relu")
+
+    def hybrid_forward(self, F, x):
+        f1 = self.c1(x)
+        f2 = self.c2(F.Pooling(f1, kernel=(2, 2), stride=(2, 2),
+                               pool_type="avg"))
+        return f1, f2
+
+
+def gram(f):
+    n, c, h, w = f.shape
+    m = f.reshape((n, c, h * w))
+    return nd.batch_dot(m, m, transpose_b=True) / (c * h * w)
+
+
+def make_images(size=32, seed=0):
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[:size, :size] / size
+    content = ((yy - 0.5) ** 2 + (xx - 0.5) ** 2 < 0.1) \
+        .astype(np.float32)  # a disc
+    style = np.sin(12 * np.pi * xx).astype(np.float32) * 0.5 + 0.5  # stripes
+    c = np.stack([content] * 3)[None]
+    s = np.stack([style, style * 0.5, 1 - style])[None]
+    return c.astype(np.float32), s.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--style-weight", type=float, default=50.0)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
+    np.random.seed(0)
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    net = FeatureNet()
+    net.initialize(mx.initializer.Xavier(), ctx=ctx)
+    content_np, style_np = make_images()
+    content = nd.array(content_np, ctx=ctx)
+    style = nd.array(style_np, ctx=ctx)
+    with autograd.pause():
+        _, c2_t = net(content)               # content target (layer 2)
+        s1, s2 = net(style)
+        g1_t, g2_t = gram(s1), gram(s2)      # style targets
+
+    # init from noise (the reference's --init random option): both the
+    # content and style terms then have real distance to descend
+    x = nd.array(np.random.RandomState(1)
+                 .uniform(0.3, 0.7, content.shape).astype(np.float32),
+                 ctx=ctx)
+    x.attach_grad()
+    # manual Adam on the pixels
+    m = nd.zeros(x.shape, ctx=ctx)
+    v = nd.zeros(x.shape, ctx=ctx)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    first = last = None
+    for t in range(1, args.steps + 1):
+        with autograd.record():
+            f1, f2 = net(x)
+            closs = ((f2 - c2_t) ** 2).mean()
+            sloss = ((gram(f1) - g1_t) ** 2).mean() + \
+                ((gram(f2) - g2_t) ** 2).mean()
+            loss = closs + args.style_weight * sloss
+        loss.backward()
+        g = x.grad
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mh = m / (1 - b1 ** t)
+        vh = v / (1 - b2 ** t)
+        x = x - args.lr * mh / (vh.sqrt() + eps)
+        x = nd.clip(x, 0.0, 1.0)
+        x.attach_grad()
+        last = float(loss.asnumpy())
+        if first is None:
+            first = last
+        if t % 20 == 0:
+            logging.info("step %d loss %.5f (content %.5f style %.5f)",
+                         t, last, float(closs.asnumpy()),
+                         float(sloss.asnumpy()))
+    logging.info("loss %.5f -> %.5f", first, last)
+    assert last < first * 0.7, "pixel optimization should reduce the loss"
+    out = x.asnumpy()
+    assert out.min() >= 0.0 and out.max() <= 1.0
+
+
+if __name__ == "__main__":
+    main()

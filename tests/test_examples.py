@@ -186,3 +186,9 @@ def test_example_remat_composes_with_training():
                        env_extra={"MXTPU_BACKWARD_DO_MIRROR": "1",
                                   "MXTPU_REMAT_POLICY": "dots"})
     assert "accuracy" in out
+
+
+def test_example_neural_style():
+    out = _run_example("neural-style/neural_style_mini.py",
+                       "--steps", "40")
+    assert "loss" in out
