@@ -13,9 +13,12 @@ the output block is written once on the last k step.
 to the block size, runs the kernel on TPU (or in interpreter mode for
 CPU tests — `MXTPU_PALLAS_INTERPRET=1`), and falls back to a fused
 jnp reference implementation elsewhere.  The backward pass is a
-`jax.custom_vjp` using the standard recomputation formulation (XLA
-fuses it well; a Pallas backward is a further optimization, not a
-correctness need).
+`jax.custom_vjp` with the BLOCKED recompute formulation (paper §3.1):
+scores are rebuilt block by block against the LSE the forward saved
+(the kernel emits it as a second output), in two sweeps (dq; dk/dv)
+with fully-masked causal blocks skipped — backward memory is
+O(T·d + block²) like the forward; the T×T matrix is never
+materialized in either direction.
 
 Registered as `_contrib_flash_attention` (q, k, v of shape
 (batch, heads, seq, head_dim)).  `mxtpu.parallel`'s blockwise /
@@ -56,8 +59,8 @@ def _interpret():
 # the kernel
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  sm_scale, causal, block_q, block_k):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                  l_ref, *, sm_scale, causal, block_q, block_k):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
@@ -98,9 +101,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _finish():
-        l = l_ref[:, 0:1]
-        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)) \
-            .astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # per-row log-sum-exp, saved for the backward (lane-replicated
+        # to keep the 128-wide tile shape)
+        lse_ref[0] = jnp.broadcast_to(m_ref[:, 0:1] + jnp.log(l),
+                                      lse_ref.shape[1:])
 
 
 import jax  # noqa: E402  (module level: custom_vjp decorates at import)
@@ -117,17 +123,20 @@ def _flash_forward_pallas(q, k, v, sm_scale, causal, block_q, block_k):
     kernel = functools.partial(_flash_kernel, sm_scale=sm_scale,
                                causal=causal, block_q=block_q,
                                block_k=block_k)
-    return pl.pallas_call(
+    out, lse128 = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, tq, 128), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda b, i, j: (b, i, 0)),
+        out_specs=(pl.BlockSpec((1, block_q, d),
+                                lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, block_q, 128),
+                                lambda b, i, j: (b, i, 0))),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),     # acc
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max
@@ -135,10 +144,11 @@ def _flash_forward_pallas(q, k, v, sm_scale, causal, block_q, block_k):
         ],
         interpret=_interpret(),
     )(q, k, v)
+    return out, lse128[:, :, 0]
 
 
-def _reference_attention(q, k, v, sm_scale, causal):
-    """Fused jnp reference (also the CPU/GPU fallback path)."""
+def _reference_attention_lse(q, k, v, sm_scale, causal):
+    """Fused jnp reference; returns (out, per-row log-sum-exp)."""
     import jax.numpy as jnp
 
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
@@ -147,13 +157,21 @@ def _reference_attention(q, k, v, sm_scale, causal):
         tq, tk = s.shape[-2:]
         mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
         s = jnp.where(mask, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)) \
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)) \
         .astype(q.dtype)
+    return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k):
+def _reference_attention(q, k, v, sm_scale, causal):
+    """Fused jnp reference (also the CPU/GPU fallback path)."""
+    return _reference_attention_lse(q, k, v, sm_scale, causal)[0]
+
+
+def _flash_impl(q, k, v, sm_scale, causal, block_q, block_k):
+    """Returns (out, lse).  The lse rides along for the backward; the
+    non-differentiated path's copy is dead code XLA prunes."""
     if _use_pallas():
         tq, tk = q.shape[1], k.shape[1]
         pq = (-tq) % block_q
@@ -163,44 +181,132 @@ def _flash(q, k, v, sm_scale, causal, block_q, block_k):
         # kernel); ragged K lengths take the fused reference path.
         # Ragged Q is safe — padded query rows are sliced off.
         if pk:
-            return _reference_attention(q, k, v, sm_scale, causal)
+            return _reference_attention_lse(q, k, v, sm_scale, causal)
         if pq:
             import jax.numpy as jnp
 
             qp = jnp.pad(q, ((0, 0), (0, pq), (0, 0)))
-            out = _flash_forward_pallas(qp, k, v, sm_scale, causal,
-                                        block_q, block_k)
-            return out[:, :tq]
+            out, lse = _flash_forward_pallas(qp, k, v, sm_scale,
+                                             causal, block_q, block_k)
+            return out[:, :tq], lse[:, :tq]
         return _flash_forward_pallas(q, k, v, sm_scale, causal,
                                      block_q, block_k)
-    return _reference_attention(q, k, v, sm_scale, causal)
+    return _reference_attention_lse(q, k, v, sm_scale, causal)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k):
+    return _flash_impl(q, k, v, sm_scale, causal, block_q, block_k)[0]
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
-    out = _flash(q, k, v, sm_scale, causal, block_q, block_k)
-    return out, (q, k, v)
+    out, lse = _flash_impl(q, k, v, sm_scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _block_mask(causal, q0, k0, bq, bk):
+    import jax.numpy as jnp
+
+    if not causal:
+        return None
+    q_idx = q0 + jnp.arange(bq)[:, None]
+    k_idx = k0 + jnp.arange(bk)[None, :]
+    return q_idx >= k_idx
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
-    """Standard recompute backward (flash attention paper, eqs. 13-16):
-    XLA fuses the recomputation; activations are never stored."""
+    """Blocked recompute backward (flash attention paper §3.1): scores
+    are rebuilt block by block against the LSE saved by the forward, so
+    backward memory stays O(T·d + block²) — the T×T matrix is never
+    materialized.  Two sweeps (dq; dk/dv), with fully-masked causal
+    blocks skipped via loop bounds."""
     import jax.numpy as jnp
+    from jax import lax
 
-    q, k, v = res
-    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * sm_scale
-    if causal:
-        tq, tk = s.shape[-2:]
-        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
-        s = jnp.where(mask, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    g32 = g.astype(jnp.float32)
-    dv = jnp.einsum("bqk,bqd->bkd", p, g32)
-    dp = jnp.einsum("bqd,bkd->bqk", g32, v.astype(jnp.float32))
-    delta = jnp.sum(p * dp, axis=-1, keepdims=True)
-    ds = p * (dp - delta) * sm_scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32))
-    dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
+    q, k, v, out, lse_saved = res
+    B, Tq, D = q.shape
+    Tk = k.shape[1]
+    # blocks arrive pre-clamped by flash_attention (the only entry)
+    bq, bk = block_q, block_k
+    # pad to block multiples; padded K columns are masked by giving
+    # them -inf scores via the padded-position test below.  Padded Q
+    # rows get lse 0 (finite): their head-gradient rows are zero, so
+    # every term they touch is zero — but exp() must stay finite.
+    pq = (-Tq) % bq
+    pk = (-Tk) % bk
+    q32 = jnp.pad(q.astype(jnp.float32), ((0, 0), (0, pq), (0, 0)))
+    k32 = jnp.pad(k.astype(jnp.float32), ((0, 0), (0, pk), (0, 0)))
+    v32 = jnp.pad(v.astype(jnp.float32), ((0, 0), (0, pk), (0, 0)))
+    g32 = jnp.pad(g.astype(jnp.float32), ((0, 0), (0, pq), (0, 0)))
+    o32 = jnp.pad(out.astype(jnp.float32), ((0, 0), (0, pq), (0, 0)))
+    lse = jnp.pad(lse_saved, ((0, 0), (0, pq)))
+    nq = (Tq + pq) // bq
+    nk = (Tk + pk) // bk
+    k_valid = jnp.arange(Tk + pk) < Tk  # padded keys never attend
+    delta = (o32 * g32).sum(axis=-1)    # (B, Tq+pq)
+
+    def scores(qi, i, j):
+        kj = lax.dynamic_slice_in_dim(k32, j * bk, bk, 1)
+        s = jnp.einsum("bqd,bkd->bqk", qi, kj) * sm_scale
+        mask = _block_mask(causal, i * bq, j * bk, bq, bk)
+        kv = lax.dynamic_slice_in_dim(k_valid, j * bk, bk, 0)
+        s = jnp.where(kv[None, None, :], s, _NEG_INF)
+        if mask is not None:
+            s = jnp.where(mask[None], s, _NEG_INF)
+        return s, kj
+
+    # pass 1: dq, one q block at a time (the forward saved the LSE, so
+    # only the standard two recompute sweeps remain)
+    def dq_for_block(_, i):
+        qi = lax.dynamic_slice_in_dim(q32, i * bq, bq, 1)
+        gi = lax.dynamic_slice_in_dim(g32, i * bq, bq, 1)
+        li = lax.dynamic_slice_in_dim(lse, i * bq, bq, 1)
+        di = lax.dynamic_slice_in_dim(delta, i * bq, bq, 1)
+
+        def body(j, acc):
+            s, kj = scores(qi, i, j)
+            p = jnp.exp(s - li[..., None])
+            vj = lax.dynamic_slice_in_dim(v32, j * bk, bk, 1)
+            dp = jnp.einsum("bqd,bkd->bqk", gi, vj)
+            ds = p * (dp - di[..., None]) * sm_scale
+            return acc + jnp.einsum("bqk,bkd->bqd", ds, kj)
+
+        # causal: k blocks past this q block's diagonal are all-masked
+        nk_i = jnp.minimum((i * bq + bq - 1) // bk + 1, nk) \
+            if causal else nk
+        acc0 = jnp.zeros((B, bq, D), jnp.float32)
+        return _, lax.fori_loop(0, nk_i, body, acc0)
+
+    _, dq_blocks = lax.scan(dq_for_block, None, jnp.arange(nq))
+    dq = dq_blocks.transpose(1, 0, 2, 3).reshape(B, nq * bq, D)[:, :Tq]
+
+    # pass 2: dk/dv, one k block at a time
+    def dkv_for_block(_, j):
+        vj = lax.dynamic_slice_in_dim(v32, j * bk, bk, 1)
+
+        def body(i, carry):
+            dk_acc, dv_acc = carry
+            qi = lax.dynamic_slice_in_dim(q32, i * bq, bq, 1)
+            gi = lax.dynamic_slice_in_dim(g32, i * bq, bq, 1)
+            li = lax.dynamic_slice_in_dim(lse, i * bq, bq, 1)
+            di = lax.dynamic_slice_in_dim(delta, i * bq, bq, 1)
+            s, qkj = scores(qi, i, j)
+            p = jnp.exp(s - li[..., None])
+            dv_acc = dv_acc + jnp.einsum("bqk,bqd->bkd", p, gi)
+            dp = jnp.einsum("bqd,bkd->bqk", gi, vj)
+            ds = p * (dp - di[..., None]) * sm_scale
+            dk_acc = dk_acc + jnp.einsum("bqk,bqd->bkd", ds, qi)
+            return dk_acc, dv_acc
+
+        # causal: q blocks before this k block's diagonal see none of it
+        i0 = jnp.minimum((j * bk) // bq, nq) if causal else 0
+        z = jnp.zeros((B, bk, D), jnp.float32)
+        return _, lax.fori_loop(i0, nq, body, (z, z))
+
+    _, (dk_blocks, dv_blocks) = lax.scan(dkv_for_block, None,
+                                         jnp.arange(nk))
+    dk = dk_blocks.transpose(1, 0, 2, 3).reshape(B, nk * bk, D)[:, :Tk]
+    dv = dv_blocks.transpose(1, 0, 2, 3).reshape(B, nk * bk, D)[:, :Tk]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
