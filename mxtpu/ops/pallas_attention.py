@@ -59,8 +59,12 @@ def _interpret():
 # the kernel
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                  l_ref, *, sm_scale, causal, block_q, block_k):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale, causal,
+                  block_q, block_k, want_lse):
+    if want_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        (acc_ref, m_ref, l_ref), lse_ref = rest, None
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
@@ -103,16 +107,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
     def _finish():
         l = jnp.maximum(l_ref[:, 0:1], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        # per-row log-sum-exp, saved for the backward (lane-replicated
-        # to keep the 128-wide tile shape)
-        lse_ref[0] = jnp.broadcast_to(m_ref[:, 0:1] + jnp.log(l),
-                                      lse_ref.shape[1:])
+        if lse_ref is not None:
+            # per-row log-sum-exp, saved for the backward (lane-
+            # replicated to keep the 128-wide tile shape)
+            lse_ref[0] = jnp.broadcast_to(m_ref[:, 0:1] + jnp.log(l),
+                                          lse_ref.shape[1:])
 
 
 import jax  # noqa: E402  (module level: custom_vjp decorates at import)
 
 
-def _flash_forward_pallas(q, k, v, sm_scale, causal, block_q, block_k):
+def _flash_forward_pallas(q, k, v, sm_scale, causal, block_q, block_k,
+                          want_lse):
+    """Runs the kernel; returns (out, lse or None).  The LSE output is
+    built only when requested — pallas_call is an opaque custom call,
+    so an unused output would still be written to HBM."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -122,21 +131,25 @@ def _flash_forward_pallas(q, k, v, sm_scale, causal, block_q, block_k):
     grid = (bh, pl.cdiv(tq, block_q), pl.cdiv(tk, block_k))
     kernel = functools.partial(_flash_kernel, sm_scale=sm_scale,
                                causal=causal, block_q=block_q,
-                               block_k=block_k)
-    out, lse128 = pl.pallas_call(
+                               block_k=block_k, want_lse=want_lse)
+    out_shape = [jax.ShapeDtypeStruct((bh, tq, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d),
+                              lambda b, i, j: (b, i, 0))]
+    if want_lse:
+        out_shape.append(
+            jax.ShapeDtypeStruct((bh, tq, 128), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, block_q, 128),
+                                      lambda b, i, j: (b, i, 0)))
+    outs = pl.pallas_call(
         kernel,
-        out_shape=(jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-                   jax.ShapeDtypeStruct((bh, tq, 128), jnp.float32)),
+        out_shape=tuple(out_shape),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=(pl.BlockSpec((1, block_q, d),
-                                lambda b, i, j: (b, i, 0)),
-                   pl.BlockSpec((1, block_q, 128),
-                                lambda b, i, j: (b, i, 0))),
+        out_specs=tuple(out_specs),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),     # acc
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max
@@ -144,7 +157,9 @@ def _flash_forward_pallas(q, k, v, sm_scale, causal, block_q, block_k):
         ],
         interpret=_interpret(),
     )(q, k, v)
-    return out, lse128[:, :, 0]
+    if want_lse:
+        return outs[0], outs[1][:, :, 0]
+    return outs[0], None
 
 
 def _reference_attention_lse(q, k, v, sm_scale, causal):
@@ -169,9 +184,11 @@ def _reference_attention(q, k, v, sm_scale, causal):
     return _reference_attention_lse(q, k, v, sm_scale, causal)[0]
 
 
-def _flash_impl(q, k, v, sm_scale, causal, block_q, block_k):
-    """Returns (out, lse).  The lse rides along for the backward; the
-    non-differentiated path's copy is dead code XLA prunes."""
+def _flash_impl(q, k, v, sm_scale, causal, block_q, block_k, want_lse):
+    """Returns (out, lse-or-None).  The LSE is produced only for the
+    differentiated path: the pallas kernel writes it as a real second
+    output (not prunable), while the jnp reference's unused copy is
+    ordinary dead code."""
     if _use_pallas():
         tq, tk = q.shape[1], k.shape[1]
         pq = (-tq) % block_q
@@ -187,20 +204,23 @@ def _flash_impl(q, k, v, sm_scale, causal, block_q, block_k):
 
             qp = jnp.pad(q, ((0, 0), (0, pq), (0, 0)))
             out, lse = _flash_forward_pallas(qp, k, v, sm_scale,
-                                             causal, block_q, block_k)
-            return out[:, :tq], lse[:, :tq]
+                                             causal, block_q, block_k,
+                                             want_lse)
+            return out[:, :tq], (lse[:, :tq] if want_lse else None)
         return _flash_forward_pallas(q, k, v, sm_scale, causal,
-                                     block_q, block_k)
+                                     block_q, block_k, want_lse)
     return _reference_attention_lse(q, k, v, sm_scale, causal)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, sm_scale, causal, block_q, block_k):
-    return _flash_impl(q, k, v, sm_scale, causal, block_q, block_k)[0]
+    return _flash_impl(q, k, v, sm_scale, causal, block_q, block_k,
+                       want_lse=False)[0]
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
-    out, lse = _flash_impl(q, k, v, sm_scale, causal, block_q, block_k)
+    out, lse = _flash_impl(q, k, v, sm_scale, causal, block_q, block_k,
+                           want_lse=True)
     return out, (q, k, v, out, lse)
 
 
@@ -290,7 +310,7 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
             gi = lax.dynamic_slice_in_dim(g32, i * bq, bq, 1)
             li = lax.dynamic_slice_in_dim(lse, i * bq, bq, 1)
             di = lax.dynamic_slice_in_dim(delta, i * bq, bq, 1)
-            s, qkj = scores(qi, i, j)
+            s, _ = scores(qi, i, j)
             p = jnp.exp(s - li[..., None])
             dv_acc = dv_acc + jnp.einsum("bqk,bqd->bkd", p, gi)
             dp = jnp.einsum("bqd,bkd->bqk", gi, vj)

@@ -178,3 +178,21 @@ def test_flash_gradients_ragged_multiblock():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-4,
                                    err_msg="d%s" % name)
+
+
+def test_flash_bfloat16_roundtrip():
+    """bf16 inputs: internal math is fp32, output returns bf16; values
+    track the fp32 reference within bf16 tolerance."""
+    import jax.numpy as jnp
+
+    from mxtpu.ops.pallas_attention import flash_attention
+
+    rng = np.random.RandomState(7)
+    qf, kf, vf = (rng.normal(0, 1, (2, 128, 64)).astype(np.float32)
+                  for _ in range(3))
+    q, k, v = (jnp.asarray(x, dtype=jnp.bfloat16) for x in (qf, kf, vf))
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    assert out.dtype == jnp.bfloat16
+    gold = _naive(qf, kf, vf, 1.0 / np.sqrt(64), True)
+    np.testing.assert_allclose(np.asarray(out).astype(np.float32), gold,
+                               rtol=0.05, atol=0.05)
