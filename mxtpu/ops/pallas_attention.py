@@ -18,7 +18,10 @@ scores are rebuilt block by block against the LSE the forward saved
 (the kernel emits it as a second output), in two sweeps (dq; dk/dv)
 with fully-masked causal blocks skipped — backward memory is
 O(T·d + block²) like the forward; the T×T matrix is never
-materialized in either direction.
+materialized in either direction.  The sweeps themselves are Pallas
+kernels when shapes divide the blocks (`_flash_bwd_dq_kernel`,
+`_flash_bwd_dkv_kernel`), with equivalent jnp loops as the ragged /
+non-TPU fallback.
 
 Registered as `_contrib_flash_attention` (q, k, v of shape
 (batch, heads, seq, head_dim)).  `mxtpu.parallel`'s blockwise /
@@ -162,6 +165,150 @@ def _flash_forward_pallas(q, k, v, sm_scale, causal, block_q, block_k,
     return outs[0], None
 
 
+def _bwd_p_ds(q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref, i, j, *,
+              sm_scale, causal, block_q, block_k):
+    """Shared backward block math: rebuild the score block against the
+    saved LSE and return (p, ds, q, k, g) — ONE copy of the masking and
+    the ds formula for both sweeps."""
+    import jax.numpy as jnp
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]          # (bq, 1)
+    dlt = dlt_ref[0][:, None]
+    s = jnp.dot(q, k.T) * sm_scale
+    if causal:
+        q_idx = jnp.arange(block_q)[:, None] + i * block_q
+        k_idx = jnp.arange(block_k)[None, :] + j * block_k
+        s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
+    p = jnp.exp(s - lse)
+    dp = jnp.dot(g, v.T)
+    ds = p * (dp - dlt) * sm_scale
+    return p, ds, q, k, g
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref,
+                         dq_ref, acc_ref, *, sm_scale, causal, block_q,
+                         block_k):
+    """dq sweep: grid (bh, nq, nk), k innermost; accumulates
+    ds·K into VMEM scratch and writes the q block's dq once."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _step():
+        _, ds, _, k, _ = _bwd_p_ds(
+            q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref, i, j,
+            sm_scale=sm_scale, causal=causal, block_q=block_q,
+            block_k=block_k)
+        acc_ref[:] = acc_ref[:] + jnp.dot(ds, k)
+
+    if causal:
+        pl.when(j * block_k <= (i + 1) * block_q - 1)(_step)
+    else:
+        _step()
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale,
+                          causal, block_q, block_k):
+    """dk/dv sweep: grid (bh, nk, nq), q innermost."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _step():
+        p, ds, q, _, g = _bwd_p_ds(
+            q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref, i, j,
+            sm_scale=sm_scale, causal=causal, block_q=block_q,
+            block_k=block_k)
+        dv_acc[:] = dv_acc[:] + jnp.dot(p.T, g)
+        dk_acc[:] = dk_acc[:] + jnp.dot(ds.T, q)
+
+    if causal:
+        # q blocks strictly above this k block's diagonal see none of it
+        pl.when((i + 1) * block_q - 1 >= j * block_k)(_step)
+    else:
+        _step()
+
+    @pl.when(i == pl.num_programs(2) - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward_pallas(q, k, v, g, out, lse, sm_scale, causal,
+                           block_q, block_k):
+    """Pallas backward: two kernel launches (dq; dk/dv) over the saved
+    LSE — the TPU-kernel analog of the jnp blocked sweeps below."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    # (bh, tq) row vectors enter as (1, block_q) blocks — no
+    # lane-replication blow-up in HBM
+    delta = (out.astype(jnp.float32) * g.astype(jnp.float32)) \
+        .sum(axis=-1)
+    nq = tq // block_q
+    nk = tk // block_k
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    rspec = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q,
+                          block_k=block_k),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, rspec, rspec],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, g, lse, delta)
+
+    # dkv grid: (bh, nk, nq) — q innermost; index maps swap (i, j)
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    rspec2 = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q,
+                          block_k=block_k),
+        out_shape=(jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, tk, d), v.dtype)),
+        grid=(bh, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rspec2, rspec2],
+        out_specs=(kspec2, kspec2),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
 def _reference_attention_lse(q, k, v, sm_scale, causal):
     """Fused jnp reference; returns (out, per-row log-sum-exp)."""
     import jax.numpy as jnp
@@ -248,6 +395,10 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
     Tk = k.shape[1]
     # blocks arrive pre-clamped by flash_attention (the only entry)
     bq, bk = block_q, block_k
+    if _use_pallas() and Tq % bq == 0 and Tk % bk == 0:
+        # kernel path (same math as the jnp sweeps below, on the MXU)
+        return _flash_backward_pallas(q, k, v, g, out, lse_saved,
+                                      sm_scale, causal, bq, bk)
     # pad to block multiples; padded K columns are masked by giving
     # them -inf scores via the padded-position test below.  Padded Q
     # rows get lse 0 (finite): their head-gradient rows are zero, so
