@@ -35,8 +35,15 @@ if _user_platforms:
 
         if _jax.config.jax_platforms != _user_platforms:
             _jax.config.update("jax_platforms", _user_platforms)
-    except Exception:  # backends already initialized — leave them be
-        pass
+        del _jax
+    except Exception as _e:  # pragma: no cover - depends on site config
+        # jax unimportable (the package lazy-imports it everywhere else)
+        # or backends already initialized; log instead of hiding it
+        import logging as _logging
+
+        _logging.getLogger(__name__).debug(
+            "could not re-assert JAX_PLATFORMS=%s: %s", _user_platforms, _e)
+        del _logging
 del _os, _user_platforms
 
 from .base import MXNetError, MXTPUError

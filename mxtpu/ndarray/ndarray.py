@@ -839,10 +839,15 @@ def to_dlpack_for_write(data: NDArray):
     return data.to_dlpack_for_write()
 
 
-def _commutative_binary(name, op_ew, op_sc, host_fn):
+def _commutative_binary(name, op_ew, op_sc, host_fn, host_ew):
     def fn(lhs, rhs):
         if not isinstance(lhs, NDArray) and not isinstance(rhs, NDArray):
-            return host_fn(lhs, rhs)
+            # elementwise for array-likes; Python max/min only handles
+            # scalars (multi-element arrays raise ambiguous-truth-value)
+            if isinstance(lhs, (int, float, np.generic)) and \
+                    isinstance(rhs, (int, float, np.generic)):
+                return host_fn(lhs, rhs)
+            return host_ew(lhs, rhs)
         if isinstance(rhs, NDArray) and not isinstance(lhs, NDArray):
             lhs, rhs = rhs, lhs  # commutative: swap is free
         if not isinstance(rhs, (NDArray, int, float, np.generic)):
@@ -861,6 +866,6 @@ def _commutative_binary(name, op_ew, op_sc, host_fn):
 
 
 maximum = _commutative_binary("maximum", "_maximum", "_maximum_scalar",
-                              max)
+                              max, np.maximum)
 minimum = _commutative_binary("minimum", "_minimum", "_minimum_scalar",
-                              min)
+                              min, np.minimum)

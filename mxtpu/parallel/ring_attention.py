@@ -65,13 +65,22 @@ def _online_block(q, k, v, acc, row_max, row_sum, mask_bias, scale):
 
 
 def blockwise_attention(q, k, v, block_size: int = 512,
-                        causal: bool = False, scale: Optional[float] = None):
+                        causal: bool = False, scale: Optional[float] = None,
+                        use_pallas: Optional[bool] = None):
     """Memory-efficient attention via blocked online softmax.
 
     q, k, v: [B, H, T, D] (q may have different T than k/v).  Never
     materializes the full [T, T] score matrix: peak memory is
     O(T * block_size) per head, which is what lets a single chip run
     sequence lengths the reference could not.
+
+    `use_pallas` selects the Pallas flash kernel for the square
+    self-attention case; when None it falls back to the
+    ``MXTPU_USE_PALLAS`` env var.  Both paths accumulate in float32 and
+    return ``q.dtype``.  NOTE: the routing decision is STATIC — under
+    ``jit`` it is resolved once at trace time, so flipping the env var
+    after the first compiled call has no effect on cached executables
+    (pass ``use_pallas`` explicitly, or set the env var before tracing).
     """
     import jax
     import jax.numpy as jnp
@@ -84,7 +93,9 @@ def blockwise_attention(q, k, v, block_size: int = 512,
     # and shard_map-collective paths keep the jnp formulation)
     import os
 
-    if Tq == Tk and os.environ.get("MXTPU_USE_PALLAS", "0") == "1":
+    if use_pallas is None:
+        use_pallas = os.environ.get("MXTPU_USE_PALLAS", "0") == "1"
+    if Tq == Tk and use_pallas:
         from ..ops.pallas_attention import flash_attention
 
         return flash_attention(q, k, v, sm_scale=scale, causal=causal,

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Watch the axon TPU tunnel; the moment it answers, run the full bench
+# and capture results.  Probe uses the safe subprocess pattern from
+# bench._probe_tpu (a wedged tunnel hangs forever in-process).
+# Exits 0 with BENCH_r05_live.json written on success, 7 on deadline.
+cd /root/repo
+DEADLINE=$(( $(date +%s) + ${TPU_WATCH_DEADLINE_S:-21600} ))
+N=0
+while true; do
+  N=$((N+1))
+  STATE=$(timeout 130 python -c "from bench import _probe_tpu; print(_probe_tpu(timeout=100))" 2>/dev/null | tail -1)
+  echo "$(date +%H:%M:%S) probe $N: $STATE" >> /tmp/tpu_watch.log
+  if [ "$STATE" = "ok" ]; then
+    echo "$(date +%H:%M:%S) TPU LIVE — running bench" >> /tmp/tpu_watch.log
+    MXTPU_BENCH_TPU_WAIT=120 MXTPU_BENCH_BUDGET_S=2400 \
+      timeout 3000 python bench.py > /root/repo/BENCH_r05_live.json 2> /tmp/bench_r05.err
+    RC=$?
+    echo "$(date +%H:%M:%S) bench rc=$RC" >> /tmp/tpu_watch.log
+    exit $RC
+  fi
+  if [ $(date +%s) -gt $DEADLINE ]; then
+    echo "deadline reached, tunnel never answered" >> /tmp/tpu_watch.log
+    exit 7
+  fi
+  sleep 240
+done
