@@ -1,0 +1,289 @@
+"""Python engine behind the flat C ABI (`src/c_api.cc`).
+
+The reference exposes ~200 `MX*` C functions
+(`include/mxnet/c_api.h:412` onward) that underpin every language
+binding and embedding; its predict ABI got a TPU-native analog in round
+4 (`src/predict.cc` over `mxtpu.predict_embed`).  This module is the
+engine for the CORE tier of that flat API: NDArray create/copy/save,
+op enumeration + imperative invoke, KVStore init/push/pull, and data
+iterators — the function groups `python/mxnet/{ndarray,kvstore,io}`
+sit on in the reference.
+
+Contract with the C layer: every function takes/returns plain Python
+objects; the C side holds `PyObject*`s as opaque handles and frees them
+with Py_DECREF.  Keep the module import-light — the embedded
+interpreter imports it once per process; mxtpu itself loads lazily on
+first use.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+__all__ = [
+    "version", "seed", "wait_all", "list_op_names", "get_op",
+    "imperative_invoke", "ndarray_create", "nd_itemsize", "nd_copy_from_bytes",
+    "nd_to_bytes", "nd_shape", "nd_dtype_code", "nd_context",
+    "nd_save", "nd_load", "kv_create", "kv_init", "kv_push", "kv_pull",
+    "iter_create", "iter_before_first", "iter_next", "iter_data",
+    "iter_label",
+]
+
+
+def _mx():
+    import mxtpu
+
+    return mxtpu
+
+
+def version() -> int:
+    """MXGetVersion: MAJOR*10000 + MINOR*100 + PATCH (reference
+    include/mxnet/base.h MXNET_VERSION encoding)."""
+    parts = _mx().__version__.split(".")[:3]
+    nums = [int("".join(ch for ch in p if ch.isdigit()) or 0)
+            for p in parts]
+    while len(nums) < 3:
+        nums.append(0)
+    return nums[0] * 10000 + nums[1] * 100 + nums[2]
+
+
+def seed(s: int) -> None:
+    _mx().random.seed(int(s))
+
+
+def wait_all() -> None:
+    """MXNDArrayWaitAll: barrier on all outstanding async work — the
+    native engine's queues plus device computations."""
+    from mxtpu.engine import get_engine
+
+    get_engine().wait_for_all()
+    try:
+        import jax
+
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+def list_op_names() -> List[str]:
+    """MXListAllOpNames (c_api.h): every registered operator name."""
+    from mxtpu.ops.registry import list_ops
+
+    return sorted(list_ops())
+
+
+def get_op(name: str) -> str:
+    """Analog of NNGetOpHandle / MXSymbolListAtomicSymbolCreators +
+    GetAtomicSymbolName: resolve an op name to an opaque handle."""
+    from mxtpu.ops.registry import has_op
+
+    if not has_op(name):
+        raise KeyError("no such operator: %s" % name)
+    return name  # the name itself is a perfectly good opaque handle
+
+
+def _parse_c_attr(v: str):
+    """The C wire format is string-typed attrs (reference
+    MXImperativeInvoke keys/vals); parse numbers/tuples/bools the way
+    the reference's parameter structs do, leaving enum-ish strings
+    (e.g. act_type='relu') alone."""
+    import ast
+
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        if v in ("True", "true"):
+            return True
+        if v in ("False", "false"):
+            return False
+        return v
+
+
+def imperative_invoke(op_name: str, inputs: Sequence, keys: Sequence[str],
+                      vals: Sequence[str]) -> list:
+    """MXImperativeInvoke (c_api.h:968): run one op eagerly on NDArray
+    handles with string-typed attrs; returns the output NDArray list."""
+    from mxtpu.ndarray.ndarray import imperative_invoke as _invoke
+
+    attrs = {k: _parse_c_attr(v) for k, v in zip(keys, vals)}
+    out = _invoke(op_name, *list(inputs), **attrs)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+# -- NDArray ---------------------------------------------------------------
+
+def _ctx(dev_type: int, dev_id: int):
+    mx = _mx()
+    # reference dev_type codes: 1=cpu, 2=gpu (tpu here), 3=cpu_pinned
+    if dev_type == 2:
+        return mx.tpu(dev_id)
+    if dev_type == 3:
+        return mx.cpu_pinned(dev_id)
+    return mx.cpu(dev_id)
+
+
+def ndarray_create(shape: Sequence[int], dev_type: int, dev_id: int,
+                   dtype_code: int):
+    """MXNDArrayCreateEx: a zero-initialized array (delay_alloc is
+    meaningless under XLA's buffer model)."""
+    from mxtpu.base import dtype_mx_to_np
+
+    mx = _mx()
+    return mx.nd.zeros(tuple(int(s) for s in shape),
+                       ctx=_ctx(dev_type, dev_id),
+                       dtype=dtype_mx_to_np(dtype_code))
+
+
+def nd_itemsize(arr) -> int:
+    return int(np.dtype(arr.dtype).itemsize)
+
+
+def _check_size(arr, size: int, fn: str) -> None:
+    # reference NDArray::SyncCopyFromCPU: CHECK_EQ(shape().Size(), size)
+    if int(arr.size) != int(size):
+        raise ValueError("%s: size mismatch — array has %d elements, "
+                         "caller passed %d" % (fn, int(arr.size), size))
+
+
+def nd_copy_from_bytes(arr, data: bytes, size: int) -> None:
+    """MXNDArraySyncCopyFromCPU: `size` is the element count (reference
+    semantics); `data` carries size*itemsize raw little-endian bytes in
+    the array's dtype, row-major."""
+    _check_size(arr, size, "MXNDArraySyncCopyFromCPU")
+    np_val = np.frombuffer(data, dtype=arr.dtype).reshape(arr.shape)
+    arr[:] = np_val
+
+
+def nd_to_bytes(arr, size: int) -> bytes:
+    """MXNDArraySyncCopyToCPU: validates the element count, returns the
+    full payload."""
+    _check_size(arr, size, "MXNDArraySyncCopyToCPU")
+    return np.ascontiguousarray(arr.asnumpy()).tobytes()
+
+
+def nd_shape(arr) -> List[int]:
+    return [int(s) for s in arr.shape]
+
+
+def nd_dtype_code(arr) -> int:
+    from mxtpu.base import dtype_np_to_mx
+
+    return dtype_np_to_mx(arr.dtype)
+
+
+def nd_context(arr):
+    ctx = arr.ctx
+    dev_type = {"cpu": 1, "tpu": 2, "gpu": 2, "cpu_pinned": 3,
+                "cpu_shared": 5}.get(ctx.device_type, 1)
+    return (dev_type, int(ctx.device_id))
+
+
+def nd_save(fname: str, arrays: Sequence, keys: Sequence[str]) -> None:
+    """MXNDArraySave: same container format as mx.nd.save (round-trips
+    with the Python frontend)."""
+    mx = _mx()
+    if keys:
+        mx.nd.save(fname, dict(zip(keys, arrays)))
+    else:
+        mx.nd.save(fname, list(arrays))
+
+
+def nd_load(fname: str):
+    """MXNDArrayLoad -> (arrays, names); names is empty for list
+    containers."""
+    mx = _mx()
+    loaded = mx.nd.load(fname)
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        return [loaded[k] for k in names], names
+    return list(loaded), []
+
+
+# -- KVStore ---------------------------------------------------------------
+
+def kv_create(kv_type: str):
+    return _mx().kv.create(kv_type)
+
+
+def _kv_keys(keys):
+    return [int(k) for k in keys]
+
+
+def kv_init(kv, keys, vals) -> None:
+    kv.init(_kv_keys(keys), list(vals))
+
+
+def kv_push(kv, keys, vals, priority: int) -> None:
+    kv.push(_kv_keys(keys), list(vals), priority=priority)
+
+
+def kv_pull(kv, keys, outs, priority: int) -> None:
+    kv.pull(_kv_keys(keys), out=list(outs), priority=priority)
+
+
+# -- Data iterators --------------------------------------------------------
+
+_ITER_ARG_TYPES = {
+    "batch_size": int, "shuffle": lambda v: v not in ("0", "False",
+                                                      "false", ""),
+    "last_batch_handle": str, "data_name": str, "label_name": str,
+    "round_batch": lambda v: v not in ("0", "False", "false", ""),
+    "num_parts": int, "part_index": int, "prefetch_depth": int,
+}
+
+
+class _CIter(object):
+    """Holds the iterator plus the current batch for GetData/GetLabel
+    (reference MXDataIterGetData semantics: valid until the next
+    Next())."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+
+def iter_create(name: str, keys: Sequence[str], vals: Sequence[str]):
+    """MXListDataIters + MXDataIterCreateIter: create a registered
+    iterator by name with string-typed kwargs (the C wire format).
+    Array-valued kwargs (data/label) are file paths or unsupported from
+    C — NDArrayIter from C feeds via `data_handle`-style kwargs is out
+    of scope; CSVIter/MNISTIter/LibSVMIter cover the C use case."""
+    import mxtpu.io as mio
+
+    kwargs: Dict[str, object] = {}
+    for k, v in zip(keys, vals):
+        conv = _ITER_ARG_TYPES.get(k)
+        if conv is not None:
+            kwargs[k] = conv(v)
+        else:
+            # shapes arrive as "(a, b)" tuples, everything else raw
+            vs = v.strip()
+            if vs.startswith("("):
+                kwargs[k] = tuple(
+                    int(t) for t in vs.strip("()").split(",") if t.strip())
+            else:
+                kwargs[k] = v
+    return _CIter(mio.create(name, **kwargs))
+
+
+def iter_before_first(ci: _CIter) -> None:
+    ci.it.reset()
+    ci.batch = None
+
+
+def iter_next(ci: _CIter) -> bool:
+    try:
+        ci.batch = ci.it.next()
+        return True
+    except StopIteration:
+        ci.batch = None
+        return False
+
+
+def iter_data(ci: _CIter):
+    return ci.batch.data[0]
+
+
+def iter_label(ci: _CIter):
+    return ci.batch.label[0]

@@ -1,0 +1,485 @@
+/*
+ * Flat C ABI — the core tier of the reference's 200-function MX* API
+ * (`include/mxnet/c_api.h:412` onward): NDArray create/copy/save/load,
+ * operator enumeration + imperative invoke, KVStore init/push/pull, and
+ * data iterators.  These are the function groups every language binding
+ * and embedding in the reference sits on (MXNDArrayCreateEx,
+ * MXImperativeInvoke, MXKVStore*, MXDataIter*).
+ *
+ * Architecture: same embedded-CPython approach proven by the predict
+ * ABI (`src/predict.cc`) — the library embeds one interpreter and
+ * drives `mxtpu.c_embed`, so C callers get the SAME XLA compute path,
+ * op registry (395 ops), and KVStore implementations as Python users.
+ * Handles are opaque `PyObject*`s; every call takes the GIL.  Returned
+ * pointer/array buffers follow the reference's convention: valid until
+ * the next call in the same group (c_api.h "out" docs).
+ *
+ * Tradeoff (documented in README): unlike the reference's amalgamation
+ * build, this ABI carries a CPython runtime dependency — the price of
+ * one engine instead of two.
+ */
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "embed_common.h"
+
+namespace {
+
+using mxtpu_embed::Gil;
+using mxtpu_embed::set_error;
+using mxtpu_embed::set_error_from_python;
+
+/* call mxtpu.c_embed.<fn>(*args); returns new ref or nullptr with the
+ * error recorded.  Caller must hold the GIL. */
+PyObject* embed_call(const char* fn, PyObject* args) {
+  return mxtpu_embed::module_call("mxtpu.c_embed", fn, args);
+}
+
+PyObject* str_list(const char** items, uint32_t n) {
+  PyObject* lst = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i)
+    PyList_SetItem(lst, i, PyUnicode_FromString(items[i]));
+  return lst;
+}
+
+PyObject* int_list(const int* items, uint32_t n) {
+  PyObject* lst = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i)
+    PyList_SetItem(lst, i, PyLong_FromLong(items[i]));
+  return lst;
+}
+
+/* borrowed handles -> python list (INCREFs each) */
+PyObject* handle_list(void* const* handles, uint32_t n) {
+  PyObject* lst = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject* o = static_cast<PyObject*>(handles[i]);
+    Py_INCREF(o);
+    PyList_SetItem(lst, i, o);
+  }
+  return lst;
+}
+
+/* ---- stable out-buffer storage (reference: valid until next call) ---- */
+std::mutex g_buf_mu;
+std::vector<std::string> g_name_store;
+std::vector<const char*> g_name_ptrs;
+std::unordered_map<void*, std::vector<uint32_t>> g_shape_store;
+/* separate stores per function group so MXImperativeInvoke outputs stay
+ * valid across an MXNDArrayLoad and vice versa (the documented
+ * "valid until the next call in the same group" contract) */
+std::vector<void*> g_invoke_store;
+std::vector<void*> g_load_store;
+
+/* expose a python list[str] as (size, const char**) with stable storage */
+int export_names(PyObject* lst, uint32_t* out_size,
+                 const char*** out_array) {
+  std::lock_guard<std::mutex> lk(g_buf_mu);
+  Py_ssize_t n = PyList_Size(lst);
+  g_name_store.clear();
+  g_name_ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+    g_name_store.emplace_back(s ? s : "");
+  }
+  for (auto& s : g_name_store) g_name_ptrs.push_back(s.c_str());
+  *out_size = static_cast<uint32_t>(n);
+  *out_array = g_name_ptrs.data();
+  return 0;
+}
+
+int fail() { return -1; }
+
+}  // namespace
+
+extern "C" {
+
+/* ---- runtime ---------------------------------------------------------- */
+
+const char* MXGetLastError() { return mxtpu_embed::get_error(); }
+
+int MXGetVersion(int* out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* res = embed_call("version", nullptr);
+  if (!res) return fail();
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRandomSeed(int s) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(i)", s);
+  PyObject* res = embed_call("seed", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitAll() {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* res = embed_call("wait_all", nullptr);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNotifyShutdown() {
+  /* reference semantics: flush outstanding async work before exit */
+  return MXNDArrayWaitAll();
+}
+
+/* ---- operators -------------------------------------------------------- */
+
+int MXListAllOpNames(uint32_t* out_size, const char*** out_array) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* res = embed_call("list_op_names", nullptr);
+  if (!res) return fail();
+  int rc = export_names(res, out_size, out_array);
+  Py_DECREF(res);
+  return rc;
+}
+
+/* analog of NNGetOpHandle; the handle feeds MXImperativeInvoke the way
+ * AtomicSymbolCreator does in the reference (c_api.h:968) */
+int MXGetOpHandle(const char* name, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(s)", name);
+  PyObject* res = embed_call("get_op", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res; /* ownership to caller (a PyUnicode of the op name) */
+  return 0;
+}
+
+int MXImperativeInvoke(void* op_handle, int num_inputs, void** inputs,
+                       int* num_outputs, void*** outputs, int num_params,
+                       const char** param_keys, const char** param_vals) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* ins = handle_list(inputs, num_inputs);
+  PyObject* keys = str_list(param_keys, num_params);
+  PyObject* vals = str_list(param_vals, num_params);
+  PyObject* args = Py_BuildValue("(OOOO)",
+                                 static_cast<PyObject*>(op_handle),
+                                 ins, keys, vals);
+  Py_DECREF(ins);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  PyObject* res = embed_call("imperative_invoke", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  std::lock_guard<std::mutex> lk(g_buf_mu);
+  Py_ssize_t n = PyList_Size(res);
+  g_invoke_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(res, i);
+    Py_INCREF(o); /* caller owns each output handle (MXNDArrayFree) */
+    g_invoke_store.push_back(o);
+  }
+  Py_DECREF(res);
+  *num_outputs = static_cast<int>(n);
+  *outputs = g_invoke_store.data();
+  return 0;
+}
+
+/* ---- NDArray ---------------------------------------------------------- */
+
+int MXNDArrayCreateEx(const uint32_t* shape, uint32_t ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype, void** out) {
+  (void)delay_alloc; /* XLA owns buffer lifetime */
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* shp = PyList_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyList_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject* args = Py_BuildValue("(Oiii)", shp, dev_type, dev_id, dtype);
+  Py_DECREF(shp);
+  PyObject* res = embed_call("ndarray_create", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res;
+  return 0;
+}
+
+int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dev_type,
+                    int dev_id, int delay_alloc, void** out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc,
+                           /*dtype=float32*/ 0, out);
+}
+
+int MXNDArrayFree(void* handle) {
+  if (!handle) return 0;
+  if (Py_IsInitialized()) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    Py_DECREF(static_cast<PyObject*>(handle));
+    PyGILState_Release(st);
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_buf_mu);
+    g_shape_store.erase(handle);
+  }
+  return 0;
+}
+
+/* reference semantics (c_api.h:627/641 + NDArray::SyncCopyFromCPU):
+ * `size` is the ELEMENT count and must equal the array's shape size —
+ * mismatches error instead of silently truncating */
+int MXNDArraySyncCopyFromCPU(void* handle, const void* data, size_t size) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* h = static_cast<PyObject*>(handle);
+  PyObject* args0 = Py_BuildValue("(O)", h);
+  PyObject* isz = embed_call("nd_itemsize", args0);
+  Py_DECREF(args0);
+  if (!isz) return fail();
+  size_t nbytes = size * static_cast<size_t>(PyLong_AsLong(isz));
+  Py_DECREF(isz);
+  PyObject* blob = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), static_cast<Py_ssize_t>(nbytes));
+  PyObject* args = Py_BuildValue("(OOn)", h, blob,
+                                 static_cast<Py_ssize_t>(size));
+  Py_DECREF(blob);
+  PyObject* res = embed_call("nd_copy_from_bytes", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(void* handle, void* data, size_t size) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(On)", static_cast<PyObject*>(handle),
+                                 static_cast<Py_ssize_t>(size));
+  PyObject* res = embed_call("nd_to_bytes", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &n) != 0) {
+    set_error_from_python();
+    Py_DECREF(res);
+    return fail();
+  }
+  /* python validated size == arr.size, so n is exactly the payload */
+  std::memcpy(data, buf, static_cast<size_t>(n));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetShape(void* handle, uint32_t* out_dim,
+                      const uint32_t** out_pdata) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("nd_shape", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  std::lock_guard<std::mutex> lk(g_buf_mu);
+  auto& store = g_shape_store[handle];
+  Py_ssize_t n = PyList_Size(res);
+  store.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    store[i] = static_cast<uint32_t>(PyLong_AsLong(PyList_GetItem(res, i)));
+  Py_DECREF(res);
+  *out_dim = static_cast<uint32_t>(n);
+  *out_pdata = store.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(void* handle, int* out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("nd_dtype_code", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetContext(void* handle, int* out_dev_type, int* out_dev_id) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("nd_context", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out_dev_type = static_cast<int>(
+      PyLong_AsLong(PyTuple_GetItem(res, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 1)));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySave(const char* fname, uint32_t num_args, void** args_h,
+                  const char** keys) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* arrs = handle_list(args_h, num_args);
+  PyObject* ks = keys ? str_list(keys, num_args) : PyList_New(0);
+  PyObject* args = Py_BuildValue("(sOO)", fname, arrs, ks);
+  Py_DECREF(arrs);
+  Py_DECREF(ks);
+  PyObject* res = embed_call("nd_save", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayLoad(const char* fname, uint32_t* out_size, void*** out_arr,
+                  uint32_t* out_name_size, const char*** out_names) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(s)", fname);
+  PyObject* res = embed_call("nd_load", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  PyObject* arrs = PyTuple_GetItem(res, 0);
+  PyObject* names = PyTuple_GetItem(res, 1);
+  {
+    std::lock_guard<std::mutex> lk(g_buf_mu);
+    Py_ssize_t n = PyList_Size(arrs);
+    g_load_store.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* o = PyList_GetItem(arrs, i);
+      Py_INCREF(o);
+      g_load_store.push_back(o);
+    }
+    *out_size = static_cast<uint32_t>(n);
+    *out_arr = g_load_store.data();
+  }
+  export_names(names, out_name_size, out_names);
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---- KVStore ---------------------------------------------------------- */
+
+int MXKVStoreCreate(const char* type, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(s)", type);
+  PyObject* res = embed_call("kv_create", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res;
+  return 0;
+}
+
+int MXKVStoreFree(void* handle) { return MXNDArrayFree(handle); }
+
+static int kv_call(const char* fn, void* handle, uint32_t num,
+                   const int* keys, void** vals, int priority,
+                   bool with_prio) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* ks = int_list(keys, num);
+  PyObject* vs = handle_list(vals, num);
+  PyObject* args =
+      with_prio ? Py_BuildValue("(OOOi)", static_cast<PyObject*>(handle),
+                                ks, vs, priority)
+                : Py_BuildValue("(OOO)", static_cast<PyObject*>(handle),
+                                ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyObject* res = embed_call(fn, args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreInit(void* handle, uint32_t num, const int* keys,
+                  void** vals) {
+  return kv_call("kv_init", handle, num, keys, vals, 0, false);
+}
+
+int MXKVStorePush(void* handle, uint32_t num, const int* keys, void** vals,
+                  int priority) {
+  return kv_call("kv_push", handle, num, keys, vals, priority, true);
+}
+
+int MXKVStorePull(void* handle, uint32_t num, const int* keys, void** vals,
+                  int priority) {
+  return kv_call("kv_pull", handle, num, keys, vals, priority, true);
+}
+
+/* ---- Data iterators --------------------------------------------------- */
+
+int MXDataIterCreateIter(const char* name, uint32_t num_param,
+                         const char** keys, const char** vals, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* ks = str_list(keys, num_param);
+  PyObject* vs = str_list(vals, num_param);
+  PyObject* args = Py_BuildValue("(sOO)", name, ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyObject* res = embed_call("iter_create", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res;
+  return 0;
+}
+
+int MXDataIterFree(void* handle) { return MXNDArrayFree(handle); }
+
+int MXDataIterBeforeFirst(void* handle) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("iter_before_first", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterNext(void* handle, int* out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("iter_next", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = PyObject_IsTrue(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+static int iter_get(const char* fn, void* handle, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call(fn, args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res; /* caller frees with MXNDArrayFree */
+  return 0;
+}
+
+int MXDataIterGetData(void* handle, void** out) {
+  return iter_get("iter_data", handle, out);
+}
+
+int MXDataIterGetLabel(void* handle, void** out) {
+  return iter_get("iter_label", handle, out);
+}
+
+}  // extern "C"

@@ -1,0 +1,113 @@
+/*
+ * Shared embedded-CPython plumbing for the C ABI libraries
+ * (`src/predict.cc`, `src/c_api.cc`): interpreter bootstrap, GIL
+ * scoping, and last-error capture.
+ *
+ * Thread model: the first MX* call from any thread boots the
+ * interpreter exactly once (std::call_once) and then RELEASES the GIL
+ * (PyEval_SaveThread) — Py_InitializeEx leaves the booting thread
+ * holding it, which would deadlock every other thread's
+ * PyGILState_Ensure forever.  After that, every call acquires/releases
+ * via the Gil RAII scope, so multithreaded C consumers are safe.
+ */
+#ifndef MXTPU_EMBED_COMMON_H_
+#define MXTPU_EMBED_COMMON_H_
+
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+namespace mxtpu_embed {
+
+inline std::string& last_error() {
+  static std::string err;
+  return err;
+}
+
+inline std::mutex& err_mu() {
+  static std::mutex mu;
+  return mu;
+}
+
+inline void set_error(const std::string& msg) {
+  std::lock_guard<std::mutex> lk(err_mu());
+  last_error() = msg;
+}
+
+inline const char* get_error() { return last_error().c_str(); }
+
+inline void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+inline bool ensure_python() {
+  static std::once_flag once;
+  static bool ok = false;
+  std::call_once(once, [] {
+    if (Py_IsInitialized()) {
+      ok = true;
+      return;
+    }
+    Py_InitializeEx(0);
+    ok = Py_IsInitialized();
+    /* release the GIL the booting thread implicitly holds; every call
+     * site re-acquires through Gil/PyGILState_Ensure */
+    if (ok) PyEval_SaveThread();
+  });
+  return ok;
+}
+
+/* RAII GIL scope (also boots the interpreter on first use) */
+struct Gil {
+  PyGILState_STATE st;
+  bool ok;
+  Gil() : st(), ok(ensure_python()) {
+    if (ok) st = PyGILState_Ensure();
+  }
+  ~Gil() {
+    if (ok) PyGILState_Release(st);
+  }
+  Gil(const Gil&) = delete;
+  Gil& operator=(const Gil&) = delete;
+};
+
+/* call module.fn(*args) -> new ref or nullptr (error recorded); caller
+ * must hold the GIL */
+inline PyObject* module_call(const char* module, const char* fn,
+                             PyObject* args) {
+  PyObject* mod = PyImport_ImportModule(module);
+  if (!mod) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (!f) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* res = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  if (!res) set_error_from_python();
+  return res;
+}
+
+}  // namespace mxtpu_embed
+
+#endif  // MXTPU_EMBED_COMMON_H_

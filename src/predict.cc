@@ -15,48 +15,21 @@
 
 #include <cstdint>
 #include <cstring>
-#include <mutex>
 #include <string>
 #include <vector>
 
+#include "embed_common.h"
+
 namespace {
 
-std::string g_last_error;
-std::mutex g_err_mu;
-
-void set_error(const std::string& msg) {
-  std::lock_guard<std::mutex> lk(g_err_mu);
-  g_last_error = msg;
-}
-
-void set_error_from_python() {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  PyErr_NormalizeException(&type, &value, &tb);
-  std::string msg = "python error";
-  if (value) {
-    PyObject* s = PyObject_Str(value);
-    if (s) {
-      msg = PyUnicode_AsUTF8(s);
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-  set_error(msg);
-}
+using mxtpu_embed::set_error;
+using mxtpu_embed::set_error_from_python;
+using mxtpu_embed::ensure_python;
 
 struct Predictor {
   PyObject* obj;                       // mxtpu.predict_embed.Predictor
   std::vector<uint32_t> shape_buf;     // backing store for GetOutputShape
 };
-
-bool ensure_python() {
-  if (Py_IsInitialized()) return true;
-  Py_InitializeEx(0);
-  return Py_IsInitialized();
-}
 
 /* call obj.method(args) -> new ref or nullptr (error recorded) */
 PyObject* call_method(PyObject* obj, const char* name, PyObject* args) {
@@ -75,7 +48,7 @@ PyObject* call_method(PyObject* obj, const char* name, PyObject* args) {
 
 extern "C" {
 
-const char* MXTPUPredGetLastError() { return g_last_error.c_str(); }
+const char* MXTPUPredGetLastError() { return mxtpu_embed::get_error(); }
 
 /* reference MXPredCreate (c_predict_api.h:78): dev_type 1=cpu 2=tpu */
 int MXTPUPredCreate(const char* symbol_json_str, const void* param_bytes,

@@ -1,0 +1,185 @@
+/* Flat C ABI end-to-end consumer: exercises every function group of
+ * libmxtpu_c.so (runtime, op enumeration + imperative invoke, NDArray,
+ * KVStore, DataIter) the way a language binding would (reference
+ * include/mxnet/c_api.h).  argv[1] = a CSV file for CSVIter;
+ * argv[2] = a scratch path for save/load.  Prints "group:<name> ok"
+ * lines the pytest harness asserts on, exits nonzero on any failure. */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+extern const char* MXGetLastError(void);
+extern int MXGetVersion(int*);
+extern int MXRandomSeed(int);
+extern int MXNDArrayWaitAll(void);
+extern int MXNotifyShutdown(void);
+extern int MXListAllOpNames(uint32_t*, const char***);
+extern int MXGetOpHandle(const char*, void**);
+extern int MXImperativeInvoke(void*, int, void**, int*, void***, int,
+                              const char**, const char**);
+extern int MXNDArrayCreateEx(const uint32_t*, uint32_t, int, int, int, int,
+                             void**);
+extern int MXNDArrayCreate(const uint32_t*, uint32_t, int, int, int, void**);
+extern int MXNDArrayFree(void*);
+extern int MXNDArraySyncCopyFromCPU(void*, const void*, size_t); /* element count */
+extern int MXNDArraySyncCopyToCPU(void*, void*, size_t);
+extern int MXNDArrayGetShape(void*, uint32_t*, const uint32_t**);
+extern int MXNDArrayGetDType(void*, int*);
+extern int MXNDArrayGetContext(void*, int*, int*);
+extern int MXNDArraySave(const char*, uint32_t, void**, const char**);
+extern int MXNDArrayLoad(const char*, uint32_t*, void***, uint32_t*,
+                         const char***);
+extern int MXKVStoreCreate(const char*, void**);
+extern int MXKVStoreFree(void*);
+extern int MXKVStoreInit(void*, uint32_t, const int*, void**);
+extern int MXKVStorePush(void*, uint32_t, const int*, void**, int);
+extern int MXKVStorePull(void*, uint32_t, const int*, void**, int);
+extern int MXDataIterCreateIter(const char*, uint32_t, const char**,
+                                const char**, void**);
+extern int MXDataIterFree(void*);
+extern int MXDataIterBeforeFirst(void*);
+extern int MXDataIterNext(void*, int*);
+extern int MXDataIterGetData(void*, void**);
+extern int MXDataIterGetLabel(void*, void**);
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s (last error: %s)\n", __FILE__,  \
+              __LINE__, #cond, MXGetLastError());                     \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <csv path> <save path>\n", argv[0]);
+    return 2;
+  }
+
+  /* -- runtime group -- */
+  int version = 0;
+  CHECK(MXGetVersion(&version) == 0 && version > 0);
+  CHECK(MXRandomSeed(7) == 0);
+  printf("group:runtime ok version=%d\n", version);
+
+  /* -- op enumeration -- */
+  uint32_t n_ops = 0;
+  const char** op_names = NULL;
+  CHECK(MXListAllOpNames(&n_ops, &op_names) == 0);
+  CHECK(n_ops > 300);
+  int seen_fc = 0;
+  for (uint32_t i = 0; i < n_ops; ++i)
+    if (strcmp(op_names[i], "FullyConnected") == 0) seen_fc = 1;
+  CHECK(seen_fc);
+  printf("group:oplist ok n=%u\n", n_ops);
+
+  /* -- NDArray group: create, fill, read back -- */
+  uint32_t shape[2] = {2, 3};
+  void* a = NULL;
+  CHECK(MXNDArrayCreateEx(shape, 2, /*cpu*/ 1, 0, 0, /*f32*/ 0, &a) == 0);
+  float data[6] = {1, 2, 3, 4, 5, 6};
+  CHECK(MXNDArraySyncCopyFromCPU(a, data, 6) == 0); /* size = ELEMENT count */
+  uint32_t ndim = 0;
+  const uint32_t* pshape = NULL;
+  CHECK(MXNDArrayGetShape(a, &ndim, &pshape) == 0);
+  CHECK(ndim == 2 && pshape[0] == 2 && pshape[1] == 3);
+  int dtype = -1, dev_type = 0, dev_id = -1;
+  CHECK(MXNDArrayGetDType(a, &dtype) == 0 && dtype == 0);
+  CHECK(MXNDArrayGetContext(a, &dev_type, &dev_id) == 0);
+  /* size-mismatch must ERROR, not truncate (reference CHECK_EQ) */
+  CHECK(MXNDArraySyncCopyFromCPU(a, data, 5) != 0);
+  float back[6] = {0};
+  CHECK(MXNDArraySyncCopyToCPU(a, back, 6) == 0);
+  for (int i = 0; i < 6; ++i) CHECK(back[i] == data[i]);
+  printf("group:ndarray ok dev_type=%d\n", dev_type);
+
+  /* -- imperative invoke: _plus(a, a) == 2a -- */
+  void* plus = NULL;
+  CHECK(MXGetOpHandle("elemwise_add", &plus) == 0);
+  void* ins[2] = {a, a};
+  int n_out = 0;
+  void** outs = NULL;
+  CHECK(MXImperativeInvoke(plus, 2, ins, &n_out, &outs, 0, NULL, NULL) == 0);
+  CHECK(n_out == 1);
+  void* sum = outs[0];
+  CHECK(MXNDArraySyncCopyToCPU(sum, back, 6) == 0);
+  for (int i = 0; i < 6; ++i) CHECK(back[i] == 2 * data[i]);
+
+  /* attrs path: FullyConnected with num_hidden */
+  uint32_t wshape[2] = {4, 3};
+  uint32_t bshape[1] = {4};
+  void *w = NULL, *b = NULL;
+  CHECK(MXNDArrayCreateEx(wshape, 2, 1, 0, 0, 0, &w) == 0);
+  CHECK(MXNDArrayCreateEx(bshape, 1, 1, 0, 0, 0, &b) == 0);
+  void* fc = NULL;
+  CHECK(MXGetOpHandle("FullyConnected", &fc) == 0);
+  const char* keys[1] = {"num_hidden"};
+  const char* vals[1] = {"4"};
+  void* fc_ins[3] = {a, w, b};
+  CHECK(MXImperativeInvoke(fc, 3, fc_ins, &n_out, &outs, 1, keys, vals) ==
+        0);
+  CHECK(n_out == 1);
+  const uint32_t* oshape = NULL;
+  CHECK(MXNDArrayGetShape(outs[0], &ndim, &oshape) == 0);
+  CHECK(ndim == 2 && oshape[0] == 2 && oshape[1] == 4);
+  CHECK(MXNDArrayFree(outs[0]) == 0);
+  printf("group:invoke ok\n");
+
+  /* -- save / load -- */
+  const char* save_keys[2] = {"weight", "bias"};
+  void* save_arrs[2] = {w, b};
+  CHECK(MXNDArraySave(argv[2], 2, save_arrs, save_keys) == 0);
+  uint32_t n_loaded = 0, n_names = 0;
+  void** loaded = NULL;
+  const char** names = NULL;
+  CHECK(MXNDArrayLoad(argv[2], &n_loaded, &loaded, &n_names, &names) == 0);
+  CHECK(n_loaded == 2 && n_names == 2);
+  printf("group:saveload ok first=%s\n", names[0]);
+
+  /* -- KVStore -- */
+  void* kv = NULL;
+  CHECK(MXKVStoreCreate("local", &kv) == 0);
+  int kv_keys[1] = {9};
+  void* kv_vals[1] = {a};
+  CHECK(MXKVStoreInit(kv, 1, kv_keys, kv_vals) == 0);
+  CHECK(MXKVStorePush(kv, 1, kv_keys, kv_vals, 0) == 0);
+  void* pulled = NULL;
+  CHECK(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &pulled) == 0);
+  void* kv_outs[1] = {pulled};
+  CHECK(MXKVStorePull(kv, 1, kv_keys, kv_outs, 0) == 0);
+  CHECK(MXNDArraySyncCopyToCPU(pulled, back, 6) == 0);
+  /* local kvstore: init set the value; push adds a, pull returns merged */
+  printf("group:kvstore ok pulled0=%g\n", back[0]);
+
+  /* -- DataIter: CSVIter over argv[1] (4 rows of 3 floats) -- */
+  const char* it_keys[4] = {"data_csv", "data_shape", "batch_size",
+                            "round_batch"};
+  const char* it_vals[4] = {argv[1], "(3,)", "2", "0"};
+  void* it = NULL;
+  CHECK(MXDataIterCreateIter("CSVIter", 4, it_keys, it_vals, &it) == 0);
+  CHECK(MXDataIterBeforeFirst(it) == 0);
+  int has_next = 0, batches = 0;
+  while (MXDataIterNext(it, &has_next) == 0 && has_next) {
+    void* batch_data = NULL;
+    CHECK(MXDataIterGetData(it, &batch_data) == 0);
+    CHECK(MXNDArrayGetShape(batch_data, &ndim, &pshape) == 0);
+    CHECK(ndim == 2 && pshape[0] == 2 && pshape[1] == 3);
+    CHECK(MXNDArrayFree(batch_data) == 0);
+    batches++;
+  }
+  CHECK(batches == 2);
+  CHECK(MXDataIterFree(it) == 0);
+  printf("group:dataiter ok batches=%d\n", batches);
+
+  CHECK(MXNDArrayWaitAll() == 0);
+  CHECK(MXNDArrayFree(a) == 0);
+  CHECK(MXNDArrayFree(w) == 0);
+  CHECK(MXNDArrayFree(b) == 0);
+  CHECK(MXNDArrayFree(pulled) == 0);
+  CHECK(MXKVStoreFree(kv) == 0);
+  CHECK(MXNotifyShutdown() == 0);
+  printf("ALL-GROUPS-OK\n");
+  return 0;
+}

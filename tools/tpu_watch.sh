@@ -13,9 +13,13 @@ while true; do
   if [ "$STATE" = "ok" ]; then
     echo "$(date +%H:%M:%S) TPU LIVE — running bench" >> /tmp/tpu_watch.log
     MXTPU_BENCH_TPU_WAIT=120 MXTPU_BENCH_BUDGET_S=2400 \
-      timeout 3000 python bench.py > /root/repo/BENCH_r05_live.json 2> /tmp/bench_r05.err
+      timeout 3000 python bench.py > /tmp/bench_r05_live.tmp 2> /tmp/bench_r05.err
     RC=$?
     echo "$(date +%H:%M:%S) bench rc=$RC" >> /tmp/tpu_watch.log
+    # only publish a complete run; a partial/timed-out file is garbage
+    if [ $RC -eq 0 ]; then
+      mv /tmp/bench_r05_live.tmp /root/repo/BENCH_r05_live.json
+    fi
     exit $RC
   fi
   if [ $(date +%s) -gt $DEADLINE ]; then
