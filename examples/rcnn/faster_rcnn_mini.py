@@ -1,0 +1,171 @@
+"""Faster R-CNN, miniature — the reference's `example/rcnn/` pipeline
+end to end on a synthetic one-object detection task: a conv backbone,
+an RPN head whose outputs feed `_contrib_Proposal` (anchor transform +
+blocked greedy NMS), `ROIPooling` over the proposed regions, and a
+Fast R-CNN head with joint softmax classification + smooth-L1 bbox
+regression (reference `example/rcnn/symnet/symbol_resnet.py` roles).
+
+Synthetic task: each 64x64 image contains one bright axis-aligned
+square (class 1) or cross (class 2) on a noisy background; the model
+must classify the ROI and refine its box.
+
+Run:  python faster_rcnn_mini.py [--epochs 6]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+
+IMG = 64
+FEAT_STRIDE = 8          # backbone downsamples 64 -> 8
+NUM_CLASSES = 3          # background + {square, cross}
+
+
+def make_batch(rng, n):
+    """Images with one object each; returns images (n,1,64,64), class
+    ids (n,), ground-truth boxes (n,4) in pixels."""
+    imgs = rng.uniform(0, 0.25, (n, 1, IMG, IMG)).astype(np.float32)
+    cls = rng.randint(1, NUM_CLASSES, n)
+    boxes = np.zeros((n, 4), np.float32)
+    for i in range(n):
+        size = rng.randint(14, 26)
+        x0 = rng.randint(2, IMG - size - 2)
+        y0 = rng.randint(2, IMG - size - 2)
+        if cls[i] == 1:   # filled square
+            imgs[i, 0, y0:y0 + size, x0:x0 + size] = 1.0
+        else:             # cross
+            cx, cy = x0 + size // 2, y0 + size // 2
+            imgs[i, 0, cy - 2:cy + 2, x0:x0 + size] = 1.0
+            imgs[i, 0, y0:y0 + size, cx - 2:cx + 2] = 1.0
+        boxes[i] = (x0, y0, x0 + size - 1, y0 + size - 1)
+    return imgs, cls.astype(np.int64), boxes
+
+
+class Backbone(gluon.nn.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.body = gluon.nn.HybridSequential()
+            for ch in (16, 32, 32):   # three stride-2 stages: 64 -> 8
+                self.body.add(gluon.nn.Conv2D(ch, 3, strides=2, padding=1,
+                                              activation="relu"))
+
+    def hybrid_forward(self, F, x):
+        return self.body(x)
+
+
+def iou_xyxy(a, b):
+    ix0 = np.maximum(a[:, 0], b[:, 0])
+    iy0 = np.maximum(a[:, 1], b[:, 1])
+    ix1 = np.minimum(a[:, 2], b[:, 2])
+    iy1 = np.minimum(a[:, 3], b[:, 3])
+    inter = np.maximum(ix1 - ix0 + 1, 0) * np.maximum(iy1 - iy0 + 1, 0)
+    area = lambda z: (z[:, 2] - z[:, 0] + 1) * (z[:, 3] - z[:, 1] + 1)
+    return inter / (area(a) + area(b) - inter)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+    ctx = mx.cpu()
+
+    backbone = Backbone()
+    # RPN head: objectness (2 per anchor) + box deltas (4 per anchor)
+    n_anchor = 3
+    rpn_conv = gluon.nn.Conv2D(32, 3, padding=1, activation="relu")
+    rpn_cls = gluon.nn.Conv2D(2 * n_anchor, 1)
+    rpn_reg = gluon.nn.Conv2D(4 * n_anchor, 1)
+    # Fast R-CNN head over 4x4 pooled ROIs
+    head = gluon.nn.HybridSequential()
+    head.add(gluon.nn.Dense(64, activation="relu"))
+    cls_fc = gluon.nn.Dense(NUM_CLASSES)
+    box_fc = gluon.nn.Dense(4)
+    blocks = [backbone, rpn_conv, rpn_cls, rpn_reg, head, cls_fc, box_fc]
+    params = gluon.ParameterDict()
+    for b in blocks:
+        b.initialize(ctx=ctx)
+        params.update(b.collect_params())
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": args.lr})
+
+    prop_kw = dict(rpn_pre_nms_top_n=48, rpn_post_nms_top_n=4,
+                   threshold=0.7, rpn_min_size=8,
+                   scales=(2.0, 3.0, 4.0), ratios=(1.0,),
+                   feature_stride=FEAT_STRIDE)
+    im_info = nd.array(np.tile([IMG, IMG, 1.0],
+                               (args.batch_size, 1)).astype(np.float32))
+
+    for epoch in range(args.epochs):
+        tot, correct, lsum = 0, 0, 0.0
+        for _ in range(12):
+            imgs, cls, gt = make_batch(rng, args.batch_size)
+            x = nd.array(imgs)
+            with autograd.record():
+                feat = backbone(x)
+                r = rpn_conv(feat)
+                rpn_score = nd.softmax(
+                    rpn_cls(r).reshape((0, 2, -1)), axis=1) \
+                    .reshape((0, 2 * n_anchor,
+                              IMG // FEAT_STRIDE, IMG // FEAT_STRIDE))
+                rpn_delta = rpn_reg(r)
+                # proposals ride the SAME graph (no grad through NMS,
+                # matching the reference's Proposal op semantics)
+                rois = nd.contrib.MultiProposal(
+                    nd.BlockGrad(rpn_score), nd.BlockGrad(rpn_delta),
+                    im_info, **prop_kw)
+                pooled = nd.ROIPooling(feat, rois, pooled_size=(4, 4),
+                                       spatial_scale=1.0 / FEAT_STRIDE)
+                h = head(pooled.reshape((pooled.shape[0], -1)))
+                logits = cls_fc(h)
+                deltas = box_fc(h)
+                # assign each ROI the image-level target (one object)
+                rois_np = rois.asnumpy()
+                img_idx = rois_np[:, 0].astype(int)
+                labels = nd.array(cls[img_idx])
+                g = gt[img_idx]
+                rb = rois_np[:, 1:]
+                # degenerate proposals (x1<x0 after clipping) would put
+                # NaN into the targets — and NaN*0 defeats the pos mask
+                rw = np.maximum(rb[:, 2] - rb[:, 0] + 1.0, 1.0)
+                rh = np.maximum(rb[:, 3] - rb[:, 1] + 1.0, 1.0)
+                tgt = np.stack(
+                    [((g[:, 0] + g[:, 2]) - (rb[:, 0] + rb[:, 2])) / 2 / rw,
+                     ((g[:, 1] + g[:, 3]) - (rb[:, 1] + rb[:, 3])) / 2 / rh,
+                     np.log((g[:, 2] - g[:, 0] + 1) / rw),
+                     np.log((g[:, 3] - g[:, 1] + 1) / rh)], 1)
+                tgt = np.clip(tgt, -4.0, 4.0).astype(np.float32)
+                # only ROIs overlapping the object learn the box
+                pos = (iou_xyxy(rb, g) > 0.3).astype(np.float32)[:, None]
+                ce = nd.softmax_cross_entropy(logits, labels) / labels.shape[0]
+                sl1 = (nd.smooth_l1(deltas - nd.array(tgt), scalar=1.0) *
+                       nd.array(pos)).mean()
+                loss = ce + sl1
+            loss.backward()
+            trainer.step(1)
+            lsum += float(loss.asnumpy())
+            pred = logits.asnumpy().argmax(1)
+            correct += int((pred == cls[img_idx]).sum())
+            tot += len(img_idx)
+        acc = correct / max(tot, 1)
+        logging.info("epoch %d rcnn loss %.4f roi accuracy %.3f",
+                     epoch, lsum / 12, acc)
+    print("FINAL_ROI_ACCURACY %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

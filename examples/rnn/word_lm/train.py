@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Word-level LSTM language model — the analog of the reference's
+`example/rnn/word_lm/train.py` (BASELINE config #3's named deliverable):
+a stateful Module-path LM with truncated BPTT, hidden state carried
+across batches within an epoch, optional tied embedding/output weights
+(`--tied`), global-norm gradient clipping, and per-epoch train/valid
+perplexity with lr annealing on plateau — the reference's training
+recipe (train.py:61-118), TPU-native.
+
+Corpus: `--text FILE` (whitespace-tokenized, reference data.py Corpus
+role) or a seeded synthetic Markov corpus with learnable structure so
+perplexity provably drops.
+
+Run:  python train.py --epochs 4 [--tied]
+"""
+import argparse
+import logging
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+
+
+class Corpus(object):
+    """Reference word_lm/data.py Corpus: builds a vocab and a flat
+    token stream; here from a file or the synthetic generator."""
+
+    def __init__(self, path=None, n_tokens=60000, vocab=200, seed=3):
+        if path:
+            with open(path) as f:
+                words = f.read().split()
+            uniq = sorted(set(words))
+            self.vocab = {w: i for i, w in enumerate(uniq)}
+            self.data = np.array([self.vocab[w] for w in words], np.int64)
+        else:
+            rng = np.random.RandomState(seed)
+            toks = [rng.randint(1, vocab)]
+            for _ in range(n_tokens - 1):
+                toks.append((toks[-1] * 7 + 3) % vocab
+                            if rng.rand() < 0.85 else rng.randint(0, vocab))
+            self.vocab = {i: i for i in range(vocab)}
+            self.data = np.array(toks, np.int64)
+
+    def batchify(self, batch_size):
+        nb = len(self.data) // batch_size
+        return self.data[:nb * batch_size].reshape(
+            batch_size, nb).T  # (nbatch, batch_size)
+
+
+class RNNModel(gluon.nn.HybridBlock):
+    """Embedding -> n-layer LSTM -> (tied) decoder (reference
+    word_lm/model.py rnn())."""
+
+    def __init__(self, vocab_size, emsize, nhid, nlayers, dropout,
+                 tied, **kw):
+        super().__init__(**kw)
+        self.nhid, self.nlayers = nhid, nlayers
+        with self.name_scope():
+            self.drop = gluon.nn.Dropout(dropout)
+            self.encoder = gluon.nn.Embedding(vocab_size, emsize)
+            self.rnn = gluon.rnn.LSTM(nhid, num_layers=nlayers,
+                                      dropout=dropout)
+            if tied:
+                if nhid != emsize:
+                    raise ValueError("--tied requires emsize == nhid")
+                self.decoder = gluon.nn.Dense(
+                    vocab_size, flatten=False,
+                    params=self.encoder.params)
+            else:
+                self.decoder = gluon.nn.Dense(vocab_size, flatten=False)
+
+    def hybrid_forward(self, F, x, states):
+        # x: (bptt, batch)
+        emb = self.drop(self.encoder(x))
+        out, states = self.rnn(emb, states)
+        return self.decoder(self.drop(out)), states
+
+    def begin_state(self, batch_size, ctx):
+        return self.rnn.begin_state(batch_size=batch_size, ctx=ctx)
+
+
+def detach(states):
+    return [s.detach() for s in states]
+
+
+def clip_global_norm(params, max_norm):
+    grads = [p.grad() for p in params.values() if p.grad_req != "null"]
+    total = math.sqrt(sum(float((g ** 2).sum().asnumpy())
+                          for g in grads))
+    if total > max_norm:
+        scale = max_norm / total
+        for g in grads:
+            g *= scale
+    return total
+
+
+def run_epoch(model, data, bptt, loss_fn, trainer=None, clip=0.25):
+    ctx = mx.cpu()
+    batch_size = data.shape[1]
+    states = model.begin_state(batch_size, ctx)
+    total_loss, n = 0.0, 0
+    for i in range(0, data.shape[0] - 1 - bptt, bptt):
+        x = nd.array(data[i:i + bptt])
+        y = nd.array(data[i + 1:i + 1 + bptt])
+        states = detach(states)  # truncated BPTT boundary
+        if trainer is not None:
+            with autograd.record():
+                logits, states = model(x, states)
+                loss = loss_fn(logits, y).mean()
+            loss.backward()
+            clip_global_norm(model.collect_params(), clip)
+            trainer.step(1)
+        else:
+            logits, states = model(x, states)
+            loss = loss_fn(logits, y).mean()
+        total_loss += float(loss.asnumpy())
+        n += 1
+    return total_loss / max(n, 1)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Word-level LSTM language model")
+    ap.add_argument("--text", default=None)
+    ap.add_argument("--emsize", type=int, default=64)
+    ap.add_argument("--nhid", type=int, default=64)
+    ap.add_argument("--nlayers", type=int, default=2)
+    # mean-normalized loss needs a large SGD lr (the classic recipe)
+    ap.add_argument("--lr", type=float, default=20.0)
+    ap.add_argument("--clip", type=float, default=0.25)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch_size", type=int, default=32)
+    ap.add_argument("--dropout", type=float, default=0.2)
+    ap.add_argument("--tied", action="store_true")
+    ap.add_argument("--bptt", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=3)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+
+    corpus = Corpus(args.text, seed=args.seed)
+    vocab_size = max(len(corpus.vocab), int(corpus.data.max()) + 1)
+    stream = corpus.batchify(args.batch_size)
+    n_train = int(stream.shape[0] * 0.9)
+    train_data, valid_data = stream[:n_train], stream[n_train:]
+
+    model = RNNModel(vocab_size, args.emsize, args.nhid, args.nlayers,
+                     args.dropout, args.tied)
+    model.initialize(ctx=mx.cpu())
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    best_val = float("inf")
+    for epoch in range(args.epochs):
+        train_loss = run_epoch(model, train_data, args.bptt, loss_fn,
+                               trainer, args.clip)
+        val_loss = run_epoch(model, valid_data, args.bptt, loss_fn)
+        logging.info(
+            "epoch %d train ppl %.2f valid ppl %.2f lr %.3f", epoch,
+            math.exp(train_loss), math.exp(val_loss),
+            trainer.learning_rate)
+        if val_loss < best_val:
+            best_val = val_loss
+        else:  # reference recipe: anneal lr when valid stops improving
+            trainer.set_learning_rate(trainer.learning_rate * 0.25)
+    print("FINAL_VALID_PPL %.3f" % math.exp(best_val))
+
+
+if __name__ == "__main__":
+    main()

@@ -139,6 +139,15 @@ def nd_itemsize(arr) -> int:
     return int(np.dtype(arr.dtype).itemsize)
 
 
+def nd_copy_meta(arr, size: int) -> int:
+    """Pre-copy validation for MXNDArraySyncCopyFromCPU: checks the
+    element count BEFORE the C side reads the caller's buffer (an
+    oversized `size` must fail cleanly, not OOB-read), then returns the
+    itemsize for the byte-length computation."""
+    _check_size(arr, size, "MXNDArraySyncCopyFromCPU")
+    return nd_itemsize(arr)
+
+
 def _check_size(arr, size: int, fn: str) -> None:
     # reference NDArray::SyncCopyFromCPU: CHECK_EQ(shape().Size(), size)
     if int(arr.size) != int(size):

@@ -49,7 +49,10 @@ def _roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
                     (xs[None, :] >= wstart) & (xs[None, :] < wend))
             big_neg = jnp.asarray(-1e30, dtype=data.dtype)
             masked = jnp.where(mask[None], img, big_neg)
-            return masked.max(axis=(1, 2))
+            # reference roi_pooling.cc: an empty bin (degenerate ROI or
+            # out-of-image cell) outputs 0, not -inf
+            return jnp.where(mask.any(), masked.max(axis=(1, 2)),
+                             jnp.zeros((), data.dtype))
 
         cells = [[cell(iy, ix) for ix in range(pw)] for iy in range(ph)]
         return jnp.stack([jnp.stack(r, axis=-1) for r in cells], axis=-2)

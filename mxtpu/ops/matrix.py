@@ -323,6 +323,18 @@ def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=Non
     return jnp.matmul(a, b)
 
 
+@register("einsum")
+def _einsum(*args, subscripts=None, num_args=None):
+    """General tensor contraction (TPU-native addition; the reference
+    gained `_npi_einsum` only in 1.6 — `src/operator/numpy/np_einsum_op.cc`).
+    Einsum IS the MXU's native language: XLA lowers any contraction to
+    systolic-array matmuls, so prefer this over reshape+batch_dot
+    chains.  `subscripts` e.g. "bij,bjk->bik"."""
+    if not subscripts:
+        raise ValueError("einsum requires the `subscripts` attr")
+    return _jnp().einsum(subscripts, *args)
+
+
 @register("khatri_rao")
 def _khatri_rao(*args):
     jnp = _jnp()

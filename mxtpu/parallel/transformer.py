@@ -793,3 +793,91 @@ def dryrun(n_devices: int, devices=None) -> None:
         if not np.isfinite(float(jax.device_get(aloss))):
             raise MXNetError("dryrun ZeRO-1 adam produced non-finite "
                              "loss (axes=%r)" % (axes,))
+
+
+def dryrun_parity(n_devices: int, devices=None, rtol: float = 2e-4):
+    """Per-axis loss-parity sweep (VERDICT r4 next #6): the SAME model,
+    init seed, and global batch must produce the SAME first-step loss
+    no matter which mesh axis the devices are spent on — dp / tp / sp /
+    ep each compared against the single-axis gold, and the GPipe
+    microbatch count must be loss-invariant at fixed global batch.
+
+    Catches the class of sharding bug the single-shape dryrun can't:
+    a wrong PartitionSpec or a missed psum produces a *finite but
+    different* loss.  Returns {config_name: loss} for reporting."""
+    import numpy as np
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+
+    def one_loss(axes, n_micro=1, seed=0):
+        mesh = create_mesh(axes, devices=devices[:int(
+            np.prod(list(axes.values())))])
+        cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                n_layers=2 * axes[AXIS_PP], d_ff=64,
+                                n_experts=2, max_len=16,
+                                dtype="float32")
+        params = init_params(cfg, mesh, seed=seed)
+        step, sh = make_train_step(cfg, mesh, n_micro=n_micro, lr=1e-2)
+        rng = np.random.RandomState(42)
+        B, T = 8, 16
+        tokens = jax.device_put(
+            rng.randint(0, cfg.vocab, (B, T)).astype(np.int32),
+            sh["data"])
+        labels = jax.device_put(
+            rng.randint(0, cfg.vocab, (B, T)).astype(np.int32),
+            sh["data"])
+        _, loss = step(params, tokens, labels)
+        return float(jax.device_get(loss))
+
+    base = {AXIS_DP: 1, AXIS_PP: 1, AXIS_TP: 1, AXIS_SP: 1, AXIS_EP: 1}
+    losses = {"gold_1dev": one_loss(dict(base))}
+
+    def run(name, **over):
+        axes = dict(base)
+        axes.update(over)
+        need = int(np.prod(list(axes.values())))
+        if need > n_devices:
+            return
+        losses[name] = one_loss(axes)
+        if not np.isclose(losses[name], losses["gold_1dev"], rtol=rtol):
+            raise MXNetError(
+                "loss parity violation on %s: %.6f vs gold %.6f"
+                % (name, losses[name], losses["gold_1dev"]))
+
+    run("dp%d" % min(n_devices, 8), **{AXIS_DP: min(n_devices, 8)})
+    run("tp2", **{AXIS_TP: 2})
+    run("tp4", **{AXIS_TP: 4})
+    run("sp2", **{AXIS_SP: 2})
+    run("ep2", **{AXIS_EP: 2})
+    run("dp2_tp2", **{AXIS_DP: 2, AXIS_TP: 2})
+    run("dp2_sp2_ep2" if n_devices >= 8 else "dp2_sp2",
+        **({AXIS_DP: 2, AXIS_SP: 2, AXIS_EP: 2} if n_devices >= 8
+           else {AXIS_DP: 2, AXIS_SP: 2}))
+
+    # pipeline group: init layout depends on pp, so pp configs compare
+    # against a pp=2 gold — dp-extension and the GPipe microbatch count
+    # must both be loss-neutral
+    if n_devices >= 2:
+        pp_axes = dict(base)
+        pp_axes[AXIS_PP] = 2
+        gold_pp = one_loss(pp_axes, n_micro=1)
+        losses["gold_pp2_m1"] = gold_pp
+        for n_micro in (2, 4):
+            l = one_loss(pp_axes, n_micro=n_micro)
+            losses["pp2_m%d" % n_micro] = l
+            if not np.isclose(l, gold_pp, rtol=rtol):
+                raise MXNetError(
+                    "microbatch parity violation: pp2 n_micro=%d "
+                    "%.6f vs %.6f" % (n_micro, l, gold_pp))
+        if n_devices >= 4:
+            pd = dict(pp_axes)
+            pd[AXIS_DP] = 2
+            l = one_loss(pd, n_micro=2)
+            losses["pp2_dp2_m2"] = l
+            if not np.isclose(l, gold_pp, rtol=rtol):
+                raise MXNetError(
+                    "loss parity violation on pp2_dp2: %.6f vs %.6f"
+                    % (l, gold_pp))
+    return losses

@@ -68,8 +68,15 @@ PyObject* handle_list(void* const* handles, uint32_t n) {
 
 /* ---- stable out-buffer storage (reference: valid until next call) ---- */
 std::mutex g_buf_mu;
-std::vector<std::string> g_name_store;
-std::vector<const char*> g_name_ptrs;
+/* separate name stores per function group (same rationale as the
+ * handle stores below): holding MXListAllOpNames output across an
+ * MXNDArrayLoad must stay valid */
+struct NameStore {
+  std::vector<std::string> strs;
+  std::vector<const char*> ptrs;
+};
+NameStore g_op_names;
+NameStore g_load_names;
 std::unordered_map<void*, std::vector<uint32_t>> g_shape_store;
 /* separate stores per function group so MXImperativeInvoke outputs stay
  * valid across an MXNDArrayLoad and vice versa (the documented
@@ -78,19 +85,19 @@ std::vector<void*> g_invoke_store;
 std::vector<void*> g_load_store;
 
 /* expose a python list[str] as (size, const char**) with stable storage */
-int export_names(PyObject* lst, uint32_t* out_size,
+int export_names(PyObject* lst, NameStore* store, uint32_t* out_size,
                  const char*** out_array) {
   std::lock_guard<std::mutex> lk(g_buf_mu);
   Py_ssize_t n = PyList_Size(lst);
-  g_name_store.clear();
-  g_name_ptrs.clear();
+  store->strs.clear();
+  store->ptrs.clear();
   for (Py_ssize_t i = 0; i < n; ++i) {
     const char* s = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
-    g_name_store.emplace_back(s ? s : "");
+    store->strs.emplace_back(s ? s : "");
   }
-  for (auto& s : g_name_store) g_name_ptrs.push_back(s.c_str());
+  for (auto& s : store->strs) store->ptrs.push_back(s.c_str());
   *out_size = static_cast<uint32_t>(n);
-  *out_array = g_name_ptrs.data();
+  *out_array = store->ptrs.data();
   return 0;
 }
 
@@ -146,7 +153,7 @@ int MXListAllOpNames(uint32_t* out_size, const char*** out_array) {
   if (!gil.ok) return fail();
   PyObject* res = embed_call("list_op_names", nullptr);
   if (!res) return fail();
-  int rc = export_names(res, out_size, out_array);
+  int rc = export_names(res, &g_op_names, out_size, out_array);
   Py_DECREF(res);
   return rc;
 }
@@ -241,12 +248,15 @@ int MXNDArraySyncCopyFromCPU(void* handle, const void* data, size_t size) {
   Gil gil;
   if (!gil.ok) return fail();
   PyObject* h = static_cast<PyObject*>(handle);
-  PyObject* args0 = Py_BuildValue("(O)", h);
-  PyObject* isz = embed_call("nd_itemsize", args0);
+  /* validate the element count BEFORE touching the caller's buffer —
+   * an oversized `size` must be a clean error, not an OOB read */
+  PyObject* args0 = Py_BuildValue("(On)", h,
+                                  static_cast<Py_ssize_t>(size));
+  PyObject* meta = embed_call("nd_copy_meta", args0);
   Py_DECREF(args0);
-  if (!isz) return fail();
-  size_t nbytes = size * static_cast<size_t>(PyLong_AsLong(isz));
-  Py_DECREF(isz);
+  if (!meta) return fail();
+  size_t nbytes = size * static_cast<size_t>(PyLong_AsLong(meta));
+  Py_DECREF(meta);
   PyObject* blob = PyBytes_FromStringAndSize(
       static_cast<const char*>(data), static_cast<Py_ssize_t>(nbytes));
   PyObject* args = Py_BuildValue("(OOn)", h, blob,
@@ -364,7 +374,7 @@ int MXNDArrayLoad(const char* fname, uint32_t* out_size, void*** out_arr,
     *out_size = static_cast<uint32_t>(n);
     *out_arr = g_load_store.data();
   }
-  export_names(names, out_name_size, out_names);
+  export_names(names, &g_load_names, out_name_size, out_names);
   Py_DECREF(res);
   return 0;
 }

@@ -88,8 +88,11 @@ int main(int argc, char** argv) {
   int dtype = -1, dev_type = 0, dev_id = -1;
   CHECK(MXNDArrayGetDType(a, &dtype) == 0 && dtype == 0);
   CHECK(MXNDArrayGetContext(a, &dev_type, &dev_id) == 0);
-  /* size-mismatch must ERROR, not truncate (reference CHECK_EQ) */
+  /* size-mismatch must ERROR, not truncate (reference CHECK_EQ) —
+   * both too-small AND too-large (the latter must be rejected BEFORE
+   * the library reads past the caller's buffer) */
   CHECK(MXNDArraySyncCopyFromCPU(a, data, 5) != 0);
+  CHECK(MXNDArraySyncCopyFromCPU(a, data, 6000000) != 0);
   float back[6] = {0};
   CHECK(MXNDArraySyncCopyToCPU(a, back, 6) == 0);
   for (int i = 0; i < 6; ++i) CHECK(back[i] == data[i]);

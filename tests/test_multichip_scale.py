@@ -1,0 +1,56 @@
+"""Multichip scaling matrix (VERDICT r4 next #3/#6): per-axis loss
+parity, the GPipe microbatch sweep, collective self-checks, and
+16/32-virtual-device dryruns — the sharding bugs a single-shape 8-dev
+run cannot catch (wrong PartitionSpec or missed psum = finite but
+DIFFERENT loss; axis mis-wiring often only shows at size > 8)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_per_axis_loss_parity_and_microbatch_sweep():
+    from mxtpu.parallel import transformer
+
+    losses = transformer.dryrun_parity(8, devices=jax.devices()[:8])
+    # the sweep itself raises on violation; sanity-check coverage here
+    assert "gold_1dev" in losses and "dp8" in losses
+    assert {"tp2", "sp2", "ep2", "dp2_tp2"} <= set(losses)
+    assert "pp2_m2" in losses and "pp2_m4" in losses
+    assert np.isfinite(list(losses.values())).all()
+
+
+def test_collective_microbench_self_checks():
+    from mxtpu.parallel import collectives, mesh as pmesh
+
+    m = pmesh.create_mesh({"dp": 2, "tp": 2, "sp": 2},
+                          devices=jax.devices()[:8])
+    res = collectives.microbench(m, n_bytes=1 << 14, reps=2)
+    assert set(res) == {"dp", "tp", "sp"}
+    for axis, r in res.items():
+        assert set(r) == {"all_reduce", "all_gather", "reduce_scatter",
+                          "all_to_all", "ppermute"}
+        for name, v in r.items():
+            assert v["ok"], (axis, name)
+            assert v["ms"] > 0 and np.isfinite(v["gb_s"])
+
+
+@pytest.mark.parametrize("n", [16, 32])
+def test_dryrun_scales_past_eight_devices(n):
+    """dryrun_multichip self-provisions a child with N virtual CPU
+    devices; 16 and 32 exercise axis factors (4-way splits) the 8-dev
+    run never produces."""
+    env = dict(os.environ)
+    env.pop("_MXTPU_DRYRUN_CHILD", None)
+    code = ("import __graft_entry__ as g; g.dryrun_multichip(%d); "
+            "print('OK%d')" % (n, n))
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert ("OK%d" % n) in r.stdout

@@ -688,6 +688,18 @@ def _():
        rtol=1e-3, atol=1e-4)
 
 
+@case("einsum")
+def _():
+    a, b = _a(4, 2, 3), _a(4, 3, 5)
+    op("einsum", a, b, attrs={"subscripts": "bij,bjk->bik"},
+       gold=np.einsum("bij,bjk->bik", a, b), rtol=1e-3, atol=1e-4)
+    # contraction + reduction in one spec
+    c = _a(3, 4)
+    op("einsum", c, attrs={"subscripts": "ij->i"},
+       gold=c.sum(axis=1), rtol=1e-4, atol=1e-5)
+    gradcheck("einsum", a, b, attrs={"subscripts": "bij,bjk->bik"})
+
+
 @case("khatri_rao")
 def _():
     a, b = _a(2, 3), _a(4, 3)
