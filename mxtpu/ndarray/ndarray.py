@@ -294,6 +294,17 @@ class NDArray(object):
         key = self._canon_index(key)
         if isinstance(key, slice) and key.start is None and key.stop is None and key.step is None:
             return self
+        # under record, indexing must tape (reference: slicing emits a
+        # `slice`/`gather_nd` NNVM node) — otherwise downstream grads
+        # silently vanish at the first subscript
+        if _ag.is_recording() and (self._entry is not None or
+                                   self._marked):
+            outs, node = _ag._record_fn(
+                "getitem", lambda d: (d[key],), [self], [self._data])
+            out = NDArray(outs[0], ctx=self._ctx, _committed=True)
+            if node is not None:
+                out._entry = (node, 0)
+            return out
         data = self._data[key]
         out = NDArray(data, ctx=self._ctx, _committed=True)
         return out

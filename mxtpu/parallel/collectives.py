@@ -177,35 +177,41 @@ def microbench(mesh=None, n_bytes: int = 1 << 20, reps: int = 5):
             continue
         k = max(n_elem // size, size)
         k -= k % size                      # reduce_scatter tiling
-        shard = np.ones((size, k), np.float32)
-        flat = np.ones((size * k,), np.float32)
-        a2a = np.ones((size, size, max(k // size, 1)), np.float32)
+        # per-shard-DISTINCT payload: an all-ones buffer cannot catch
+        # ordering/wiring bugs (identity permute, wrong gather order)
+        shard = np.arange(size * k, dtype=np.float32).reshape(size, k)
+        flat = shard.reshape(-1)
+        ka = max(k // size, 1)
+        a2a = np.arange(size * size * ka,
+                        dtype=np.float32).reshape(size, size, ka)
         ring = [(i, (i + 1) % size) for i in range(size)]
         cases = {
             # input conventions follow the eager wrappers (see
             # tests/test_parallel.py::TestCollectives)
             "all_reduce": (lambda: all_reduce(shard, axis=axis, mesh=mesh),
                            lambda out: np.allclose(np.asarray(out)[0],
-                                                   size),
+                                                   shard.sum(0)),
                            2.0 * (size - 1) / size),
             "all_gather": (lambda: all_gather(flat, axis=axis, mesh=mesh),
-                           lambda out: np.allclose(np.asarray(out),
-                                                   flat),
+                           lambda out: np.array_equal(np.asarray(out),
+                                                      flat),
                            float(size - 1) / size),
             "reduce_scatter": (lambda: reduce_scatter(flat, axis=axis,
                                                       mesh=mesh),
                                lambda out: np.allclose(np.asarray(out),
-                                                       size),
+                                                       shard.sum(0)),
                                float(size - 1) / size),
-            # wrapper contract: (size, size, k) -> (size*size, 1, k)
-            # (device-major regrouping of the transposed blocks)
+            # wrapper contract: (size, size, ka) -> (size*size, 1, ka),
+            # row-major blocks of the [src, dst] transpose
             "all_to_all": (lambda: all_to_all(a2a, axis=axis, mesh=mesh),
-                           lambda out: np.asarray(out).shape ==
-                           (size * size, 1, a2a.shape[2]),
+                           lambda out: np.array_equal(
+                               np.asarray(out).reshape(size, size, ka),
+                               np.swapaxes(a2a, 0, 1)),
                            float(size - 1) / size),
             "ppermute": (lambda: collective_permute(
                 shard, ring, axis=axis, mesh=mesh),
-                lambda out: np.asarray(out).shape == shard.shape,
+                lambda out: np.array_equal(np.asarray(out),
+                                           np.roll(shard, 1, axis=0)),
                 1.0),
         }
         axis_res = {}

@@ -292,3 +292,40 @@ def test_dist_async_kvstore_local_launcher():
         env=env, capture_output=True, text=True, timeout=300)
     assert res.returncode == 0, res.stdout + res.stderr
     assert res.stdout.count("DIST_ASYNC_OK") == 2, res.stdout + res.stderr
+
+
+def test_dist_sync_kvstore_ssh_launcher(tmp_path):
+    """The ssh launcher's whole pipeline — hostfile parse, round-robin
+    role placement, env broadcast, remote command assembly, reaping —
+    driven through a local `ssh` SHIM that executes the remote command
+    via bash (the reference's dmlc-tracker ssh mode, tools/launch.py
+    ssh.py; real multi-host needs only passwordless ssh)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    shim = shim_dir / "ssh"
+    # drop the ssh options + hostname, run the remote command locally
+    shim.write_text("#!/bin/bash\n"
+                    "while [[ \"$1\" == -* ]]; do\n"
+                    "  if [[ \"$1\" == -o ]]; then shift 2; "
+                    "else shift; fi\n"
+                    "done\n"
+                    "host=\"$1\"; shift\n"
+                    "exec bash -c \"$*\"\n")
+    shim.chmod(0o755)
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("127.0.0.1\n127.0.0.1\n")
+
+    script = os.path.join(repo, "tests", "dist_sync_kvstore.py")
+    launcher = os.path.join(repo, "tools", "launch.py")
+    env = dict(os.environ)
+    env["PATH"] = str(shim_dir) + os.pathsep + env["PATH"]
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    res = subprocess.run(
+        [sys.executable, launcher, "-n", "2", "-s", "2",
+         "--launcher", "ssh", "-H", str(hostfile),
+         sys.executable, script],
+        env=env, capture_output=True, text=True, timeout=300, cwd=repo)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("DIST_SYNC_OK") == 2, res.stdout + res.stderr

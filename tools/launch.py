@@ -1,15 +1,24 @@
 #!/usr/bin/env python
-"""Local launcher for distributed KVStore jobs.
+"""Launcher for distributed KVStore jobs.
 
 The analog of the reference's `tools/launch.py` → dmlc-tracker
-(`tools/launch.py:71-111`): spawns 1 scheduler + S servers + W workers
-as local processes with the role environment set
+(`tools/launch.py:71-111` drives ssh/mpi/sge/yarn): spawns 1 scheduler
++ S servers + W workers with the role environment set
 (MXTPU_ROLE/MXTPU_PS_ROOT_URI/...), waits for the workers, then reaps
-the rest.  Only the ``local`` launcher is provided — on real clusters
-multi-host jobs use the TPU coordination service (jax.distributed), not
-this PS bootstrap.
+the rest.  Two launchers:
+
+* ``local`` — all roles as local processes (development/tests);
+* ``ssh``  — roles distributed round-robin over ``--hostfile`` hosts
+  via passwordless ssh (the reference's ssh tracker): scheduler runs on
+  the FIRST host, its address is broadcast through the role env, and
+  `--sync-dst-dir` optionally rsyncs the working dir to each host
+  first.  TPU-pod compute jobs use the coordination service
+  (jax.distributed) instead — this bootstrap serves the PS/DCN path
+  (dist_sync/dist_async kvstore).
 
 Usage:  python tools/launch.py -n 2 [-s 1] python my_script.py args...
+        python tools/launch.py -n 4 --launcher ssh -H hosts.txt \
+               python train.py --kv-store dist_sync
 """
 from __future__ import annotations
 
@@ -33,12 +42,21 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=None)
-    ap.add_argument("--launcher", choices=["local"], default="local")
+    ap.add_argument("--launcher", choices=["local", "ssh"],
+                    default="local")
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="one host per line (ssh launcher)")
+    ap.add_argument("--sync-dst-dir", default=None,
+                    help="rsync CWD to this dir on every host first")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
         ap.error("no command given")
     ns = args.num_servers if args.num_servers is not None else args.num_workers
+    if args.launcher == "ssh":
+        if not args.hostfile:
+            ap.error("--launcher ssh requires -H/--hostfile")
+        return _launch_ssh(args, ns)
 
     base = dict(os.environ)
     base.update({
@@ -67,6 +85,96 @@ def main(argv=None):
     workers = []
     for _ in range(args.num_workers):
         spawn("worker")
+        workers.append(procs[-1])
+
+    rc = 0
+    try:
+        for w in workers:
+            code = w.wait()
+            if code != 0 and rc == 0:
+                rc = code if 0 < code < 256 else 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return rc
+
+
+def _launch_ssh(args, ns):
+    """ssh launcher (reference dmlc-tracker ssh.py role): round-robin
+    role placement over the hostfile, env passed on the remote command
+    line, scheduler bound on the first host's address."""
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()
+                 and not h.startswith("#")]
+    if not hosts:
+        raise SystemExit("empty hostfile %s" % args.hostfile)
+    root = hosts[0]
+    # NOTE: the port is probed on the LOCAL machine; the scheduler
+    # binds it on hosts[0].  Collisions there surface as a scheduler
+    # bind failure — pin MXTPU_PS_ROOT_PORT in the environment to
+    # choose explicitly.
+    root_port = int(os.environ.get("MXTPU_PS_ROOT_PORT", 0)) or \
+        _free_port()
+    cwd = args.sync_dst_dir or os.getcwd()
+
+    if args.sync_dst_dir:
+        for h in set(hosts):
+            subprocess.run(["rsync", "-az", "--exclude", ".git",
+                            os.getcwd() + "/",
+                            "%s:%s/" % (h, args.sync_dst_dir)],
+                           check=True)
+
+    base_env = {
+        "MXTPU_PS_ROOT_URI": root,
+        "MXTPU_PS_ROOT_PORT": str(root_port),
+        "MXTPU_NUM_WORKER": str(args.num_workers),
+        "MXTPU_NUM_SERVER": str(ns),
+    }
+    # pass through the caller's python-visible config
+    for k, v in os.environ.items():
+        if (k == "PYTHONPATH" or
+                k.startswith(("MXTPU_", "JAX_", "XLA_"))) and \
+                k not in base_env:
+            base_env[k] = v
+
+    procs = []
+
+    def spawn(role, host):
+        env = dict(base_env)
+        env["MXTPU_ROLE"] = role
+        if role in ("scheduler", "server"):
+            inner = ("%s -c 'import mxtpu.kvstore_server as s; "
+                     "s.init_module()'" % sys.executable)
+        else:
+            import shlex
+
+            inner = " ".join(shlex.quote(c) for c in args.command)
+        import shlex
+
+        envstr = " ".join("%s=%s" % (k, shlex.quote(v))
+                          for k, v in sorted(env.items()))
+        remote = "cd %s && env %s %s" % (shlex.quote(cwd), envstr, inner)
+        # -tt forces a tty so dropping the ssh client (our SIGTERM on
+        # cleanup) HUPs and kills the remote role instead of leaking it
+        procs.append(subprocess.Popen(
+            ["ssh", "-tt", "-o", "StrictHostKeyChecking=no", host,
+             remote], stdin=subprocess.DEVNULL))
+
+    spawn("scheduler", root)
+    workers = []
+    for i in range(ns):
+        spawn("server", hosts[i % len(hosts)])
+    for i in range(args.num_workers):
+        spawn("worker", hosts[i % len(hosts)])
         workers.append(procs[-1])
 
     rc = 0
