@@ -27,7 +27,9 @@ __all__ = [
     "nd_to_bytes", "nd_shape", "nd_dtype_code", "nd_context",
     "nd_save", "nd_load", "kv_create", "kv_init", "kv_push", "kv_pull",
     "iter_create", "iter_before_first", "iter_next", "iter_data",
-    "iter_label",
+    "iter_label", "autograd_set_recording", "autograd_set_training",
+    "autograd_is_recording", "autograd_is_training",
+    "autograd_mark_variables", "autograd_backward", "nd_get_grad",
 ]
 
 
@@ -296,3 +298,67 @@ def iter_data(ci: _CIter):
 
 def iter_label(ci: _CIter):
     return ci.batch.label[0]
+
+
+# -- Autograd (reference c_api.h:1004-1050) --------------------------------
+
+def autograd_set_recording(flag: int) -> int:
+    from mxtpu import autograd
+
+    return int(autograd.set_recording(bool(flag)))
+
+
+def autograd_set_training(flag: int) -> int:
+    from mxtpu import autograd
+
+    return int(autograd.set_training(bool(flag)))
+
+
+def autograd_is_recording() -> int:
+    from mxtpu import autograd
+
+    return int(autograd.is_recording())
+
+
+def autograd_is_training() -> int:
+    from mxtpu import autograd
+
+    return int(autograd.is_training())
+
+
+def autograd_mark_variables(arrs, grad_reqs, grads) -> None:
+    """MXAutogradMarkVariables: attach gradient buffers.  grad_req
+    codes follow the reference's _GRAD_REQ_MAP (ndarray.py:94):
+    0=null, 1=write, 3=add (2 is kWriteInplace, not exposed there
+    either); unknown codes error instead of silently writing."""
+    from mxtpu import autograd
+
+    req_names = {0: "null", 1: "write", 3: "add"}
+    reqs = []
+    for r in grad_reqs:
+        if int(r) not in req_names:
+            raise ValueError("MXAutogradMarkVariables: unsupported "
+                             "grad_req code %d (0=null, 1=write, "
+                             "3=add)" % int(r))
+        reqs.append(req_names[int(r)])
+    autograd.mark_variables(list(arrs), list(grads), reqs)
+
+
+def autograd_backward(outputs, out_grads, retain_graph: int,
+                      train_mode: int) -> None:
+    """MXAutogradBackward."""
+    from mxtpu import autograd
+
+    autograd.backward(list(outputs),
+                      list(out_grads) if out_grads else None,
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+
+
+def nd_get_grad(arr):
+    """MXNDArrayGetGrad: the grad buffer attached by mark_variables."""
+    g = arr.grad
+    if g is None:
+        raise ValueError("array has no gradient buffer "
+                         "(MXAutogradMarkVariables first)")
+    return g

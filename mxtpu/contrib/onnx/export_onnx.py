@@ -87,7 +87,8 @@ def _pair(v, n=2, default=1):
 
 class _Exporter(object):
     def __init__(self, sym, params: Dict[str, np.ndarray],
-                 aux: Dict[str, np.ndarray]):
+                 aux: Dict[str, np.ndarray], shapes=None):
+        self.shapes = shapes or {}
         self.sym = sym
         self.params = dict(params)
         self.aux = dict(aux)
@@ -99,6 +100,12 @@ class _Exporter(object):
     def uid(self, base):
         self._uid += 1
         return "%s_%d" % (base, self._uid)
+
+    def entry_shape(self, entry):
+        node, idx = entry
+        if node.is_variable:
+            return self.shapes.get(node.name)
+        return self.shapes.get(("out", id(node), idx))
 
     def tname(self, entry) -> str:
         node, idx = entry
@@ -243,6 +250,118 @@ class _Exporter(object):
             self.emit("GlobalAveragePool", ins, [gap], gap)
             self.emit("Flatten", [gap], [out], node.name,
                       [_attr_i("axis", 1)])
+        elif op == "Deconvolution":
+            k = tuple(int(x) for x in a["kernel"])
+            n = len(k)
+            attrs = [_attr_ints("kernel_shape", k),
+                     _attr_ints("strides", _pair(a.get("stride"), n)),
+                     _attr_ints("dilations", _pair(a.get("dilate"), n)),
+                     _attr_ints("pads", _pair(a.get("pad"), n, 0) * 2),
+                     _attr_i("group", a.get("num_group", 1))]
+            if a.get("adj"):
+                attrs.append(_attr_ints("output_padding",
+                                        _pair(a.get("adj"), n, 0)))
+            self.emit("ConvTranspose",
+                      ins[:2 if a.get("no_bias") else 3], [out],
+                      node.name, attrs)
+        elif op == "slice_axis":
+            ax = int(a["axis"])
+            end = a.get("end")
+            ends = self.const(node.name + "_ends", np.asarray(
+                [2 ** 31 - 1 if end in (None, "None") else int(end)],
+                np.int64))
+            starts = self.const(node.name + "_starts",
+                                np.asarray([int(a.get("begin", 0))],
+                                           np.int64))
+            axes = self.const(node.name + "_axes",
+                              np.asarray([ax], np.int64))
+            self.emit("Slice", [ins[0], starts, ends, axes], [out],
+                      node.name)
+        elif op == "slice":
+            begin = [0 if b in (None, "None") else int(b)
+                     for b in a.get("begin", ())]
+            end = [2 ** 31 - 1 if e in (None, "None") else int(e)
+                   for e in a.get("end", ())]
+            step = [1 if st in (None, "None") else int(st)
+                    for st in (a.get("step") or (1,) * len(begin))]
+            starts = self.const(node.name + "_starts",
+                                np.asarray(begin, np.int64))
+            ends = self.const(node.name + "_ends",
+                              np.asarray(end, np.int64))
+            axes = self.const(node.name + "_axes",
+                              np.arange(len(begin), dtype=np.int64))
+            steps = self.const(node.name + "_steps",
+                               np.asarray(step, np.int64))
+            self.emit("Slice", [ins[0], starts, ends, axes, steps],
+                      [out], node.name)
+        elif op == "expand_dims":
+            # opset 12: axes is an ATTRIBUTE of Unsqueeze
+            self.emit("Unsqueeze", ins, [out], node.name,
+                      [_attr_ints("axes", (int(a["axis"]),))])
+        elif op == "squeeze":
+            ax = a.get("axis")
+            if ax is None:
+                self.emit("Squeeze", ins, [out], node.name)
+            else:
+                axes = (ax,) if isinstance(ax, int) else tuple(ax)
+                self.emit("Squeeze", ins, [out], node.name,
+                          [_attr_ints("axes", axes)])
+        elif op in ("Embedding", "take"):
+            # Gather(data, indices): mxnet argument order is reversed
+            data, idx = (ins[1], ins[0]) if op == "Embedding" \
+                else (ins[0], ins[1])
+            self.emit("Gather", [data, idx], [out], node.name,
+                      [_attr_i("axis", int(a.get("axis", 0)))])
+        elif op == "dot":
+            if a.get("transpose_a") or a.get("transpose_b"):
+                raise MXNetError("ONNX export: transposed dot")
+            for e in node.inputs:
+                shp = self.entry_shape(e)
+                if shp is not None and len(shp) > 2:
+                    # mxnet dot on >2-D contracts last-with-first —
+                    # NOT MatMul's batched semantics
+                    raise MXNetError(
+                        "ONNX export: dot with ndim>2 operand has no "
+                        "MatMul equivalent (use batch_dot)")
+            self.emit("MatMul", ins, [out], node.name)
+        elif op == "batch_dot":
+            if a.get("transpose_a") or a.get("transpose_b"):
+                raise MXNetError("ONNX export: transposed batch_dot")
+            self.emit("MatMul", ins, [out], node.name)
+        elif op in ("Pad", "pad"):
+            width = tuple(int(x) for x in a["pad_width"])
+            half = len(width) // 2
+            onnx_pads = [width[2 * i] for i in range(half)] + \
+                [width[2 * i + 1] for i in range(half)]
+            pads = self.const(node.name + "_pads",
+                              np.asarray(onnx_pads, np.int64))
+            cval = self.const(node.name + "_cval",
+                              np.asarray(a.get("constant_value", 0.0),
+                                         np.float32))
+            mode = a.get("mode", "constant")
+            self.emit("Pad", [ins[0], pads, cval], [out], node.name,
+                      [_attr_s("mode", {"constant": "constant",
+                                        "edge": "edge",
+                                        "reflect": "reflect"}[mode])])
+        elif op in ("broadcast_maximum", "_maximum"):
+            self.emit("Max", ins, [out], node.name)
+        elif op in ("broadcast_minimum", "_minimum"):
+            self.emit("Min", ins, [out], node.name)
+        elif op in ("broadcast_power", "_power"):
+            self.emit("Pow", ins, [out], node.name)
+        elif op in ("sum", "mean", "max", "min") :
+            onnx_op = {"sum": "ReduceSum", "mean": "ReduceMean",
+                       "max": "ReduceMax", "min": "ReduceMin"}[op]
+            attrs = [_attr_i("keepdims",
+                             1 if a.get("keepdims") else 0)]
+            ax = a.get("axis")
+            if ax is not None and ax != "None":
+                axes = (ax,) if isinstance(ax, int) else tuple(ax)
+                attrs.append(_attr_ints("axes", axes))
+            self.emit(onnx_op, ins, [out], node.name, attrs)
+        elif op == "InstanceNorm":
+            self.emit("InstanceNormalization", ins, [out], node.name,
+                      [_attr_f("epsilon", a.get("eps", 1e-3))])
         else:
             raise MXNetError(
                 "ONNX export: no converter for op %r (node %r) — "
@@ -257,7 +376,16 @@ def export_symbol(sym, params: Dict[str, Any], aux: Dict[str, Any],
            for k, v in (params or {}).items()}
     anp = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
            for k, v in (aux or {}).items()}
-    ex = _Exporter(sym, pnp, anp)
+    known = dict(input_shapes)
+    for _pname, _parr in {**pnp, **anp}.items():
+        known.setdefault(_pname, tuple(_parr.shape))
+    from ...symbol.symbol import _infer_graph
+
+    try:
+        shape_map, _ = _infer_graph(sym, known, {}, partial=True)
+    except Exception:
+        shape_map = {}
+    ex = _Exporter(sym, pnp, anp, shape_map)
     label_like = set()
     for node in sym._topo():
         if node.is_variable:
@@ -288,7 +416,14 @@ def export_symbol(sym, params: Dict[str, Any], aux: Dict[str, Any],
             inputs += P.w_bytes(11, _value_info(node.name,
                                                 input_shapes[node.name]))
     outputs = b""
-    _, out_shapes, _ = sym.infer_shape(**dict(input_shapes))
+    # seed inference with the param shapes too — attrs alone cannot
+    # determine weight shapes for ops like dot/MatMul
+    known = dict(input_shapes)
+    arg_names = set(sym.list_arguments())
+    for name, arr in {**pnp, **anp}.items():
+        if name in arg_names and name not in known:
+            known[name] = tuple(arr.shape)
+    _, out_shapes, _ = sym.infer_shape(**known)
     for name, shape in zip(sym.list_outputs(), out_shapes):
         outputs += P.w_bytes(12, _value_info(name, shape))
 

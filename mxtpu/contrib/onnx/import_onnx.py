@@ -259,6 +259,138 @@ def import_model(onnx_file_path: str):
                 hi = float(a["max"])
             res = invoke_symbol("clip", [sym_in(ins[0])],
                                {"a_min": lo, "a_max": hi}, name=name)
+        elif op == "ConvTranspose":
+            k = a.get("kernel_shape", ())
+            n = len(k)
+            w = inits.get(ins[1])
+            res = invoke_symbol("Deconvolution",
+                               [sym_in(x) for x in ins],
+                               {"kernel": tuple(k),
+                                "stride": _pairs(a.get("strides"), n),
+                                "dilate": _pairs(a.get("dilations"), n),
+                                "pad": _pairs(a.get("pads"), n, 0),
+                                "adj": _pairs(a.get("output_padding"),
+                                              n, 0),
+                                "num_filter": int(w.shape[1]) *
+                                int(a.get("group", 1))
+                                if w is not None else 0,
+                                "num_group": int(a.get("group", 1)),
+                                "no_bias": len(ins) == 2}, name=name)
+        elif op == "Slice":
+            def _ints(slot, key):
+                if len(ins) > slot and ins[slot] and ins[slot] in inits:
+                    consumed.add(ins[slot])
+                    return [int(v) for v in np.ravel(inits[ins[slot]])]
+                v = a.get(key)
+                return [int(x) for x in v] if v is not None else None
+            starts = _ints(1, "starts")
+            ends = _ints(2, "ends")
+            axes = _ints(3, "axes") or list(range(len(starts)))
+            steps = _ints(4, "steps") or [1] * len(starts)
+            big = 2 ** 31 - 1
+            if all(ax >= 0 for ax in axes):
+                nd_hint = max(axes) + 1
+                begin = [None] * nd_hint
+                end = [None] * nd_hint
+                step = [1] * nd_hint
+                for ax, st, en, sp in zip(axes, starts, ends, steps):
+                    begin[ax] = st
+                    end[ax] = None if en >= big else en
+                    step[ax] = sp
+                res = invoke_symbol("slice", [sym_in(ins[0])],
+                                   {"begin": tuple(begin),
+                                    "end": tuple(end),
+                                    "step": tuple(step)}, name=name)
+            else:
+                # negative axes (legal per spec): rank unknown until
+                # bind, so chain per-axis slice_axis (negative-axis
+                # aware); steps would need the rank, so reject them
+                if any(sp != 1 for sp in steps):
+                    raise MXNetError(
+                        "ONNX import: Slice with negative axes AND "
+                        "steps != 1 is unsupported")
+                res = sym_in(ins[0])
+                for j, (ax, st, en) in enumerate(
+                        zip(axes, starts, ends)):
+                    res = invoke_symbol(
+                        "slice_axis", [res],
+                        {"axis": ax, "begin": st,
+                         "end": None if en >= big else en},
+                        name="%s_ax%d" % (name, j))
+        elif op == "Unsqueeze":
+            axes = a.get("axes")
+            if axes is None and len(ins) > 1 and ins[1] in inits:
+                consumed.add(ins[1])
+                axes = [int(v) for v in np.ravel(inits[ins[1]])]
+            res = sym_in(ins[0])
+            for ax in sorted(int(x) for x in axes):
+                res = invoke_symbol("expand_dims", [res],
+                                   {"axis": ax},
+                                   name=name + "_ax%d" % ax)
+        elif op == "Squeeze":
+            axes = a.get("axes")
+            if axes is None and len(ins) > 1 and ins[1] in inits:
+                consumed.add(ins[1])
+                axes = [int(v) for v in np.ravel(inits[ins[1]])]
+            res = invoke_symbol(
+                "squeeze", [sym_in(ins[0])],
+                {"axis": tuple(int(x) for x in axes)
+                 if axes is not None else None}, name=name)
+        elif op == "Gather":
+            res = invoke_symbol("take",
+                               [sym_in(ins[0]), sym_in(ins[1])],
+                               {"axis": int(a.get("axis", 0))},
+                               name=name)
+        elif op == "MatMul":
+            res = invoke_symbol("_onnx_MatMul",
+                               [sym_in(ins[0]), sym_in(ins[1])], {},
+                               name=name)
+        elif op == "Pad":
+            if len(ins) > 1 and ins[1] in inits:
+                consumed.add(ins[1])
+                pads = [int(v) for v in np.ravel(inits[ins[1]])]
+            else:
+                pads = [int(x) for x in a.get("pads", ())]
+            cval = 0.0
+            if len(ins) > 2 and ins[2] and ins[2] in inits:
+                consumed.add(ins[2])
+                cval = float(np.ravel(inits[ins[2]])[0])
+            half = len(pads) // 2
+            width = []
+            for i in range(half):
+                width += [pads[i], pads[half + i]]
+            mode = a.get("mode", "constant")
+            if isinstance(mode, bytes):
+                mode = mode.decode()
+            res = invoke_symbol("Pad", [sym_in(ins[0])],
+                               {"mode": mode,
+                                "pad_width": tuple(width),
+                                "constant_value": cval}, name=name)
+        elif op in ("Max", "Min", "Pow"):
+            mxop = {"Max": "broadcast_maximum",
+                    "Min": "broadcast_minimum",
+                    "Pow": "broadcast_power"}[op]
+            res = invoke_symbol(mxop,
+                               [sym_in(ins[0]), sym_in(ins[1])], {},
+                               name=name)
+        elif op in ("ReduceSum", "ReduceMean", "ReduceMax",
+                    "ReduceMin"):
+            mxop = {"ReduceSum": "sum", "ReduceMean": "mean",
+                    "ReduceMax": "max", "ReduceMin": "min"}[op]
+            axes = a.get("axes")
+            if axes is None and len(ins) > 1 and ins[1] in inits:
+                consumed.add(ins[1])
+                axes = [int(v) for v in np.ravel(inits[ins[1]])]
+            attrs = {"keepdims": bool(a.get("keepdims", 1))}
+            if axes is not None:
+                attrs["axis"] = tuple(int(x) for x in axes)
+            res = invoke_symbol(mxop, [sym_in(ins[0])], attrs,
+                               name=name)
+        elif op == "InstanceNormalization":
+            res = invoke_symbol("InstanceNorm",
+                               [sym_in(x) for x in ins],
+                               {"eps": float(a.get("epsilon", 1e-5))},
+                               name=name)
         else:
             raise MXNetError(
                 "ONNX import: no converter for op %r — extend "

@@ -335,6 +335,15 @@ def _einsum(*args, subscripts=None, num_args=None):
     return _jnp().einsum(subscripts, *args)
 
 
+@register("_onnx_MatMul")
+def _onnx_matmul(a, b):
+    """numpy-matmul semantics (ONNX MatMul): 2-D = plain matmul, N-D =
+    batched with broadcasting — used by the ONNX importer, where the
+    operand ranks are unknown until bind time (mxnet `dot` has
+    different >2-D semantics)."""
+    return _jnp().matmul(a, b)
+
+
 @register("khatri_rao")
 def _khatri_rao(*args):
     jnp = _jnp()

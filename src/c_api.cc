@@ -379,6 +379,96 @@ int MXNDArrayLoad(const char* fname, uint32_t* out_size, void*** out_arr,
   return 0;
 }
 
+/* ---- Autograd (reference c_api.h:1004-1050) --------------------------- */
+
+static int ag_flag(const char* fn, int flag, int* prev) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(i)", flag);
+  PyObject* res = embed_call(fn, args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+static int ag_query(const char* fn, int* out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* res = embed_call(fn, nullptr);
+  if (!res) return fail();
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradSetIsRecording(int is_recording, int* prev) {
+  return ag_flag("autograd_set_recording", is_recording, prev);
+}
+
+int MXAutogradSetIsTraining(int is_training, int* prev) {
+  return ag_flag("autograd_set_training", is_training, prev);
+}
+
+int MXAutogradIsRecording(int* curr) {
+  return ag_query("autograd_is_recording", curr);
+}
+
+int MXAutogradIsTraining(int* curr) {
+  return ag_query("autograd_is_training", curr);
+}
+
+int MXAutogradMarkVariables(uint32_t num_var, void** var_handles,
+                            uint32_t* reqs_array, void** grad_handles) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* vars = handle_list(var_handles, num_var);
+  PyObject* grads = handle_list(grad_handles, num_var);
+  PyObject* reqs = PyList_New(num_var);
+  for (uint32_t i = 0; i < num_var; ++i)
+    PyList_SetItem(reqs, i, PyLong_FromUnsignedLong(reqs_array[i]));
+  PyObject* args = Py_BuildValue("(OOO)", vars, reqs, grads);
+  Py_DECREF(vars);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  PyObject* res = embed_call("autograd_mark_variables", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradBackward(uint32_t num_output, void** output_handles,
+                       void** ograd_handles, int retain_graph) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* outs = handle_list(output_handles, num_output);
+  PyObject* ograds = ograd_handles
+      ? handle_list(ograd_handles, num_output)
+      : (Py_INCREF(Py_None), Py_None);
+  PyObject* args = Py_BuildValue("(OOii)", outs, ograds, retain_graph,
+                                 /*train_mode=*/1);
+  Py_DECREF(outs);
+  Py_DECREF(ograds);
+  PyObject* res = embed_call("autograd_backward", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetGrad(void* handle, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("nd_get_grad", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res; /* caller frees with MXNDArrayFree */
+  return 0;
+}
+
 /* ---- KVStore ---------------------------------------------------------- */
 
 int MXKVStoreCreate(const char* type, void** out) {

@@ -42,6 +42,12 @@ extern int MXDataIterBeforeFirst(void*);
 extern int MXDataIterNext(void*, int*);
 extern int MXDataIterGetData(void*, void**);
 extern int MXDataIterGetLabel(void*, void**);
+extern int MXAutogradSetIsRecording(int, int*);
+extern int MXAutogradSetIsTraining(int, int*);
+extern int MXAutogradIsRecording(int*);
+extern int MXAutogradMarkVariables(uint32_t, void**, uint32_t*, void**);
+extern int MXAutogradBackward(uint32_t, void**, void**, int);
+extern int MXNDArrayGetGrad(void*, void**);
 
 #define CHECK(cond)                                                   \
   do {                                                                \
@@ -175,6 +181,38 @@ int main(int argc, char** argv) {
   CHECK(batches == 2);
   CHECK(MXDataIterFree(it) == 0);
   printf("group:dataiter ok batches=%d\n", batches);
+
+  /* -- autograd: d(x*w)/dw == x, end to end from C -- */
+  void* wv = NULL;
+  void* wgrad = NULL;
+  CHECK(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &wv) == 0);
+  CHECK(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &wgrad) == 0);
+  float wdata[6] = {2, 2, 2, 2, 2, 2};
+  CHECK(MXNDArraySyncCopyFromCPU(wv, wdata, 6) == 0);
+  uint32_t req[1] = {1 /* write */};
+  void* mark_vars[1] = {wv};
+  void* mark_grads[1] = {wgrad};
+  CHECK(MXAutogradMarkVariables(1, mark_vars, req, mark_grads) == 0);
+  int prev = -1, curr = 0;
+  CHECK(MXAutogradSetIsRecording(1, &prev) == 0 && prev == 0);
+  CHECK(MXAutogradIsRecording(&curr) == 0 && curr == 1);
+  void* mul = NULL;
+  CHECK(MXGetOpHandle("elemwise_mul", &mul) == 0);
+  void* mul_ins[2] = {a, wv};
+  CHECK(MXImperativeInvoke(mul, 2, mul_ins, &n_out, &outs, 0, NULL,
+                           NULL) == 0);
+  void* y_out = outs[0];
+  CHECK(MXAutogradSetIsRecording(0, &prev) == 0 && prev == 1);
+  CHECK(MXAutogradBackward(1, &y_out, NULL, 0) == 0);
+  void* g = NULL;
+  CHECK(MXNDArrayGetGrad(wv, &g) == 0);
+  CHECK(MXNDArraySyncCopyToCPU(g, back, 6) == 0);
+  for (int i = 0; i < 6; ++i) CHECK(back[i] == data[i]); /* dy/dw = x */
+  CHECK(MXNDArrayFree(g) == 0);
+  CHECK(MXNDArrayFree(y_out) == 0);
+  CHECK(MXNDArrayFree(wv) == 0);
+  CHECK(MXNDArrayFree(wgrad) == 0);
+  printf("group:autograd ok\n");
 
   CHECK(MXNDArrayWaitAll() == 0);
   CHECK(MXNDArrayFree(a) == 0);

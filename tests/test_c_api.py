@@ -48,6 +48,6 @@ def test_c_api_all_groups(tmp_path):
         capture_output=True, text=True, timeout=300, env=env)
     assert res.returncode == 0, res.stdout + res.stderr
     for group in ("runtime", "oplist", "ndarray", "invoke", "saveload",
-                  "kvstore", "dataiter"):
+                  "kvstore", "dataiter", "autograd"):
         assert ("group:%s ok" % group) in res.stdout, res.stdout
     assert "ALL-GROUPS-OK" in res.stdout, res.stdout

@@ -688,6 +688,16 @@ def _():
        rtol=1e-3, atol=1e-4)
 
 
+@case("_onnx_MatMul")
+def _():
+    a, b = _a(2, 3), _a(3, 4)
+    op("_onnx_MatMul", a, b, gold=a @ b, rtol=1e-3, atol=1e-4)
+    a3, b3 = _a(5, 2, 3), _a(5, 3, 4)
+    op("_onnx_MatMul", a3, b3, gold=np.matmul(a3, b3), rtol=1e-3,
+       atol=1e-4)
+    gradcheck("_onnx_MatMul", a, b)
+
+
 @case("einsum")
 def _():
     a, b = _a(4, 2, 3), _a(4, 3, 5)

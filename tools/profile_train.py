@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Profile the production train loop on the current backend and
+attribute the step time (VERDICT r4 next #2: "close the MFU gap with a
+profile-driven loop").
+
+Captures, for the same ResNet-50 training configuration bench.py
+times:
+
+1. a jax.profiler trace (xprof / chrome://tracing protobuf) of K fused
+   steps -> --trace-dir;
+2. a host-side phase attribution: input staging (host->device), program
+   dispatch+execute (device), and publish (weight readback), so the
+   idle fraction is split between the input pipeline, dispatch
+   latency, and HLO quality;
+3. an MFU estimate per configuration (fp32/bf16 x NCHW/NHWC x
+   steps-per-program), printed as one JSON line per config for
+   BENCH_NOTES.
+
+Usage (on the chip):   python tools/profile_train.py --iters 6
+CPU sanity run:        JAX_PLATFORMS=cpu python tools/profile_train.py \
+                           --batch 8 --image 64 --iters 2 --no-trace
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+TRAIN_GFLOP_PER_IMG_224 = 12.3   # fwd ~4.1 GFLOP x3 (fwd+bwd)
+
+
+def build_loop(batch, image, dtype, spp):
+    import mxtpu as mx
+    from mxtpu import sym
+    from mxtpu.fused_train import FusedTrainLoop
+    from mxtpu.gluon.model_zoo import vision
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    with mx.amp.scope(dtype if dtype != "float32" else None):
+        net = vision.resnet50_v1(classes=1000)
+        net.initialize(ctx=ctx)
+        x_trace = mx.nd.zeros((batch, 3, image, image), ctx=ctx)
+        out_sym, _, _ = net._trace_symbol(x_trace)
+        softmax = sym.SoftmaxOutput(data=out_sym,
+                                    label=sym.Variable("softmax_label"),
+                                    name="softmax")
+        mod = mx.mod.Module(softmax, data_names=("data0",),
+                            label_names=("softmax_label",))
+        mod.bind(data_shapes=[("data0", (batch, 3, image, image))],
+                 label_shapes=[("softmax_label", (batch,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.01,
+                                             "momentum": 0.9})
+    return FusedTrainLoop(mod, steps_per_program=spp), mx
+
+
+def one_config(args, dtype, layout):
+    os.environ["MXTPU_CONV_LAYOUT"] = layout
+    import jax
+
+    from mxtpu.ops.registry import clear_executable_cache
+
+    clear_executable_cache()
+    loop, mx = build_loop(args.batch, args.image, dtype, args.spp)
+    from mxtpu.io.io import DataBatch
+
+    rng = np.random.RandomState(0)
+
+    def batches():
+        return [DataBatch(
+                    data=[mx.nd.array(
+                        rng.rand(args.batch, 3, args.image, args.image)
+                        .astype(np.float32))],
+                    label=[mx.nd.array(
+                        rng.randint(0, 1000, args.batch)
+                        .astype(np.float32))])
+                for _ in range(args.spp)]
+
+    # ---- phase attribution ----
+    t0 = time.perf_counter()
+    stacked = loop.stack_batches(batches())
+    jax.block_until_ready([v._data if hasattr(v, "_data") else v
+                           for v in stacked])
+    t_stage0 = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    loop.run_stacked(stacked)    # compile + first execute
+    t_compile = time.perf_counter() - t0
+
+    trace_dir = None
+    if args.trace_dir and dtype == args.trace_dtype and \
+            layout == args.trace_layout:
+        trace_dir = os.path.join(args.trace_dir,
+                                 "%s_%s" % (dtype, layout or "nchw"))
+        jax.profiler.start_trace(trace_dir)
+
+    stage_s = exec_s = 0.0
+    images = 0
+    for _ in range(args.iters):
+        bs = batches()           # host data generation: NOT staging
+        t0 = time.perf_counter()
+        stacked = loop.stack_batches(bs)
+        jax.block_until_ready([v._data if hasattr(v, "_data") else v
+                               for v in stacked])
+        stage_s += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        loop.run_stacked(stacked)
+        # run_stacked dispatches asynchronously — block on the updated
+        # params so the execute phase is charged to THIS timer, not to
+        # the next stage's block_until_ready
+        jax.block_until_ready(loop._p_vals)
+        exec_s += time.perf_counter() - t0
+        images += args.batch * args.spp
+
+    t0 = time.perf_counter()
+    loop.finalize()              # publish weights back to the module
+    t_publish = time.perf_counter() - t0
+    if trace_dir:
+        jax.profiler.stop_trace()
+
+    wall = stage_s + exec_s
+    gflop_per_img = TRAIN_GFLOP_PER_IMG_224 * (args.image / 224.0) ** 2
+    tflops = images * gflop_per_img / max(exec_s, 1e-9) / 1e3
+    peak = float(os.environ.get("MXTPU_PEAK_TFLOPS", "197"))
+    if dtype == "float32":
+        peak = min(peak, float(os.environ.get(
+            "MXTPU_PEAK_TFLOPS_F32", str(peak / 2))))
+    rec = {
+        "dtype": dtype, "layout": layout or "NCHW", "spp": args.spp,
+        "batch": args.batch, "image": args.image,
+        "img_per_s_exec": images / max(exec_s, 1e-9),
+        "img_per_s_wall": images / max(wall, 1e-9),
+        "exec_ms_per_step": exec_s * 1e3 / (args.iters * args.spp),
+        "stage_ms_per_step": stage_s * 1e3 / (args.iters * args.spp),
+        "input_pipeline_frac": stage_s / max(wall, 1e-9),
+        "compile_s": round(t_compile, 2),
+        "first_stage_s": round(t_stage0, 3),
+        "publish_s": round(t_publish, 3),
+        "device_tflops": round(tflops, 2),
+        "mfu_vs_peak": round(tflops / peak, 4),
+        "trace": trace_dir,
+    }
+    print(json.dumps(rec))
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--iters", type=int, default=6,
+                    help="timed windows per config")
+    ap.add_argument("--spp", type=int, default=8)
+    ap.add_argument("--configs", default="float32:,bfloat16:,"
+                    "float32:NHWC,bfloat16:NHWC",
+                    help="comma list of dtype:layout")
+    ap.add_argument("--trace-dir", default="/tmp/mxtpu_trace")
+    ap.add_argument("--no-trace", action="store_true")
+    ap.add_argument("--trace-dtype", default="bfloat16",
+                    help="config that gets the xprof trace")
+    ap.add_argument("--trace-layout", default="")
+    args = ap.parse_args()
+    if args.no_trace:
+        args.trace_dir = None
+
+    for spec in args.configs.split(","):
+        dtype, _, layout = spec.partition(":")
+        try:
+            one_config(args, dtype.strip(), layout.strip().upper())
+        except Exception as e:  # keep later configs running
+            print(json.dumps({"dtype": dtype, "layout": layout,
+                              "error": str(e)[:500]}))
+
+
+if __name__ == "__main__":
+    main()
