@@ -1,0 +1,105 @@
+"""Factorization machine on sparse input — the reference's
+`example/sparse/factorization_machine/` role: second-order FM
+(Rendle 2010) over high-dimensional sparse features, CSR batches, and
+the O(nnz·k) interaction identity  0.5·((x·V)² − x²·V²)  instead of
+the naive O(d²) pair sum.
+
+Synthetic task: click prediction where the label depends ONLY on
+feature co-occurrence pairs — a linear model cannot beat the
+majority-class baseline, the FM must.
+
+Run:  python factorization_machine.py [--epochs 30]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+
+D = 2000          # feature dimension (sparse)
+K = 8             # factor rank
+PAIRS = [(17, 412), (901, 1203), (55, 1999), (333, 777), (64, 128)]
+
+
+def make_data(rng, n):
+    X = (rng.rand(n, D) < 0.01).astype(np.float32)
+    for i, j in PAIRS:       # boost pair co-occurrence frequency
+        on = rng.rand(n) < 0.25
+        X[on, i] = 1
+        X[on, j] = 1
+    score = sum(X[:, i] * X[:, j] for i, j in PAIRS)
+    y = (score > 0).astype(np.float32)
+    return X, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--batch-size", type=int, default=200)
+    ap.add_argument("--lr", type=float, default=0.3)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+    Xtr, ytr = make_data(rng, 4000)
+    Xte, yte = make_data(rng, 1000)
+    base = max(yte.mean(), 1 - yte.mean())
+
+    w = nd.zeros((D, 1))
+    V = nd.random.normal(0, 0.05, (D, K))
+    b = nd.zeros((1,))
+    for p in (w, V, b):
+        p.attach_grad()
+
+    def fm(xb):
+        # works for CSR inputs (sparse gather-dot) and dense alike;
+        # features are BINARY, so x**2 == x and the second interaction
+        # term reuses the same sparse product
+        from mxtpu.ndarray import sparse as sp
+
+        dot = sp.dot if isinstance(xb, sp.CSRNDArray) else nd.dot
+        lin = dot(xb, w).reshape((-1,)) + b
+        xv = dot(xb, V)
+        inter = 0.5 * ((xv ** 2).sum(axis=1) - dot(xb, V ** 2)
+                       .sum(axis=1))
+        return lin + inter
+
+    def logloss(z, t):
+        return (nd.relu(z) - z * t +
+                nd.log(1 + nd.exp(-nd.abs(z)))).mean()
+
+    n = len(Xtr)
+    for epoch in range(args.epochs):
+        lsum, nb = 0.0, 0
+        for i in range(0, n, args.batch_size):
+            # CSR batch through the taped sparse dot path
+            xb = mx.nd.sparse.csr_matrix(Xtr[i:i + args.batch_size])
+            yb = nd.array(ytr[i:i + args.batch_size])
+            with autograd.record():
+                loss = logloss(fm(xb), yb)
+            loss.backward()
+            for p in (w, V, b):
+                p -= args.lr * p.grad
+                p.grad[:] = 0
+            lsum += float(loss.asnumpy())
+            nb += 1
+        if (epoch + 1) % 10 == 0 or epoch == args.epochs - 1:
+            pred = (fm(nd.array(Xte)).asnumpy() > 0)
+            acc = float((pred == yte).mean())
+            logging.info("epoch %d logloss %.4f test acc %.3f "
+                         "(majority %.3f)", epoch, lsum / nb, acc,
+                         base)
+    print("FINAL_ACCURACY %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

@@ -13,7 +13,7 @@ reference's model): right when the per-sample transform is
 python-heavy (GIL-bound) rather than decode-heavy.  Workers batchify
 to NUMPY (never touching jax/the device) and the parent does the
 single host->device conversion.  Measured crossover on this host
-(tests/test_gluon_data.py::test_process_workers_beat_threads_on_gil_bound):
+(tests/test_gluon_data.py, crossover timing print):
 a ~1 ms pure-python transform per sample is already ~2x faster with
 2 processes than 2 threads; byte-decode workloads favor threads.
 """

@@ -136,10 +136,9 @@ def _neighbor_sample(key, graph, seeds, prob, num_hops, num_neighbor,
     verts = jnp.where(vvalid, verts, -1)
     sub = _induced(graph, verts)
     vlayer = jnp.where(vvalid, jnp.take(layer, verts), -1)
-    # reference emits int64 vertex ids; without jax_enable_x64 JAX
-    # truncates int64 to int32 (with a per-call warning), so request the
-    # widest dtype actually available
-    idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    from .registry import index_dtype
+
+    idt = index_dtype()  # reference emits int64 vertex ids
     return verts.astype(idt), sub, vlayer.astype(idt)
 
 

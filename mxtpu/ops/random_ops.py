@@ -236,8 +236,10 @@ def _sample_unique_zipfian(key, range_max=1, shape=()):
         g = jax.random.gumbel(k, (range_max,))
         return jax.lax.top_k(logp + g, n)[1]
 
+    from .registry import index_dtype
+
     idx = jax.vmap(draw)(jax.random.split(key, rows))
-    return idx.reshape(shape).astype(jnp.int64)
+    return idx.reshape(shape).astype(index_dtype())
 
 
 @register("_shuffle", needs_rng=True, differentiable=False,

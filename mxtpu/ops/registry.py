@@ -217,3 +217,14 @@ def invoke_jax(opdef: OpDef, jax_inputs: Sequence, attrs: Dict[str, Any], rng_ke
 def clear_executable_cache():
     """Drop all cached jitted callables (test hook)."""
     _jitted.cache_clear()
+
+
+def index_dtype():
+    """Widest integer dtype actually available for emitted indices:
+    int64 only under jax_enable_x64 (otherwise JAX truncates with a
+    per-call warning) — shared by ops that mirror the reference's
+    int64 index outputs (dgl samplers, unique_zipfian)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32

@@ -299,3 +299,29 @@ def test_example_dsd():
 def test_example_kaggle_ndsb():
     out = _run_example("kaggle-ndsb1/plankton_cnn.py", "--epochs", "5")
     assert _final_metric(out, "FINAL_LOGLOSS") < 0.8
+
+
+def test_example_large_word_lm():
+    """Sampled-softmax LM (reference example/rnn/large_word_lm): full
+    validation perplexity over the 10k vocab must fall far below
+    uniform (10000) with training cost independent of vocab size."""
+    out = _run_example("rnn/large_word_lm/train.py", "--epochs", "2",
+                       timeout=560)
+    assert _final_metric(out, "FINAL_VALID_PPL") < 5000
+
+
+def test_example_factorization_machine():
+    """FM on sparse features (reference example/sparse/
+    factorization_machine): interactions-only labels — a linear model
+    is stuck at the majority baseline (~0.76), the FM must crack 0.9."""
+    out = _run_example("sparse/factorization_machine.py",
+                       "--epochs", "20", timeout=560)
+    assert _final_metric(out, "FINAL_ACCURACY") > 0.9
+
+
+def test_example_wide_deep():
+    """Wide&Deep (reference example/sparse/wide_deep): joint arms must
+    beat the majority baseline (~0.58) by a wide margin."""
+    out = _run_example("sparse/wide_deep.py", "--epochs", "10",
+                       timeout=560)
+    assert _final_metric(out, "FINAL_ACCURACY") > 0.8

@@ -6,6 +6,7 @@ Usage: python tools/gen_op_docs.py [-o docs/api/ops.md]
 import argparse
 import inspect
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -51,6 +52,9 @@ def main():
         for name, opdef in groups[mod]:
             try:
                 sig = str(inspect.signature(opdef.fn))
+                # function-object defaults repr as '<function f at 0x..>'
+                # — nondeterministic addresses churn the generated file
+                sig = re.sub(r"=<[^>]*>", "=<fn>", sig)
             except (TypeError, ValueError):
                 sig = "(...)"
             flags = []
