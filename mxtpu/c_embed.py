@@ -362,3 +362,98 @@ def nd_get_grad(arr):
         raise ValueError("array has no gradient buffer "
                          "(MXAutogradMarkVariables first)")
     return g
+
+
+# -- Symbol + Executor (reference c_api_symbolic.cc / c_api_executor.cc) ----
+
+def symbol_from_json(json_str: str):
+    """MXSymbolCreateFromJSON."""
+    from mxtpu.symbol.symbol import load_json
+
+    return load_json(json_str)
+
+
+def symbol_to_json(sym) -> str:
+    """MXSymbolSaveToJSON."""
+    return sym.tojson()
+
+
+def symbol_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def symbol_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_list_aux(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def _decode_csr_shapes(keys, indptr, shape_data):
+    """Reference CSR shape wire format -> {name: shape} (shared by
+    symbol_infer_shape and executor_simple_bind)."""
+    return {k: tuple(int(s)
+                     for s in shape_data[indptr[i]:indptr[i + 1]])
+            for i, k in enumerate(keys)}
+
+
+def symbol_infer_shape(sym, keys, indptr, shape_data):
+    """MXSymbolInferShape (reference CSR wire format in; three
+    (arg, out, aux) shape lists out)."""
+    arg_s, out_s, aux_s = sym.infer_shape(
+        **_decode_csr_shapes(keys, indptr, shape_data))
+    as_lists = lambda seq: [[int(d) for d in (s or ())] for s in seq]
+    return as_lists(arg_s), as_lists(out_s), as_lists(aux_s)
+
+
+def executor_simple_bind(sym, dev_type: int, dev_id: int, keys, indptr,
+                         shape_data, grad_req_code: int):
+    """MXExecutorSimpleBind: grad_req_code 0=null, 1=write — applied to
+    EVERY argument.  Passed as an explicit per-arg dict because the
+    python-level simple_bind treats string grad_req + provided shape as
+    "data input, null grad" — a C caller naturally provides all shapes
+    and still expects gradients."""
+    shapes = _decode_csr_shapes(keys, indptr, shape_data)
+    req = {0: "null", 1: "write"}.get(int(grad_req_code))
+    if req is None:
+        raise ValueError("grad_req code %d (0=null, 1=write)"
+                         % grad_req_code)
+    req_dict = {name: req for name in sym.list_arguments()}
+    return sym.simple_bind(ctx=_ctx(dev_type, dev_id),
+                           grad_req=req_dict, **shapes)
+
+
+def executor_set_arg(exe, name: str, arr) -> None:
+    """Copy `arr` into the named argument (data, label, or parameter)
+    — the C-side analog of writing exe.arg_dict[name][:]."""
+    if name in exe.arg_dict:
+        arr.copyto(exe.arg_dict[name])
+    elif name in exe.aux_dict:
+        arr.copyto(exe.aux_dict[name])
+    else:
+        raise KeyError("executor has no argument %r" % name)
+
+
+def executor_forward(exe, is_train: int) -> None:
+    exe.forward(is_train=bool(is_train))
+
+
+def executor_outputs(exe):
+    return list(exe.outputs)
+
+
+def executor_backward(exe, ograds) -> None:
+    """MXExecutorBackward; empty ograds = scalar-loss heads."""
+    exe.backward(list(ograds) if ograds else None)
+
+
+def executor_arg_grad(exe, name: str):
+    """Gradient buffer of a bound argument after backward."""
+    grads = dict(zip(symbol_list_arguments(exe._symbol),
+                     exe.grad_arrays))
+    g = grads.get(name)
+    if g is None:
+        raise KeyError("no gradient for argument %r (grad_req null?)"
+                       % name)
+    return g

@@ -379,6 +379,273 @@ int MXNDArrayLoad(const char* fname, uint32_t* out_size, void*** out_arr,
   return 0;
 }
 
+/* ---- Symbol + Executor (reference c_api_symbolic/executor.cc) --------- */
+
+int MXSymbolCreateFromJSON(const char* json, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(s)", json);
+  PyObject* res = embed_call("symbol_from_json", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res;
+  return 0;
+}
+
+int MXSymbolFree(void* handle) { return MXNDArrayFree(handle); }
+
+/* json string valid until the next MXSymbolSaveToJSON call */
+static std::string g_json_store;
+
+int MXSymbolSaveToJSON(void* handle, const char** out_json) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("symbol_to_json", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  const char* c = PyUnicode_AsUTF8(res);
+  {
+    std::lock_guard<std::mutex> lk(g_buf_mu);
+    g_json_store = c ? c : "";
+    *out_json = g_json_store.c_str();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+/* one store per list function: the bind workflow holds argument and
+ * output names SIMULTANEOUSLY (same rationale as g_op_names vs
+ * g_load_names) */
+static NameStore g_sym_arg_names;
+static NameStore g_sym_out_names;
+static NameStore g_sym_aux_names;
+
+static int sym_list(const char* fn, NameStore* store, void* handle,
+                    uint32_t* out_size, const char*** out_array) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call(fn, args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  int rc = export_names(res, store, out_size, out_array);
+  Py_DECREF(res);
+  return rc;
+}
+
+int MXSymbolListArguments(void* handle, uint32_t* out_size,
+                          const char*** out_array) {
+  return sym_list("symbol_list_arguments", &g_sym_arg_names, handle,
+                  out_size, out_array);
+}
+
+int MXSymbolListOutputs(void* handle, uint32_t* out_size,
+                        const char*** out_array) {
+  return sym_list("symbol_list_outputs", &g_sym_out_names, handle,
+                  out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(void* handle, uint32_t* out_size,
+                                const char*** out_array) {
+  return sym_list("symbol_list_aux", &g_sym_aux_names, handle,
+                  out_size, out_array);
+}
+
+/* CSR shape wire -> (keys, indptr, data) python lists; returns a new
+ * 3-tuple ref or nullptr */
+static PyObject* csr_to_pylists(uint32_t num, const char** keys,
+                                const uint32_t* ind_ptr,
+                                const uint32_t* shape_data) {
+  PyObject* ks = str_list(keys, num);
+  PyObject* indptr = PyList_New(num + 1);
+  for (uint32_t i = 0; i <= num; ++i)
+    PyList_SetItem(indptr, i, PyLong_FromUnsignedLong(ind_ptr[i]));
+  uint32_t n_dims = ind_ptr[num];
+  PyObject* data = PyList_New(n_dims);
+  for (uint32_t i = 0; i < n_dims; ++i)
+    PyList_SetItem(data, i, PyLong_FromUnsignedLong(shape_data[i]));
+  /* PyTuple_Pack ADDS refs; drop our creation refs so the tuple is
+   * the sole owner */
+  PyObject* tup = PyTuple_Pack(3, ks, indptr, data);
+  Py_DECREF(ks);
+  Py_DECREF(indptr);
+  Py_DECREF(data);
+  return tup;
+}
+
+/* shape triple storage for MXSymbolInferShape (valid until next call) */
+struct ShapeStore {
+  std::vector<uint32_t> ndims;
+  std::vector<std::vector<uint32_t>> rows;
+  std::vector<const uint32_t*> ptrs;
+};
+static ShapeStore g_shape_out[3];
+
+static void fill_shape_store(PyObject* lst, ShapeStore* st,
+                             uint32_t* out_size, const uint32_t** out_ndim,
+                             const uint32_t*** out_data) {
+  Py_ssize_t n = PyList_Size(lst);
+  st->ndims.clear();
+  st->rows.clear();
+  st->ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* row = PyList_GetItem(lst, i);
+    Py_ssize_t nd = PyList_Size(row);
+    std::vector<uint32_t> dims(nd);
+    for (Py_ssize_t j = 0; j < nd; ++j)
+      dims[j] = static_cast<uint32_t>(
+          PyLong_AsLong(PyList_GetItem(row, j)));
+    st->ndims.push_back(static_cast<uint32_t>(nd));
+    st->rows.push_back(std::move(dims));
+  }
+  for (auto& r : st->rows) st->ptrs.push_back(r.data());
+  *out_size = static_cast<uint32_t>(n);
+  *out_ndim = st->ndims.data();
+  *out_data = st->ptrs.data();
+}
+
+int MXSymbolInferShape(void* handle, uint32_t num_args, const char** keys,
+                       const uint32_t* arg_ind_ptr,
+                       const uint32_t* arg_shape_data,
+                       uint32_t* in_shape_size,
+                       const uint32_t** in_shape_ndim,
+                       const uint32_t*** in_shape_data,
+                       uint32_t* out_shape_size,
+                       const uint32_t** out_shape_ndim,
+                       const uint32_t*** out_shape_data,
+                       uint32_t* aux_shape_size,
+                       const uint32_t** aux_shape_ndim,
+                       const uint32_t*** aux_shape_data, int* complete) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* csr = csr_to_pylists(num_args, keys, arg_ind_ptr,
+                                 arg_shape_data);
+  PyObject* args = Py_BuildValue("(OOOO)",
+                                 static_cast<PyObject*>(handle),
+                                 PyTuple_GetItem(csr, 0),
+                                 PyTuple_GetItem(csr, 1),
+                                 PyTuple_GetItem(csr, 2));
+  Py_DECREF(csr);
+  PyObject* res = embed_call("symbol_infer_shape", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  {
+    std::lock_guard<std::mutex> lk(g_buf_mu);
+    fill_shape_store(PyTuple_GetItem(res, 0), &g_shape_out[0],
+                     in_shape_size, in_shape_ndim, in_shape_data);
+    fill_shape_store(PyTuple_GetItem(res, 1), &g_shape_out[1],
+                     out_shape_size, out_shape_ndim, out_shape_data);
+    fill_shape_store(PyTuple_GetItem(res, 2), &g_shape_out[2],
+                     aux_shape_size, aux_shape_ndim, aux_shape_data);
+  }
+  if (complete) *complete = 1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorSimpleBind(void* sym_handle, int dev_type, int dev_id,
+                         uint32_t num_provided, const char** keys,
+                         const uint32_t* ind_ptr,
+                         const uint32_t* shape_data, int grad_req,
+                         void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* csr = csr_to_pylists(num_provided, keys, ind_ptr,
+                                 shape_data);
+  PyObject* args = Py_BuildValue("(OiiOOOi)",
+                                 static_cast<PyObject*>(sym_handle),
+                                 dev_type, dev_id,
+                                 PyTuple_GetItem(csr, 0),
+                                 PyTuple_GetItem(csr, 1),
+                                 PyTuple_GetItem(csr, 2), grad_req);
+  Py_DECREF(csr);
+  PyObject* res = embed_call("executor_simple_bind", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res;
+  return 0;
+}
+
+int MXExecutorFree(void* handle) { return MXNDArrayFree(handle); }
+
+int MXExecutorSetArg(void* handle, const char* name, void* nd_handle) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(OsO)",
+                                 static_cast<PyObject*>(handle), name,
+                                 static_cast<PyObject*>(nd_handle));
+  PyObject* res = embed_call("executor_set_arg", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorForward(void* handle, int is_train) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(Oi)",
+                                 static_cast<PyObject*>(handle),
+                                 is_train);
+  PyObject* res = embed_call("executor_forward", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+static std::vector<void*> g_exec_out_store;
+
+int MXExecutorOutputs(void* handle, uint32_t* out_size, void*** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("executor_outputs", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  std::lock_guard<std::mutex> lk(g_buf_mu);
+  Py_ssize_t n = PyList_Size(res);
+  g_exec_out_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(res, i);
+    Py_INCREF(o);
+    g_exec_out_store.push_back(o);
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<uint32_t>(n);
+  *out = g_exec_out_store.data();
+  return 0;
+}
+
+int MXExecutorBackward(void* handle, uint32_t num_ograds,
+                       void** ograd_handles) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* og = num_ograds ? handle_list(ograd_handles, num_ograds)
+                            : PyList_New(0);
+  PyObject* args = Py_BuildValue("(OO)",
+                                 static_cast<PyObject*>(handle), og);
+  Py_DECREF(og);
+  PyObject* res = embed_call("executor_backward", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorArgGrad(void* handle, const char* name, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(Os)",
+                                 static_cast<PyObject*>(handle), name);
+  PyObject* res = embed_call("executor_arg_grad", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res;
+  return 0;
+}
+
 /* ---- Autograd (reference c_api.h:1004-1050) --------------------------- */
 
 static int ag_flag(const char* fn, int flag, int* prev) {

@@ -48,6 +48,27 @@ extern int MXAutogradIsRecording(int*);
 extern int MXAutogradMarkVariables(uint32_t, void**, uint32_t*, void**);
 extern int MXAutogradBackward(uint32_t, void**, void**, int);
 extern int MXNDArrayGetGrad(void*, void**);
+extern int MXSymbolCreateFromJSON(const char*, void**);
+extern int MXSymbolSaveToJSON(void*, const char**);
+extern int MXSymbolListArguments(void*, uint32_t*, const char***);
+extern int MXSymbolListOutputs(void*, uint32_t*, const char***);
+extern int MXSymbolInferShape(void*, uint32_t, const char**,
+                              const uint32_t*, const uint32_t*,
+                              uint32_t*, const uint32_t**,
+                              const uint32_t***, uint32_t*,
+                              const uint32_t**, const uint32_t***,
+                              uint32_t*, const uint32_t**,
+                              const uint32_t***, int*);
+extern int MXSymbolFree(void*);
+extern int MXExecutorSimpleBind(void*, int, int, uint32_t, const char**,
+                                const uint32_t*, const uint32_t*, int,
+                                void**);
+extern int MXExecutorSetArg(void*, const char*, void*);
+extern int MXExecutorForward(void*, int);
+extern int MXExecutorOutputs(void*, uint32_t*, void***);
+extern int MXExecutorBackward(void*, uint32_t, void**);
+extern int MXExecutorArgGrad(void*, const char*, void**);
+extern int MXExecutorFree(void*);
 
 #define CHECK(cond)                                                   \
   do {                                                                \
@@ -213,6 +234,92 @@ int main(int argc, char** argv) {
   CHECK(MXNDArrayFree(wv) == 0);
   CHECK(MXNDArrayFree(wgrad) == 0);
   printf("group:autograd ok\n");
+
+  /* -- symbol + executor: json -> bind -> fwd -> bwd from C -- */
+  /* argv[3] = path to a symbol json written by the pytest harness */
+  if (argc > 3) {
+    FILE* f = fopen(argv[3], "rb");
+    CHECK(f != NULL);
+    static char js[65536];
+    size_t nread = fread(js, 1, sizeof(js) - 1, f);
+    fclose(f);
+    js[nread] = 0;
+    void* symh = NULL;
+    CHECK(MXSymbolCreateFromJSON(js, &symh) == 0);
+    const char* js2 = NULL;
+    CHECK(MXSymbolSaveToJSON(symh, &js2) == 0 && js2[0] == '{');
+    uint32_t n_args = 0, n_outs = 0;
+    const char **arg_names, **out_names;
+    CHECK(MXSymbolListArguments(symh, &n_args, &arg_names) == 0);
+    CHECK(MXSymbolListOutputs(symh, &n_outs, &out_names) == 0);
+    CHECK(n_args == 3 && n_outs == 1); /* data, fc_weight, fc_bias */
+    /* both name arrays must stay valid SIMULTANEOUSLY (per-function
+     * stable storage) */
+    CHECK(strcmp(arg_names[0], "data") == 0);
+    CHECK(strstr(out_names[0], "output") != NULL);
+    const char* skeys[1] = {"data"};
+    uint32_t sindptr[2] = {0, 2};
+    uint32_t sdata[2] = {2, 5};
+    uint32_t isz, osz, asz;
+    const uint32_t *indim, *ondim, *andim;
+    const uint32_t **idat, **odat, **adat;
+    int complete = 0;
+    CHECK(MXSymbolInferShape(symh, 1, skeys, sindptr, sdata, &isz,
+                             &indim, &idat, &osz, &ondim, &odat, &asz,
+                             &andim, &adat, &complete) == 0);
+    CHECK(isz == 3 && osz == 1 && complete == 1);
+    CHECK(ondim[0] == 2 && odat[0][0] == 2 && odat[0][1] == 3);
+    /* bind with ALL shapes provided (the natural C pattern) — grads
+     * must still flow for every argument */
+    const char* bkeys[3] = {"data", "fc_weight", "fc_bias"};
+    uint32_t bindptr[4] = {0, 2, 4, 5};
+    uint32_t bdata[5] = {2, 5, 3, 5, 3};
+    void* exec = NULL;
+    CHECK(MXExecutorSimpleBind(symh, 1, 0, 3, bkeys, bindptr, bdata,
+                               /*grad_req=write*/ 1, &exec) == 0);
+    uint32_t dshape[2] = {2, 5};
+    uint32_t wshape2[2] = {3, 5};
+    uint32_t bshape2[1] = {3};
+    void *xd, *wd, *bd;
+    CHECK(MXNDArrayCreateEx(dshape, 2, 1, 0, 0, 0, &xd) == 0);
+    CHECK(MXNDArrayCreateEx(wshape2, 2, 1, 0, 0, 0, &wd) == 0);
+    CHECK(MXNDArrayCreateEx(bshape2, 1, 1, 0, 0, 0, &bd) == 0);
+    float ones10[10] = {1, 1, 1, 1, 1, 1, 1, 1, 1, 1};
+    float w15[15];
+    for (int i = 0; i < 15; ++i) w15[i] = 1.0f;
+    float b3[3] = {0, 0, 0};
+    CHECK(MXNDArraySyncCopyFromCPU(xd, ones10, 10) == 0);
+    CHECK(MXNDArraySyncCopyFromCPU(wd, w15, 15) == 0);
+    CHECK(MXNDArraySyncCopyFromCPU(bd, b3, 3) == 0);
+    CHECK(MXExecutorSetArg(exec, "data", xd) == 0);
+    CHECK(MXExecutorSetArg(exec, "fc_weight", wd) == 0);
+    CHECK(MXExecutorSetArg(exec, "fc_bias", bd) == 0);
+    CHECK(MXExecutorForward(exec, 1) == 0);
+    uint32_t n_eo = 0;
+    void** eo = NULL;
+    CHECK(MXExecutorOutputs(exec, &n_eo, &eo) == 0 && n_eo == 1);
+    float fc_out[6];
+    CHECK(MXNDArraySyncCopyToCPU(eo[0], fc_out, 6) == 0);
+    CHECK(fc_out[0] == 5.0f); /* ones(5) . ones(5) */
+    void* og = NULL;
+    uint32_t oshape2[2] = {2, 3};
+    CHECK(MXNDArrayCreateEx(oshape2, 2, 1, 0, 0, 0, &og) == 0);
+    float og6[6] = {1, 1, 1, 1, 1, 1};
+    CHECK(MXNDArraySyncCopyFromCPU(og, og6, 6) == 0);
+    void* ogs[1] = {og};
+    CHECK(MXExecutorBackward(exec, 1, ogs) == 0);
+    void* wgrad2 = NULL;
+    CHECK(MXExecutorArgGrad(exec, "fc_weight", &wgrad2) == 0);
+    float wg15[15];
+    CHECK(MXNDArraySyncCopyToCPU(wgrad2, wg15, 15) == 0);
+    CHECK(wg15[0] == 2.0f); /* sum over batch of data ones */
+    MXNDArrayFree(wgrad2); MXNDArrayFree(og);
+    MXNDArrayFree(xd); MXNDArrayFree(wd); MXNDArrayFree(bd);
+    MXNDArrayFree(eo[0]);
+    CHECK(MXExecutorFree(exec) == 0);
+    CHECK(MXSymbolFree(symh) == 0);
+    printf("group:symexec ok\n");
+  }
 
   CHECK(MXNDArrayWaitAll() == 0);
   CHECK(MXNDArrayFree(a) == 0);

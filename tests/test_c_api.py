@@ -31,6 +31,14 @@ def test_c_api_all_groups(tmp_path):
     rows = np.arange(12, dtype=np.float32).reshape(4, 3)
     np.savetxt(csv, rows, delimiter=",", fmt="%.1f")
 
+    # symbol json for the symexec group
+    from mxtpu import sym
+
+    d = sym.Variable("data")
+    fc = sym.FullyConnected(data=d, num_hidden=3, name="fc")
+    sym_json = tmp_path / "fc.json"
+    sym_json.write_text(fc.tojson())
+
     exe_path = str(tmp_path / "c_api_test")
     cc = subprocess.run(
         ["gcc", os.path.join(REPO, "tests", "c_api_test.c"),
@@ -44,10 +52,11 @@ def test_c_api_all_groups(tmp_path):
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     res = subprocess.run(
-        [exe_path, str(csv), str(tmp_path / "weights.params")],
+        [exe_path, str(csv), str(tmp_path / "weights.params"),
+         str(sym_json)],
         capture_output=True, text=True, timeout=300, env=env)
     assert res.returncode == 0, res.stdout + res.stderr
     for group in ("runtime", "oplist", "ndarray", "invoke", "saveload",
-                  "kvstore", "dataiter", "autograd"):
+                  "kvstore", "dataiter", "autograd", "symexec"):
         assert ("group:%s ok" % group) in res.stdout, res.stdout
     assert "ALL-GROUPS-OK" in res.stdout, res.stdout
