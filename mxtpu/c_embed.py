@@ -457,3 +457,38 @@ def executor_arg_grad(exe, name: str):
         raise KeyError("no gradient for argument %r (grad_req null?)"
                        % name)
     return g
+
+
+# -- CachedOp (reference c_api_ndarray.cc MXCreateCachedOp[Ex]) ------------
+
+def cached_op_create(sym):
+    """MXCreateCachedOp: compile the symbol once; invocations reuse the
+    jitted module."""
+    from mxtpu.cached_op import CachedOp
+
+    return CachedOp(sym)
+
+
+def cached_op_invoke(co, inputs):
+    """MXInvokeCachedOp: inputs are the arguments in
+    symbol.list_arguments() order FOLLOWED by the auxiliary states in
+    symbol.list_auxiliary_states() order (reference semantics: aux
+    travels among the inputs; aux handles are updated in place)."""
+    inputs = list(inputs)
+    n_args = len(co._arg_names)
+    out = co(inputs[:n_args], inputs[n_args:])
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+# -- KVStore cluster queries (reference MXKVStoreGetRank/GroupSize) --------
+
+def kv_rank(kv) -> int:
+    return int(kv.rank)
+
+
+def kv_num_workers(kv) -> int:
+    return int(kv.num_workers)
+
+
+def kv_barrier(kv) -> None:
+    kv.barrier()

@@ -103,6 +103,24 @@ int export_names(PyObject* lst, NameStore* store, uint32_t* out_size,
 
 int fail() { return -1; }
 
+/* expose a python list of objects as a stable handle array; the CALLER
+ * owns each returned handle (free with MXNDArrayFree) — array memory
+ * valid until the next call filling the same store */
+int export_handles(PyObject* lst, std::vector<void*>* store,
+                   uint32_t* out_size, void*** out_array) {
+  std::lock_guard<std::mutex> lk(g_buf_mu);
+  Py_ssize_t n = PyList_Size(lst);
+  store->clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(lst, i);
+    Py_INCREF(o);
+    store->push_back(o);
+  }
+  *out_size = static_cast<uint32_t>(n);
+  *out_array = store->data();
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -188,17 +206,10 @@ int MXImperativeInvoke(void* op_handle, int num_inputs, void** inputs,
   PyObject* res = embed_call("imperative_invoke", args);
   Py_DECREF(args);
   if (!res) return fail();
-  std::lock_guard<std::mutex> lk(g_buf_mu);
-  Py_ssize_t n = PyList_Size(res);
-  g_invoke_store.clear();
-  for (Py_ssize_t i = 0; i < n; ++i) {
-    PyObject* o = PyList_GetItem(res, i);
-    Py_INCREF(o); /* caller owns each output handle (MXNDArrayFree) */
-    g_invoke_store.push_back(o);
-  }
+  uint32_t n = 0;
+  export_handles(res, &g_invoke_store, &n, outputs);
   Py_DECREF(res);
   *num_outputs = static_cast<int>(n);
-  *outputs = g_invoke_store.data();
   return 0;
 }
 
@@ -362,18 +373,7 @@ int MXNDArrayLoad(const char* fname, uint32_t* out_size, void*** out_arr,
   if (!res) return fail();
   PyObject* arrs = PyTuple_GetItem(res, 0);
   PyObject* names = PyTuple_GetItem(res, 1);
-  {
-    std::lock_guard<std::mutex> lk(g_buf_mu);
-    Py_ssize_t n = PyList_Size(arrs);
-    g_load_store.clear();
-    for (Py_ssize_t i = 0; i < n; ++i) {
-      PyObject* o = PyList_GetItem(arrs, i);
-      Py_INCREF(o);
-      g_load_store.push_back(o);
-    }
-    *out_size = static_cast<uint32_t>(n);
-    *out_arr = g_load_store.data();
-  }
+  export_handles(arrs, &g_load_store, out_size, out_arr);
   export_names(names, &g_load_names, out_name_size, out_names);
   Py_DECREF(res);
   return 0;
@@ -604,17 +604,8 @@ int MXExecutorOutputs(void* handle, uint32_t* out_size, void*** out) {
   PyObject* res = embed_call("executor_outputs", args);
   Py_DECREF(args);
   if (!res) return fail();
-  std::lock_guard<std::mutex> lk(g_buf_mu);
-  Py_ssize_t n = PyList_Size(res);
-  g_exec_out_store.clear();
-  for (Py_ssize_t i = 0; i < n; ++i) {
-    PyObject* o = PyList_GetItem(res, i);
-    Py_INCREF(o);
-    g_exec_out_store.push_back(o);
-  }
+  export_handles(res, &g_exec_out_store, out_size, out);
   Py_DECREF(res);
-  *out_size = static_cast<uint32_t>(n);
-  *out = g_exec_out_store.data();
   return 0;
 }
 
@@ -785,6 +776,75 @@ int MXKVStorePush(void* handle, uint32_t num, const int* keys, void** vals,
 int MXKVStorePull(void* handle, uint32_t num, const int* keys, void** vals,
                   int priority) {
   return kv_call("kv_pull", handle, num, keys, vals, priority, true);
+}
+
+/* ---- CachedOp (reference c_api_ndarray.cc) ---------------------------- */
+
+int MXCreateCachedOp(void* sym_handle, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)",
+                                 static_cast<PyObject*>(sym_handle));
+  PyObject* res = embed_call("cached_op_create", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res;
+  return 0;
+}
+
+int MXFreeCachedOp(void* handle) { return MXNDArrayFree(handle); }
+
+static std::vector<void*> g_cachedop_store;
+
+int MXInvokeCachedOp(void* handle, int num_inputs, void** inputs,
+                     int* num_outputs, void*** outputs) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* ins = handle_list(inputs, num_inputs);
+  PyObject* args = Py_BuildValue("(OO)",
+                                 static_cast<PyObject*>(handle), ins);
+  Py_DECREF(ins);
+  PyObject* res = embed_call("cached_op_invoke", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  uint32_t n = 0;
+  export_handles(res, &g_cachedop_store, &n, outputs);
+  Py_DECREF(res);
+  *num_outputs = static_cast<int>(n);
+  return 0;
+}
+
+/* ---- KVStore cluster queries ------------------------------------------ */
+
+static int kv_int_query(const char* fn, void* handle, int* out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call(fn, args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreGetRank(void* handle, int* out) {
+  return kv_int_query("kv_rank", handle, out);
+}
+
+int MXKVStoreGetGroupSize(void* handle, int* out) {
+  return kv_int_query("kv_num_workers", handle, out);
+}
+
+int MXKVStoreBarrier(void* handle) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("kv_barrier", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
 }
 
 /* ---- Data iterators --------------------------------------------------- */

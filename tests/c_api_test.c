@@ -69,6 +69,12 @@ extern int MXExecutorOutputs(void*, uint32_t*, void***);
 extern int MXExecutorBackward(void*, uint32_t, void**);
 extern int MXExecutorArgGrad(void*, const char*, void**);
 extern int MXExecutorFree(void*);
+extern int MXCreateCachedOp(void*, void**);
+extern int MXInvokeCachedOp(void*, int, void**, int*, void***);
+extern int MXFreeCachedOp(void*);
+extern int MXKVStoreGetRank(void*, int*);
+extern int MXKVStoreGetGroupSize(void*, int*);
+extern int MXKVStoreBarrier(void*);
 
 #define CHECK(cond)                                                   \
   do {                                                                \
@@ -181,6 +187,10 @@ int main(int argc, char** argv) {
   CHECK(MXKVStorePull(kv, 1, kv_keys, kv_outs, 0) == 0);
   CHECK(MXNDArraySyncCopyToCPU(pulled, back, 6) == 0);
   /* local kvstore: init set the value; push adds a, pull returns merged */
+  int kv_rank = -1, kv_size = -1;
+  CHECK(MXKVStoreGetRank(kv, &kv_rank) == 0 && kv_rank == 0);
+  CHECK(MXKVStoreGetGroupSize(kv, &kv_size) == 0 && kv_size == 1);
+  CHECK(MXKVStoreBarrier(kv) == 0); /* local: immediate no-op */
   printf("group:kvstore ok pulled0=%g\n", back[0]);
 
   /* -- DataIter: CSVIter over argv[1] (4 rows of 3 floats) -- */
@@ -314,9 +324,25 @@ int main(int argc, char** argv) {
     CHECK(MXNDArraySyncCopyToCPU(wgrad2, wg15, 15) == 0);
     CHECK(wg15[0] == 2.0f); /* sum over batch of data ones */
     MXNDArrayFree(wgrad2); MXNDArrayFree(og);
-    MXNDArrayFree(xd); MXNDArrayFree(wd); MXNDArrayFree(bd);
     MXNDArrayFree(eo[0]);
     CHECK(MXExecutorFree(exec) == 0);
+
+    /* -- CachedOp: compile once, invoke twice -- */
+    void* co = NULL;
+    CHECK(MXCreateCachedOp(symh, &co) == 0);
+    for (int rep = 0; rep < 2; ++rep) {
+      void* co_ins[3] = {xd, wd, bd}; /* fc symbol has no aux */
+      int co_n = 0;
+      void** co_outs = NULL;
+      CHECK(MXInvokeCachedOp(co, 3, co_ins, &co_n, &co_outs) == 0);
+      CHECK(co_n == 1);
+      float co_o[6];
+      CHECK(MXNDArraySyncCopyToCPU(co_outs[0], co_o, 6) == 0);
+      CHECK(co_o[0] == 5.0f);
+      CHECK(MXNDArrayFree(co_outs[0]) == 0);
+    }
+    CHECK(MXFreeCachedOp(co) == 0);
+    MXNDArrayFree(xd); MXNDArrayFree(wd); MXNDArrayFree(bd);
     CHECK(MXSymbolFree(symh) == 0);
     printf("group:symexec ok\n");
   }
