@@ -251,3 +251,211 @@ def test_export_symbolblock_roundtrip(tmp_path):
                                   path + "-0000.params", ctx=mx.cpu())
     y2 = sb(x).asnumpy()
     np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# round-5 deepening toward reference test_gluon.py (2,557 lines):
+# parameter sharing, ParameterDict semantics, save/load option matrix,
+# constants, collect_params filtering, nested blocks, grad_req
+# ---------------------------------------------------------------------------
+
+def test_parameter_sharing_via_params():
+    """reference test_parameter_sharing: blocks constructed with
+    params=other.params literally share storage."""
+    a = gluon.nn.Dense(8, prefix="shared_")
+    b = gluon.nn.Dense(8, prefix="shared_", params=a.params)
+    a.initialize()
+    x = nd.array(np.random.RandomState(0).rand(2, 4).astype(np.float32))
+    np.testing.assert_allclose(a(x).asnumpy(), b(x).asnumpy())
+    # updating through a reflects in b
+    a.weight.set_data(a.weight.data() * 2)
+    np.testing.assert_allclose(a(x).asnumpy(), b(x).asnumpy())
+
+
+def test_parameter_dict_get_and_update():
+    """ParameterDict.get creates-or-returns; shape conflicts raise."""
+    pd = gluon.ParameterDict(prefix="pd_")
+    from mxtpu.base import MXNetError
+
+    w1 = pd.get("w", shape=(3, 4))
+    w2 = pd.get("w", shape=(3, 4))
+    assert w1 is w2
+    with pytest.raises(MXNetError):
+        pd.get("w", shape=(5, 5))
+
+
+def test_collect_params_regex_filter():
+    """reference collect_params('.*weight') selection semantics."""
+    net = gluon.nn.HybridSequential(prefix="f_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(4), gluon.nn.Dense(2))
+    net.initialize()
+    sel = net.collect_params(".*weight")
+    assert len(sel.keys()) == 2
+    assert all(k.endswith("weight") for k in sel.keys())
+
+
+def test_save_load_option_matrix(tmp_path):
+    """allow_missing / ignore_extra load semantics (reference
+    test_save_load)."""
+    net = gluon.nn.HybridSequential(prefix="m_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(6, activation="relu"), gluon.nn.Dense(3))
+    net.initialize()
+    x = nd.ones((2, 5))
+    net(x)
+    p = str(tmp_path / "m.params")
+    net.save_parameters(p)
+
+    # bigger net: loading with allow_missing works, strict raises
+    big = gluon.nn.HybridSequential(prefix="m_")
+    with big.name_scope():
+        big.add(gluon.nn.Dense(6, activation="relu"),
+                gluon.nn.Dense(3), gluon.nn.Dense(2))
+    from mxtpu.base import MXNetError
+
+    with pytest.raises(MXNetError):
+        big.load_parameters(p)
+    big.load_parameters(p, allow_missing=True)
+
+    # smaller net: ignore_extra permits the surplus keys
+    small = gluon.nn.HybridSequential(prefix="m_")
+    with small.name_scope():
+        small.add(gluon.nn.Dense(6, activation="relu"))
+    with pytest.raises(MXNetError):
+        small.load_parameters(p)
+    small.load_parameters(p, ignore_extra=True)
+    # loaded layer matches the original's first layer output
+    np.testing.assert_allclose(small(x).asnumpy(),
+                               net[0](x).asnumpy(), rtol=1e-6)
+
+
+def test_constant_parameter():
+    """gluon.Constant: fixed values, excluded from gradient updates."""
+    class WithConst(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.const = self.params.get_constant(
+                    "c", np.array([[1.0, 2.0], [3.0, 4.0]],
+                                  np.float32))
+                self.dense = gluon.nn.Dense(2)
+
+        def hybrid_forward(self, F, x, const):
+            return self.dense(x) + const
+
+    net = WithConst()
+    net.initialize()
+    x = nd.ones((2, 2))
+    out1 = net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.5})
+    with mx.autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    tr.step(1)
+    # constant unchanged by the update
+    np.testing.assert_allclose(
+        net.const.data().asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_nested_blocks_collect_and_run():
+    class Inner(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = gluon.nn.Dense(4, activation="tanh")
+
+        def hybrid_forward(self, F, x):
+            return self.fc(x)
+
+    class Outer(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.a = Inner()
+                self.b = Inner()
+                self.head = gluon.nn.Dense(2)
+
+        def hybrid_forward(self, F, x):
+            return self.head(self.a(x) + self.b(x))
+
+    net = Outer()
+    net.initialize()
+    out = net(nd.ones((3, 5)))
+    assert out.shape == (3, 2)
+    # 2 inner fc (w+b) x 2 + head (w+b) = 6 params
+    assert len(net.collect_params().keys()) == 6
+
+
+def test_grad_req_null_parameter_not_updated():
+    net = gluon.nn.Dense(3, in_units=4)
+    net.initialize()
+    net.bias.grad_req = "null"
+    b0 = net.bias.data().asnumpy().copy()
+    w0 = net.weight.data().asnumpy().copy()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 1.0})
+    with mx.autograd.record():
+        loss = (net(nd.ones((2, 4))) ** 2).mean()
+    loss.backward()
+    tr.step(1)
+    np.testing.assert_allclose(net.bias.data().asnumpy(), b0)
+    # weight DID move from its pre-step snapshot
+    assert np.abs(net.weight.data().asnumpy() - w0).sum() > 0
+
+
+def test_reinitialize_with_force():
+    net = gluon.nn.Dense(3, in_units=4)  # static shape: init is eager
+    net.initialize(init=mx.init.Zero())
+    assert float(net.weight.data().asnumpy().sum()) == 0.0
+    # re-init WITHOUT force is a no-op (reference warns and skips)
+    net.initialize(init=mx.init.One())
+    assert float(net.weight.data().asnumpy().sum()) == 0.0
+    net.initialize(init=mx.init.One(), force_reinit=True)
+    assert float(net.weight.data().asnumpy().sum()) == 12.0
+
+
+def test_setattr_replaces_child():
+    """Reassigning an attribute swaps the child block (reference
+    Block.__setattr__ registration semantics)."""
+    first = gluon.nn.Dense(5, prefix="x_")
+    second = gluon.nn.Dense(6, prefix="y_")
+
+    class Holder(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.body = first
+
+        def hybrid_forward(self, F, x):
+            return self.body(x)
+
+    h = Holder()
+    h.body = second
+    h.initialize()
+    assert h(nd.ones((1, 3))).shape == (1, 6)
+
+
+def test_summary_or_repr_smoke():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, activation="relu"), gluon.nn.Dense(2))
+    r = repr(net)
+    assert "Dense" in r
+
+
+def test_embedding_grad_is_row_sparse_semantics():
+    """Embedding with sparse_grad=True: only touched rows receive grad
+    mass (reference test_embedding sparse grad path)."""
+    emb = gluon.nn.Embedding(20, 4, sparse_grad=True)
+    emb.initialize()
+    idx = nd.array(np.array([1.0, 3.0, 1.0], np.float32))
+    with mx.autograd.record():
+        out = emb(idx).sum()
+    out.backward()
+    g = emb.weight.grad().asnumpy() if not hasattr(
+        emb.weight.grad(), "todense") else \
+        emb.weight.grad().todense().asnumpy()
+    touched = set(np.nonzero(np.abs(g).sum(axis=1))[0].tolist())
+    assert touched == {1, 3}
+    np.testing.assert_allclose(g[1], 2.0)  # row 1 hit twice
+    np.testing.assert_allclose(g[3], 1.0)
