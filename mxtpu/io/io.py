@@ -158,11 +158,18 @@ class NDArrayIter(DataIter):
                 raise MXNetError("inconsistent first dims: %s" % k)
         if last_batch_handle not in ("pad", "discard", "roll_over"):
             raise MXNetError("bad last_batch_handle %r" % last_batch_handle)
+        if last_batch_handle == "roll_over" and \
+                0 < self.num_data < batch_size:
+            # a carried batch could never fill: epoch 1 would emit
+            # nothing and later epochs would break the static shape
+            raise MXNetError("roll_over requires num_data >= batch_size"
+                             " (%d < %d)" % (self.num_data, batch_size))
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         self.idx = np.arange(self.num_data)
         self.cursor = -batch_size
         self._cache = None
+        self._exhausted = False
         self.reset()
 
     @property
@@ -176,28 +183,49 @@ class NDArrayIter(DataIter):
                 for k, v in self.label]
 
     def reset(self):
+        # reference NDArrayIter.reset (io.py:658): an INCOMPLETE tail
+        # batch under roll_over is never emitted — its samples (by
+        # their pre-shuffle indices) are carried and concatenated onto
+        # the next epoch's first batch
+        if self.last_batch_handle == "roll_over" and \
+                self.num_data - self.batch_size < self.cursor \
+                < self.num_data:
+            self._cache = self.idx[self.cursor:].copy()
+            self.cursor = self.cursor - self.num_data - self.batch_size
+        else:
+            self._cache = None
+            self.cursor = -self.batch_size
+        self._exhausted = False
         if self.shuffle:
             np.random.shuffle(self.idx)
-        if self.last_batch_handle == "roll_over" and \
-                0 < self.cursor < self.num_data:
-            # keep the tail for next epoch (reference roll_over)
-            self.cursor = -self.batch_size + (self.cursor % self.num_data)
-        else:
-            self.cursor = -self.batch_size
 
     def iter_next(self):
+        if self._exhausted:
+            # repeated end-of-data next() calls (e.g. PrefetchingIter's
+            # in-flight producers) must not advance the cursor past the
+            # roll_over carry window
+            return False
         self.cursor += self.batch_size
         if self.last_batch_handle == "discard":
-            return self.cursor + self.batch_size <= self.num_data
-        return self.cursor < self.num_data
+            ok = self.cursor + self.batch_size <= self.num_data
+        elif self.last_batch_handle == "roll_over":
+            # a carried first batch (cursor < 0) is complete by
+            # construction; otherwise only complete batches are emitted
+            # — the incomplete tail stops the epoch and gets cached
+            ok = self.cursor < 0 or \
+                self.cursor + self.batch_size <= self.num_data
+        else:
+            ok = self.cursor < self.num_data
+        self._exhausted = not ok
+        return ok
 
     def _take(self, arrays):
         lo = self.cursor
         hi = self.cursor + self.batch_size
         out = []
         for _, v in arrays:
-            if lo < 0:  # roll_over head
-                sel = self.idx[np.arange(lo, hi) % self.num_data]
+            if lo < 0:  # roll_over: carried tail + fresh head
+                sel = np.concatenate([self._cache, self.idx[:hi]])
             elif hi <= self.num_data:
                 sel = self.idx[lo:hi]
             else:  # pad: wrap
@@ -216,12 +244,16 @@ class NDArrayIter(DataIter):
         hi = self.cursor + self.batch_size
         if self.last_batch_handle == "pad" and hi > self.num_data:
             return hi - self.num_data
+        if self.last_batch_handle == "roll_over" and self.cursor < 0:
+            # reference getpad: carried samples count as pad
+            return -self.cursor
         return 0
 
     def getindex(self):
-        lo = max(self.cursor, 0)
         hi = self.cursor + self.batch_size
-        return self.idx[np.arange(lo, hi) % self.num_data]
+        if self.cursor < 0:  # roll_over carried batch
+            return np.concatenate([self._cache, self.idx[:hi]])
+        return self.idx[np.arange(self.cursor, hi) % self.num_data]
 
 
 class SimpleIter(DataIter):
