@@ -234,3 +234,155 @@ def test_unlabeled_then_labeled_batch_rebind():
     assert mod._exec_group.label_shapes, "label slots were dropped"
     assert not np.allclose(g_a, g_b), \
         "different labels produced identical grads (stale label buffer)"
+
+
+# ---------------------------------------------------------------------------
+# round-5 deepening toward reference test_module.py (877 lines)
+# ---------------------------------------------------------------------------
+
+def _small_mlp_sym(hidden=8, classes=3):
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data=data, num_hidden=hidden, name="fc1")
+    h = sym.Activation(data=h, act_type="relu")
+    h = sym.FullyConnected(data=h, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(data=h, name="softmax")
+
+
+def test_set_params_matches_init_params():
+    """reference test_module_set_params: set_params equals init_params
+    with the same values; missing/extra handling flags."""
+    m = mx.mod.Module(_small_mlp_sym(), data_names=("data",),
+                      label_names=("softmax_label",))
+    m.bind(data_shapes=[("data", (4, 6))],
+           label_shapes=[("softmax_label", (4,))])
+    m.init_params()
+    args, aux = m.get_params()
+    m2 = mx.mod.Module(_small_mlp_sym(), data_names=("data",),
+                       label_names=("softmax_label",))
+    m2.bind(data_shapes=[("data", (4, 6))],
+            label_shapes=[("softmax_label", (4,))])
+    m2.set_params(args, aux)
+    x = mx.nd.array(np.random.RandomState(0).rand(4, 6)
+                    .astype(np.float32))
+    batch = mx.io.DataBatch(data=[x], label=[mx.nd.zeros((4,))])
+    m.forward(batch, is_train=False)
+    m2.forward(batch, is_train=False)
+    np.testing.assert_allclose(m.get_outputs()[0].asnumpy(),
+                               m2.get_outputs()[0].asnumpy(),
+                               rtol=1e-6)
+    # missing params must raise unless allowed
+    with pytest.raises(Exception):
+        m2.set_params({"fc1_weight": args["fc1_weight"]}, {},
+                      allow_missing=False)
+    m2.set_params({"fc1_weight": args["fc1_weight"]}, {},
+                  allow_missing=True)
+
+
+def test_forward_is_train_controls_dropout():
+    """is_train toggles train-mode ops (Dropout): predict mode is
+    deterministic identity, train mode masks."""
+    data = sym.Variable("data")
+    d = sym.Dropout(data=data, p=0.5, name="drop")
+    m = mx.mod.Module(sym.MakeLoss(d, name="makeloss"),
+                      data_names=("data",), label_names=())
+    m.bind(data_shapes=[("data", (64, 16))], label_shapes=None,
+           for_training=True)
+    m.init_params()
+    x = mx.nd.ones((64, 16))
+    batch = mx.io.DataBatch(data=[x], label=[])
+    m.forward(batch, is_train=False)
+    np.testing.assert_allclose(m.get_outputs()[0].asnumpy(), 1.0)
+    m.forward(batch, is_train=True)
+    out = m.get_outputs()[0].asnumpy()
+    assert (out == 0).any() and (out > 1.0).any()  # inverted dropout
+
+
+def test_score_with_composite_metric():
+    rng = np.random.RandomState(3)
+    mx.random.seed(3)
+    X = rng.randn(60, 6).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32) + 1  # classes 1, 2 of 3
+    it = mx.io.NDArrayIter(X, y, batch_size=20,
+                           label_name="softmax_label")
+    m = mx.mod.Module(_small_mlp_sym(), data_names=("data",),
+                      label_names=("softmax_label",))
+    m.fit(it, optimizer="adam",
+          optimizer_params={"learning_rate": 5e-3}, num_epoch=6)
+    metric = mx.metric.CompositeEvalMetric(
+        [mx.metric.Accuracy(), mx.metric.CrossEntropy()])
+    m.score(it, metric)
+    names, vals = metric.get()
+    assert "accuracy" in names[0] and vals[0] > 0.6
+    assert np.isfinite(vals[1])
+
+
+def test_module_save_load_optimizer_states(tmp_path):
+    rng = np.random.RandomState(4)
+    it = mx.io.NDArrayIter(rng.randn(40, 6).astype(np.float32),
+                           np.zeros(40, np.float32), batch_size=20,
+                           label_name="softmax_label")
+    m = mx.mod.Module(_small_mlp_sym(), data_names=("data",),
+                      label_names=("softmax_label",))
+    m.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    m.init_params()
+    m.init_optimizer(optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1,
+                                       "momentum": 0.9})
+    it.reset()
+    for b in it:
+        m.forward(b, is_train=True)
+        m.backward()
+        m.update()
+    p = str(tmp_path / "opt.states")
+    m.save_optimizer_states(p)
+    m.load_optimizer_states(p)  # roundtrip loads into live updater
+
+
+def test_module_get_input_grads_shapes():
+    m = mx.mod.Module(_small_mlp_sym(), data_names=("data",),
+                      label_names=("softmax_label",))
+    m.bind(data_shapes=[("data", (4, 6))],
+           label_shapes=[("softmax_label", (4,))],
+           inputs_need_grad=True)
+    m.init_params()
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(np.random.RandomState(1).rand(4, 6)
+                          .astype(np.float32))],
+        label=[mx.nd.zeros((4,))])
+    m.forward(batch, is_train=True)
+    m.backward()
+    g = m.get_input_grads()[0]
+    assert g.shape == (4, 6)
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_bucketing_module_switch_and_params_shared():
+    """Switching buckets preserves shared parameters (reference
+    test_bucket_module semantics)."""
+    def gen(key):
+        data = sym.Variable("data")
+        emb = sym.Embedding(data=data, input_dim=20, output_dim=8,
+                            name="emb")
+        pooled = sym.mean(emb, axis=1)   # bucket-invariant params
+        out = sym.FullyConnected(data=pooled, num_hidden=3, name="fc")
+        return (sym.SoftmaxOutput(data=out, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    bm = mx.mod.BucketingModule(sym_gen=gen, default_bucket_key=8)
+    bm.bind(data_shapes=[("data", (2, 8))],
+            label_shapes=[("softmax_label", (2,))])
+    bm.init_params()
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1})
+    emb_before = bm.get_params()[0]["emb_weight"].asnumpy().copy()
+    b4 = mx.io.DataBatch(
+        data=[mx.nd.array(np.arange(8).reshape(2, 4)
+                          .astype(np.float32))],
+        label=[mx.nd.zeros((2,))], bucket_key=4,
+        provide_data=[mx.io.DataDesc("data", (2, 4))],
+        provide_label=[mx.io.DataDesc("softmax_label", (2,))])
+    bm.forward(b4, is_train=True)
+    bm.backward()
+    bm.update()
+    emb_after = bm.get_params()[0]["emb_weight"].asnumpy()
+    assert not np.allclose(emb_before, emb_after)  # shared emb trained
