@@ -325,3 +325,11 @@ def test_example_wide_deep():
     out = _run_example("sparse/wide_deep.py", "--epochs", "10",
                        timeout=560)
     assert _final_metric(out, "FINAL_ACCURACY") > 0.8
+
+
+def test_example_kaggle_ndsb2():
+    """MRI-sequence volume regression (reference example/kaggle-ndsb2):
+    CRPS must beat the predict-the-mean baseline (~0.22)."""
+    out = _run_example("kaggle-ndsb2/heart_volume_rnn.py",
+                       "--epochs", "10", timeout=560)
+    assert _final_metric(out, "FINAL_CRPS") < 0.18
