@@ -58,8 +58,14 @@ class Initializer(object):
             desc = InitDesc(str(desc))
         init_hint = desc.attrs.get("__init__", "")
         if init_hint:
-            create(json.loads(init_hint)[0] if init_hint.startswith("[")
-                   else init_hint)._init_weight(desc, arr)
+            if init_hint.startswith("["):
+                # dumps() format: ["name", {kwargs}] — the kwargs carry
+                # the configured state (e.g. Constant's value)
+                hint_name, hint_kwargs = json.loads(init_hint)
+                init = create(hint_name, **(hint_kwargs or {}))
+            else:
+                init = create(init_hint)
+            init._init_weight(desc, arr)
             return
         name = desc.lower()
         if name.endswith("weight"):

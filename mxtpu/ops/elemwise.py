@@ -99,12 +99,17 @@ def _ones_like(x):
 
 @register("shape_array", differentiable=False)
 def _shape_array(x):
-    return _jnp().array(x.shape, dtype=np.int64)
+    from .registry import index_dtype
+
+    return _jnp().array(x.shape, dtype=index_dtype())
 
 
 @register("size_array", differentiable=False)
 def _size_array(x):
-    return _jnp().array([int(np.prod(x.shape)) if x.shape else 1], dtype=np.int64)
+    from .registry import index_dtype
+
+    return _jnp().array([int(np.prod(x.shape)) if x.shape else 1],
+                        dtype=index_dtype())
 
 
 @register("BlockGrad", aliases=("stop_gradient",))

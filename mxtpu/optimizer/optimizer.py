@@ -71,6 +71,10 @@ class Optimizer(object):
         self.sym_info = ()
         if sym is not None:
             self.sym_info = (sym.attr_dict(), sym.list_arguments())
+        # reference Optimizer.__init__ seeds the multipliers from the
+        # symbol's __lr_mult__/__wd_mult__ attrs immediately
+        self.set_lr_mult({})
+        self.set_wd_mult({})
 
     # -- state ------------------------------------------------------------
     def create_state(self, index, weight):

@@ -131,6 +131,14 @@ def _topo_order(out_entries: Sequence[Tuple[SymbolNode, int]]) -> List[SymbolNod
     return order
 
 
+def _node_attrs(node) -> Dict[str, str]:
+    """Stringified user attrs of one node: op attrs then ext_attrs
+    (ext wins) — the single merge both list_attr and attr_dict use."""
+    d = {k: str(v) for k, v in node.attrs.items()}
+    d.update(node.ext_attrs)
+    return d
+
+
 class Symbol(object):
     """Immutable handle to one or more output entries of the graph."""
 
@@ -228,11 +236,20 @@ class Symbol(object):
         node = self._outputs[0][0]
         node.ext_attrs.update({k: str(v) for k, v in kwargs.items()})
 
+    def list_attr(self, recursive: bool = False) -> Dict[str, str]:
+        """Attributes of the HEAD node only (reference
+        `Symbol.list_attr`; `recursive=True` was deprecated there in
+        favor of `attr_dict`)."""
+        if recursive:
+            raise MXNetError(
+                "list_attr(recursive=True) is removed — use attr_dict()"
+                " (reference deprecation, symbol.py)")
+        return _node_attrs(self._outputs[0][0])
+
     def attr_dict(self) -> Dict[str, Dict[str, str]]:
         out = {}
         for node in self._topo():
-            d = {k: str(v) for k, v in node.attrs.items()}
-            d.update(node.ext_attrs)
+            d = _node_attrs(node)
             if d:
                 out[node.name] = d
         return out
@@ -551,12 +568,26 @@ def _unjson(v):
 
 def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,
              dtype=None, init=None, stype=None, **kwargs) -> Symbol:
-    """Create a variable symbol (reference `mx.sym.Variable`)."""
+    """Create a variable symbol (reference `mx.sym.Variable`): `attr`
+    entries and the lr_mult/wd_mult/init conveniences persist as node
+    attributes (reference spells them __lr_mult__ etc. in attr_dict)."""
     node = SymbolNode(None, name, {}, [])
     if shape is not None:
         node.ext_attrs["__shape__"] = str(tuple(shape))
     if dtype is not None:
         node.ext_attrs["__dtype__"] = np_dtype(dtype).name
+    if attr:
+        node.ext_attrs.update({k: str(v) for k, v in attr.items()})
+    if lr_mult is not None:
+        node.ext_attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        node.ext_attrs["__wd_mult__"] = str(wd_mult)
+    if init is not None:
+        # reference stores init.dumps() (a JSON hint the initializer
+        # consumer parses); plain strings pass through as names
+        node.ext_attrs["__init__"] = (init.dumps()
+                                      if hasattr(init, "dumps")
+                                      else str(init))
     return Symbol([(node, 0)])
 
 

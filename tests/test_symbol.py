@@ -220,3 +220,147 @@ def test_group2ctx_multi_device_raises():
     ex = out.bind(ctx=mx.cpu(0), args={"a": nd.array([1.0])},
                   group2ctx={"dev1": mx.cpu(0)})
     np.testing.assert_allclose(ex.forward()[0].asnumpy(), [2.0])
+
+
+# ---------------------------------------------------------------------------
+# round-5 deepening toward reference test_symbol.py (353 lines)
+# ---------------------------------------------------------------------------
+
+def test_attr_get_set_and_json_persistence(tmp_path):
+    """reference test_symbol_attr: attrs attach to nodes, survive
+    compose and the json roundtrip."""
+    data = sym.Variable("data", attr={"mood": "angry"})
+    fc = sym.FullyConnected(data=data, num_hidden=4, name="fc",
+                            attr={"lr_mult": "2.0"})
+    assert data.attr("mood") == "angry"
+    assert fc.attr("lr_mult") == "2.0"
+    d = fc.attr_dict()
+    assert d["fc"]["lr_mult"] == "2.0"
+    assert d["data"]["mood"] == "angry"
+    path = str(tmp_path / "s.json")
+    fc.save(path)
+    back = sym.load(path)
+    assert back.attr_dict()["fc"]["lr_mult"] == "2.0"
+
+
+def test_infer_type_propagation():
+    """infer_type flows dtypes through the graph (reference
+    test_symbol_infer_type)."""
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    out = sym.FullyConnected(data=data, weight=w, num_hidden=3,
+                             no_bias=True, name="fc")
+    args, outs, aux = out.infer_type(data=np.float32)
+    assert all(t == np.float32 for t in args)
+    assert outs[0] == np.float32
+
+
+def test_list_attr_shallow_vs_dict():
+    a = sym.Variable("a", attr={"k": "v"})
+    out = sym.relu(a, name="r")
+    # attr_dict covers the whole graph; list_attr only the head node
+    assert "a" in out.attr_dict()
+    assert "k" not in (out.list_attr() or {})
+
+
+def test_symbol_getitem_output_selection():
+    """sym[i] selects one output of a multi-output node (reference
+    test_symbol internals slicing)."""
+    data = sym.Variable("data")
+    split = sym.SliceChannel(data=data, num_outputs=3, axis=1,
+                             name="split")
+    assert len(split.list_outputs()) == 3
+    one = split[1]
+    assert len(one.list_outputs()) == 1
+    exe = one.simple_bind(ctx=mx.cpu(), grad_req="null", data=(2, 6))
+    x = np.arange(12, dtype=np.float32).reshape(2, 6)
+    out = exe.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(out, x[:, 2:4])
+
+
+def test_name_uniqueness_auto():
+    """Auto-naming never collides (reference NameManager)."""
+    d = sym.Variable("data")
+    a = sym.relu(d)
+    b = sym.relu(d)
+    names = {a.list_outputs()[0], b.list_outputs()[0]}
+    assert len(names) == 2
+
+
+def test_group_infer_and_outputs_order():
+    d = sym.Variable("data")
+    x = sym.relu(d, name="r1")
+    y = sym.tanh(d, name="t1")
+    g = sym.Group([x, y])
+    outs = g.list_outputs()
+    assert outs[0].startswith("r1") and outs[1].startswith("t1")
+    _, out_shapes, _ = g.infer_shape(data=(3, 4))
+    assert out_shapes == [(3, 4), (3, 4)]
+
+
+def test_symbol_pow_and_neg_compose():
+    d = sym.Variable("data")
+    expr = (-d) ** 2 + 2 / d
+    exe = expr.simple_bind(ctx=mx.cpu(), grad_req="null", data=(2, 2))
+    x = np.array([[1.0, 2.0], [4.0, 0.5]], np.float32)
+    out = exe.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(out, x ** 2 + 2 / x, rtol=1e-5)
+
+
+def test_get_internals_feature_extraction():
+    """internals + __getitem__ give intermediate outputs bindable as
+    heads (the reference's feature-extraction workflow)."""
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data=data, num_hidden=5, name="fc1")
+    h = sym.Activation(data=h, act_type="relu", name="act1")
+    out = sym.FullyConnected(data=h, num_hidden=2, name="fc2")
+    internals = out.get_internals()
+    feat = internals["act1_output"]
+    exe = feat.simple_bind(ctx=mx.cpu(), grad_req="null", data=(3, 4))
+    y = exe.forward(is_train=False,
+                    data=nd.array(np.ones((3, 4), np.float32)))[0]
+    assert y.shape == (3, 5)
+    assert (y.asnumpy() >= 0).all()
+
+
+def test_variable_init_hint_flows_to_module():
+    """Variable(init=Initializer) must store a dumps() hint the module
+    init path can actually parse (review regression: str(init) crashed
+    create())."""
+    data = sym.Variable("data")
+    w = sym.Variable("cw", init=mx.init.Constant(3.0), shape=(4, 6))
+    out = sym.FullyConnected(data=data, weight=w, num_hidden=4,
+                             no_bias=True, name="cfc")
+    mod = mx.mod.Module(sym.MakeLoss(out.sum(), name="ml"),
+                        data_names=("data",), label_names=())
+    mod.bind(data_shapes=[("data", (2, 6))], label_shapes=None)
+    mod.init_params(initializer=mx.init.Zero())
+    w_val = mod.get_params()[0]["cw"].asnumpy()
+    np.testing.assert_allclose(w_val, 3.0)  # hint overrode Zero
+
+
+def test_variable_lr_mult_scales_module_updates():
+    """Variable(lr_mult=...) -> __lr_mult__ attr -> optimizer scaling,
+    end to end through Module (the consumer chain in
+    optimizer.set_lr_mult)."""
+    data = sym.Variable("data")
+    w_fast = sym.Variable("w_fast", lr_mult=2.0)
+    w_slow = sym.Variable("w_slow", lr_mult=0.0)
+    out = sym.FullyConnected(data=data, weight=w_fast, num_hidden=3,
+                             no_bias=True, name="f1")
+    out = sym.FullyConnected(data=out, weight=w_slow, num_hidden=2,
+                             no_bias=True, name="f2")
+    loss = sym.MakeLoss(out.sum(), name="ml2")
+    mod = mx.mod.Module(loss, data_names=("data",), label_names=())
+    mod.bind(data_shapes=[("data", (2, 5))], label_shapes=None)
+    mod.init_params(initializer=mx.init.One())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    slow0 = mod.get_params()[0]["w_slow"].asnumpy().copy()
+    batch = mx.io.DataBatch(data=[nd.ones((2, 5))], label=[])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    args, _ = mod.get_params()
+    np.testing.assert_allclose(args["w_slow"].asnumpy(), slow0)
+    assert np.abs(args["w_fast"].asnumpy() - 1.0).sum() > 0
