@@ -492,3 +492,37 @@ def kv_num_workers(kv) -> int:
 
 def kv_barrier(kv) -> None:
     kv.barrier()
+
+
+# -- Profiler (reference MXSetProfilerConfig/State, MXDumpProfile,
+#    MXAggregateProfileStatsPrint) --------------------------------------
+
+def profiler_set_config(keys, vals) -> None:
+    """Boolean/numeric flags parse from the string wire format;
+    path-valued keys stay raw strings (a numeric 'filename' must not
+    become an int fd)."""
+    from mxtpu import profiler
+
+    _STR_KEYS = {"filename", "profile_process",
+                 "aggregate_stats_filename"}
+    profiler.set_config(**{k: (v if k in _STR_KEYS
+                               else _parse_c_attr(v))
+                           for k, v in zip(keys, vals)})
+
+
+def profiler_set_state(state: int) -> None:
+    from mxtpu import profiler
+
+    profiler.set_state("run" if state else "stop")
+
+
+def profiler_dump(finished: int) -> None:
+    from mxtpu import profiler
+
+    profiler.dump(bool(finished))
+
+
+def profiler_aggregate_stats(reset: int) -> str:
+    from mxtpu import profiler
+
+    return profiler.dumps(reset=bool(reset))

@@ -778,6 +778,66 @@ int MXKVStorePull(void* handle, uint32_t num, const int* keys, void** vals,
   return kv_call("kv_pull", handle, num, keys, vals, priority, true);
 }
 
+/* ---- Profiler (reference c_api_profile.cc) ---------------------------- */
+
+int MXSetProfilerConfig(int num_params, const char* const* keys,
+                        const char* const* vals) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* ks = str_list(const_cast<const char**>(keys), num_params);
+  PyObject* vs = str_list(const_cast<const char**>(vals), num_params);
+  PyObject* args = Py_BuildValue("(OO)", ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyObject* res = embed_call("profiler_set_config", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSetProfilerState(int state) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(i)", state);
+  PyObject* res = embed_call("profiler_set_state", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDumpProfile(int finished) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(i)", finished);
+  PyObject* res = embed_call("profiler_dump", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+/* string valid until the next call (same contract as SaveToJSON) */
+static std::string g_profile_stats;
+
+int MXAggregateProfileStatsPrint(const char** out_str, int reset) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(i)", reset);
+  PyObject* res = embed_call("profiler_aggregate_stats", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  const char* c = PyUnicode_AsUTF8(res);
+  {
+    std::lock_guard<std::mutex> lk(g_buf_mu);
+    g_profile_stats = c ? c : "";
+    *out_str = g_profile_stats.c_str();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
 /* ---- CachedOp (reference c_api_ndarray.cc) ---------------------------- */
 
 int MXCreateCachedOp(void* sym_handle, void** out) {

@@ -75,6 +75,11 @@ extern int MXFreeCachedOp(void*);
 extern int MXKVStoreGetRank(void*, int*);
 extern int MXKVStoreGetGroupSize(void*, int*);
 extern int MXKVStoreBarrier(void*);
+extern int MXSetProfilerConfig(int, const char* const*,
+                               const char* const*);
+extern int MXSetProfilerState(int);
+extern int MXDumpProfile(int);
+extern int MXAggregateProfileStatsPrint(const char**, int);
 
 #define CHECK(cond)                                                   \
   do {                                                                \
@@ -345,6 +350,25 @@ int main(int argc, char** argv) {
     MXNDArrayFree(xd); MXNDArrayFree(wd); MXNDArrayFree(bd);
     CHECK(MXSymbolFree(symh) == 0);
     printf("group:symexec ok\n");
+  }
+
+  /* -- profiler: run ops under the profiler, read the stats table -- */
+  {
+    const char* pk[1] = {"filename"};
+    const char* pv[1] = {"/tmp/c_api_profile.json"};
+    CHECK(MXSetProfilerConfig(1, pk, pv) == 0);
+    CHECK(MXSetProfilerState(1) == 0);
+    void* prof_ins[2] = {a, a};
+    CHECK(MXImperativeInvoke(plus, 2, prof_ins, &n_out, &outs, 0, NULL,
+                             NULL) == 0);
+    CHECK(MXNDArrayFree(outs[0]) == 0);
+    CHECK(MXSetProfilerState(0) == 0);
+    const char* stats = NULL;
+    CHECK(MXAggregateProfileStatsPrint(&stats, 0) == 0);
+    CHECK(stats != NULL && strlen(stats) > 0);
+    CHECK(strstr(stats, "elemwise_add") != NULL);
+    CHECK(MXDumpProfile(1) == 0);
+    printf("group:profiler ok\n");
   }
 
   CHECK(MXNDArrayWaitAll() == 0);

@@ -57,6 +57,7 @@ def test_c_api_all_groups(tmp_path):
         capture_output=True, text=True, timeout=300, env=env)
     assert res.returncode == 0, res.stdout + res.stderr
     for group in ("runtime", "oplist", "ndarray", "invoke", "saveload",
-                  "kvstore", "dataiter", "autograd", "symexec"):
+                  "kvstore", "dataiter", "autograd", "symexec",
+                  "profiler"):
         assert ("group:%s ok" % group) in res.stdout, res.stdout
     assert "ALL-GROUPS-OK" in res.stdout, res.stdout
