@@ -503,9 +503,9 @@ def profiler_set_config(keys, vals) -> None:
     become an int fd)."""
     from mxtpu import profiler
 
-    _STR_KEYS = {"filename", "profile_process",
-                 "aggregate_stats_filename"}
-    profiler.set_config(**{k: (v if k in _STR_KEYS
+    # only "filename" is both consumed AND type-sensitive (a numeric
+    # path must stay a string, not become an os fd)
+    profiler.set_config(**{k: (v if k == "filename"
                                else _parse_c_attr(v))
                            for k, v in zip(keys, vals)})
 

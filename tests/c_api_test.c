@@ -352,10 +352,11 @@ int main(int argc, char** argv) {
     printf("group:symexec ok\n");
   }
 
-  /* -- profiler: run ops under the profiler, read the stats table -- */
+  /* -- profiler: run ops under the profiler, read the stats table --
+   * argv[4] (optional) = tmp-scoped dump path */
   {
     const char* pk[1] = {"filename"};
-    const char* pv[1] = {"/tmp/c_api_profile.json"};
+    const char* pv[1] = {argc > 4 ? argv[4] : "/tmp/c_api_profile.json"};
     CHECK(MXSetProfilerConfig(1, pk, pv) == 0);
     CHECK(MXSetProfilerState(1) == 0);
     void* prof_ins[2] = {a, a};

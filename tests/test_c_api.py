@@ -51,9 +51,10 @@ def test_c_api_all_groups(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    profile_json = tmp_path / "profile.json"
     res = subprocess.run(
         [exe_path, str(csv), str(tmp_path / "weights.params"),
-         str(sym_json)],
+         str(sym_json), str(profile_json)],
         capture_output=True, text=True, timeout=300, env=env)
     assert res.returncode == 0, res.stdout + res.stderr
     for group in ("runtime", "oplist", "ndarray", "invoke", "saveload",
@@ -61,3 +62,4 @@ def test_c_api_all_groups(tmp_path):
                   "profiler"):
         assert ("group:%s ok" % group) in res.stdout, res.stdout
     assert "ALL-GROUPS-OK" in res.stdout, res.stdout
+    assert profile_json.exists()  # chrome trace landed at the argv path

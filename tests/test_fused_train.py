@@ -17,7 +17,12 @@ from mxtpu.io.io import DataBatch
 def _make_module(seed, optimizer="sgd", opt_params=None, batch=8):
     data = sym.Variable("data")
     label = sym.Variable("softmax_label")
-    x = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    # no_bias before BatchNorm: a bias feeding BN has ~zero true
+    # gradient, and with the reference's wd_mult=0-for-biases now
+    # seeded, its adam trajectory is pure fp-noise amplification —
+    # a degenerate parameter no real network carries
+    x = sym.FullyConnected(data=data, num_hidden=16, no_bias=True,
+                           name="fc1")
     x = sym.BatchNorm(data=x, name="bn1")
     x = sym.Activation(data=x, act_type="relu")
     x = sym.FullyConnected(data=x, num_hidden=4, name="fc2")

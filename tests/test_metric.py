@@ -1,0 +1,221 @@
+"""EvalMetric registry + every metric against numpy golds — the analog
+of the reference's `tests/python/unittest/test_metric.py` (the repo's
+metrics were previously exercised only through Module.score)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+
+M = mx.metric
+
+
+def test_registry_create_by_name_and_alias():
+    assert isinstance(M.create("acc"), M.Accuracy)
+    assert isinstance(M.create("accuracy"), M.Accuracy)
+    assert isinstance(M.create("top_k_accuracy", top_k=3),
+                      M.TopKAccuracy)
+    comp = M.create(["acc", "mse"])
+    assert isinstance(comp, M.CompositeEvalMetric)
+    with pytest.raises(Exception):
+        M.create("not_a_metric")
+
+
+def test_accuracy_exact_and_reset():
+    m = M.Accuracy()
+    pred = nd.array(np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]],
+                             np.float32))
+    label = nd.array(np.array([0, 1, 1], np.float32))
+    m.update([label], [pred])
+    assert m.get() == ("accuracy", 2.0 / 3.0)
+    m.update([label], [pred])           # accumulates
+    assert m.get()[1] == 2.0 / 3.0
+    m.reset()
+    name, val = m.get()
+    assert np.isnan(val)
+
+
+def test_topk_accuracy():
+    rng = np.random.RandomState(0)
+    pred = rng.rand(20, 6).astype(np.float32)
+    label = rng.randint(0, 6, 20).astype(np.float32)
+    m = M.TopKAccuracy(top_k=3)
+    m.update([nd.array(label)], [nd.array(pred)])
+    want = np.mean([l in np.argsort(-p)[:3]
+                    for p, l in zip(pred, label)])
+    np.testing.assert_allclose(m.get()[1], want, rtol=1e-6)
+
+
+def test_f1_and_mcc_binary_golds():
+    # hand-built confusion: TP=2 FP=1 TN=3 FN=1
+    pred = nd.array(np.array(
+        [[0.2, 0.8], [0.3, 0.7], [0.4, 0.6],      # predicted 1: TP TP FP
+         [0.8, 0.2], [0.7, 0.3], [0.9, 0.1],      # predicted 0: TN TN TN
+         [0.6, 0.4]], np.float32))                 # predicted 0: FN
+    label = nd.array(np.array([1, 1, 0, 0, 0, 0, 1], np.float32))
+    f1 = M.F1()
+    f1.update([label], [pred])
+    prec, rec = 2 / 3.0, 2 / 3.0
+    want_f1 = 2 * prec * rec / (prec + rec)
+    np.testing.assert_allclose(f1.get()[1], want_f1, rtol=1e-6)
+
+    mcc = M.MCC()
+    mcc.update([label], [pred])
+    tp, fp, tn, fn = 2.0, 1.0, 3.0, 1.0
+    want_mcc = (tp * tn - fp * fn) / np.sqrt(
+        (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    np.testing.assert_allclose(mcc.get()[1], want_mcc, rtol=1e-6)
+
+
+def test_perplexity_with_ignore_label():
+    probs = np.array([[0.5, 0.5], [0.9, 0.1], [0.25, 0.75]],
+                     np.float32)
+    label = np.array([0, 0, 1], np.float32)
+    m = M.Perplexity(ignore_label=None)
+    m.update([nd.array(label)], [nd.array(probs)])
+    want = np.exp(-np.mean(np.log([0.5, 0.9, 0.75])))
+    np.testing.assert_allclose(m.get()[1], want, rtol=1e-5)
+    # ignore_label drops those positions
+    m2 = M.Perplexity(ignore_label=0)
+    m2.update([nd.array(label)], [nd.array(probs)])
+    want2 = np.exp(-np.log(0.75))
+    np.testing.assert_allclose(m2.get()[1], want2, rtol=1e-5)
+
+
+def test_regression_metrics_golds():
+    pred = nd.array(np.array([[1.0], [2.0], [3.0]], np.float32))
+    label = nd.array(np.array([[1.5], [2.0], [1.0]], np.float32))
+    err = np.array([0.5, 0.0, 2.0])
+    cases = [(M.MAE(), np.mean(err)),
+             (M.MSE(), np.mean(err ** 2)),
+             (M.RMSE(), np.sqrt(np.mean(err ** 2)))]
+    for m, want in cases:
+        m.update([label], [pred])
+        np.testing.assert_allclose(m.get()[1], want, rtol=1e-6,
+                                   err_msg=m.name)
+
+
+def test_cross_entropy_and_nll():
+    probs = np.array([[0.7, 0.3], [0.2, 0.8]], np.float32)
+    label = np.array([0, 1], np.float32)
+    ce = M.CrossEntropy()
+    ce.update([nd.array(label)], [nd.array(probs)])
+    want = -np.mean(np.log([0.7, 0.8]))
+    np.testing.assert_allclose(ce.get()[1], want, rtol=1e-5)
+    nll = M.NegativeLogLikelihood()
+    nll.update([nd.array(label)], [nd.array(probs)])
+    np.testing.assert_allclose(nll.get()[1], want, rtol=1e-5)
+
+
+def test_composite_and_get_name_value():
+    comp = M.CompositeEvalMetric([M.Accuracy(), M.MAE()])
+    pred = nd.array(np.array([[0.9, 0.1], [0.1, 0.9]], np.float32))
+    label = nd.array(np.array([0, 1], np.float32))
+    comp.update([label], [pred])
+    d = dict(comp.get_name_value())
+    assert d["accuracy"] == 1.0
+    assert "mae" in d
+
+
+def test_update_dict_by_output_name():
+    """update_dict routes by output name (Module.score path for
+    multi-output nets)."""
+    m = M.Accuracy(output_names=["softmax_output"],
+                   label_names=["softmax_label"])
+    pred = nd.array(np.array([[0.9, 0.1]], np.float32))
+    label = nd.array(np.array([0], np.float32))
+    m.update_dict({"softmax_label": label},
+                  {"softmax_output": pred})
+    assert m.get()[1] == 1.0
+
+
+def test_pearson_correlation_gold():
+    rng = np.random.RandomState(2)
+    pred = rng.randn(30).astype(np.float32)
+    label = (0.8 * pred + 0.3 * rng.randn(30)).astype(np.float32)
+    m = M.PearsonCorrelation()
+    m.update([nd.array(label.reshape(-1, 1))],
+             [nd.array(pred.reshape(-1, 1))])
+    want = np.corrcoef(pred, label)[0, 1]
+    np.testing.assert_allclose(m.get()[1], want, rtol=1e-4)
+
+
+def test_custom_metric_wraps_function():
+    def my_err(label, pred):
+        return float(np.abs(label - pred).max())
+
+    m = M.CustomMetric(my_err, name="maxerr")
+    m.update([nd.array(np.array([1.0, 2.0], np.float32))],
+             [nd.array(np.array([1.5, 1.0], np.float32))])
+    assert m.get()[1] == 1.0
+
+
+def test_loss_metric_averages():
+    m = M.Loss()
+    m.update([], [nd.array(np.array([2.0, 4.0], np.float32))])
+    assert m.get()[1] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# lr schedulers (reference test_optimizer.py scheduler cases — the
+# schedules were previously only exercised inside the fused loop)
+# ---------------------------------------------------------------------------
+
+def test_factor_scheduler_steps():
+    from mxtpu.lr_scheduler import FactorScheduler
+
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0,
+                        stop_factor_lr=0.2)
+    # reference decays strictly AFTER each step boundary
+    # (lr_scheduler.py: while num_update > count + step)
+    assert s(5) == 1.0
+    assert s(10) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+    assert s(41) == 0.2    # clamped at stop_factor_lr
+
+
+def test_multifactor_scheduler():
+    from mxtpu.lr_scheduler import MultiFactorScheduler
+
+    s = MultiFactorScheduler(step=[5, 15], factor=0.1, base_lr=2.0)
+    assert s(4) == 2.0
+    np.testing.assert_allclose(s(6), 0.2)
+    np.testing.assert_allclose(s(20), 0.02)
+
+
+def test_poly_and_cosine_endpoints():
+    from mxtpu.lr_scheduler import CosineScheduler, PolyScheduler
+
+    p = PolyScheduler(max_update=100, base_lr=1.0, final_lr=0.0,
+                      pwr=2)
+    assert p(0) == 1.0
+    np.testing.assert_allclose(p(50), 0.25, rtol=1e-6)
+    np.testing.assert_allclose(p(100), 0.0, atol=1e-7)
+    np.testing.assert_allclose(p(200), 0.0, atol=1e-7)  # past end
+
+    c = CosineScheduler(max_update=10, base_lr=1.0, final_lr=0.1)
+    assert c(0) == 1.0
+    np.testing.assert_allclose(c(10), 0.1, rtol=1e-6)
+    mid = c(5)
+    assert 0.1 < mid < 1.0
+
+
+def test_scheduler_drives_optimizer_updates():
+    """The schedule keys off the per-index update COUNT (reference
+    semantics), not wall steps."""
+    from mxtpu.lr_scheduler import FactorScheduler
+
+    opt = mx.optimizer.SGD(learning_rate=1.0,
+                           lr_scheduler=FactorScheduler(step=2,
+                                                        factor=0.5))
+    w = nd.ones((2,))
+    g = nd.ones((2,))
+    st = opt.create_state(0, w)
+    lrs = []
+    for _ in range(5):
+        before = w.asnumpy().copy()
+        opt.update(0, w, g, st)
+        lrs.append(float((before - w.asnumpy())[0]))  # lr * grad(=1)
+    np.testing.assert_allclose(lrs, [1.0, 1.0, 0.5, 0.5, 0.25],
+                               rtol=1e-6)

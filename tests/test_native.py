@@ -334,27 +334,34 @@ def test_prefetching_iter_overlaps_on_threaded_engine():
     prev = get_engine()
     set_engine(ThreadedEngine(num_threads=2))
     try:
-        n, delay = 10, 0.03
-        src = _SlowIter(n, delay)
-        it = PrefetchingIter(src, prefetch_depth=3)
-        count = 0
-        max_ahead = 0
-        while True:
-            try:
-                it.next()
-            except StopIteration:
+        # up to 3 attempts: the ordering-based check cannot produce a
+        # FALSE positive, but a fully loaded machine can starve the
+        # producer thread an entire epoch (observed under a parallel
+        # full-suite run) — retrying distinguishes starvation from a
+        # genuinely serial implementation
+        for attempt in range(3):
+            n, delay = 10, 0.03
+            src = _SlowIter(n, delay)
+            it = PrefetchingIter(src, prefetch_depth=3)
+            count = 0
+            max_ahead = 0
+            while True:
+                try:
+                    it.next()
+                except StopIteration:
+                    break
+                count += 1
+                time.sleep(delay)  # consumer work
+                # snapshot AFTER consumer work: a serial implementation
+                # produces strictly on demand (produced == consumed at
+                # every snapshot); the producer running AHEAD of demand
+                # proves overlap
+                max_ahead = max(max_ahead, src.produced - count)
+            assert count == n
+            if max_ahead >= 1:
                 break
-            count += 1
-            time.sleep(delay)  # consumer work
-            # snapshot AFTER consumer work: a serial implementation
-            # produces strictly on demand, so produced == consumed at
-            # every snapshot; the producer running AHEAD of demand is
-            # ordering-based proof of overlap that, unlike a wall-clock
-            # ratio, cannot flake under machine load
-            max_ahead = max(max_ahead, src.produced - count)
-        assert count == n
         assert max_ahead >= 1, \
-            "no overlap: producer never ran ahead of the consumer"
+            "no overlap: producer never ran ahead in 3 attempts"
     finally:
         set_engine(prev)
 
