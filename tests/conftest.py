@@ -41,6 +41,9 @@ def _seed_everything(request):
     if seed == 0:
         seed = abs(hash(request.node.nodeid)) % (2 ** 31 - 1)
     np.random.seed(seed)
+    import random as _pyrandom
+
+    _pyrandom.seed(seed)   # stdlib random: image augmenters draw here
     import mxtpu
 
     mxtpu.random.seed(seed)

@@ -123,3 +123,82 @@ def test_imresize_float_no_uint8_clip():
     arr2 = np.full((8, 8, 3), 300.0, dtype=np.float32)
     out2 = img.imresize(arr2, 4, 4, interp=1).asnumpy()
     np.testing.assert_allclose(out2, 300.0, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# round-5 exact-value deepening (reference test_image.py golds)
+# ---------------------------------------------------------------------------
+
+def test_resize_short_aspect_preserved():
+    """resize_short scales the SHORT side to the target, preserving
+    aspect (reference image.py resize_short semantics)."""
+    from mxtpu import image as img
+
+    x = mx.nd.array(np.random.RandomState(0)
+                    .randint(0, 255, (40, 80, 3)).astype(np.uint8))
+    out = img.resize_short(x, 20)
+    assert out.shape == (20, 40, 3)   # 40x80 -> short 40 scaled to 20
+    x2 = mx.nd.array(np.random.RandomState(1)
+                     .randint(0, 255, (90, 30, 3)).astype(np.uint8))
+    out2 = img.resize_short(x2, 15)
+    assert out2.shape == (45, 15, 3)
+
+
+def test_center_crop_exact_window():
+    from mxtpu import image as img
+
+    base = np.arange(20 * 30 * 3).reshape(20, 30, 3).astype(np.uint8)
+    x = mx.nd.array(base)
+    out, (x0, y0, w, h) = img.center_crop(x, (10, 8))
+    assert (w, h) == (10, 8)
+    assert (x0, y0) == ((30 - 10) // 2, (20 - 8) // 2)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  base[y0:y0 + 8, x0:x0 + 10])
+
+
+def test_fixed_crop_exact():
+    from mxtpu import image as img
+
+    base = np.arange(16 * 16 * 3).reshape(16, 16, 3).astype(np.uint8)
+    out = img.fixed_crop(mx.nd.array(base), 2, 3, 5, 7)
+    np.testing.assert_array_equal(out.asnumpy(), base[3:10, 2:7])
+
+
+def test_color_normalize_gold():
+    from mxtpu import image as img
+
+    x = mx.nd.array(np.full((4, 4, 3), 100.0, np.float32))
+    mean = mx.nd.array(np.array([10.0, 20.0, 30.0], np.float32))
+    std = mx.nd.array(np.array([2.0, 4.0, 5.0], np.float32))
+    out = img.color_normalize(x, mean, std).asnumpy()
+    np.testing.assert_allclose(out[0, 0], [(100 - 10) / 2.0,
+                                           (100 - 20) / 4.0,
+                                           (100 - 30) / 5.0], rtol=1e-6)
+
+
+def test_random_crop_bounds_and_determinism():
+    from mxtpu import image as img
+
+    import random as pyrandom
+
+    base = np.random.RandomState(3).randint(
+        0, 255, (32, 32, 3)).astype(np.uint8)
+    pyrandom.seed(7)   # random_crop draws from python's random module
+    out1, rect1 = img.random_crop(mx.nd.array(base), (12, 10))
+    assert out1.shape == (10, 12, 3)
+    x0, y0, w, h = rect1
+    assert 0 <= x0 <= 32 - 12 and 0 <= y0 <= 32 - 10
+    np.testing.assert_array_equal(out1.asnumpy(),
+                                  base[y0:y0 + h, x0:x0 + w])
+    pyrandom.seed(7)
+    out2, rect2 = img.random_crop(mx.nd.array(base), (12, 10))
+    assert rect1 == rect2  # seeded determinism
+
+
+def test_horizontal_flip_aug_exact():
+    from mxtpu import image as img
+
+    base = np.arange(4 * 6 * 3).reshape(4, 6, 3).astype(np.float32)
+    aug = img.HorizontalFlipAug(p=1.0)
+    out = aug(mx.nd.array(base))
+    np.testing.assert_array_equal(out.asnumpy(), base[:, ::-1])
