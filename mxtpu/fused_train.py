@@ -219,25 +219,43 @@ class FusedTrainLoop(object):
             stacks.append(jnp.stack(parts))
         return stacks
 
+    def _program_args(self, data_stack, base_key):
+        """The full positional argument tuple `_jit_program` takes —
+        single source of truth shared by run_stacked (execute) and
+        lower_stacked (AOT analysis) so the two can't drift."""
+        import jax.numpy as jnp
+
+        lr_rows = self._scan_step.host_sched(self._K)
+        fixed_vals = [self._exec.arg_arrays[i]._data
+                      for i in self._fixed_idx]
+        return (self._p_vals, self._s_tree, self._aux_vals, fixed_vals,
+                base_key, jnp.int32(self._t), data_stack,
+                jnp.asarray(lr_rows))
+
+    def lower_stacked(self, data_stack: List[Any]):
+        """AOT-lower the fused K-step program for a staged stack
+        (`jax.jit(...).lower`) without executing it.  `.compile()` the
+        result for optimized-HLO text / cost / memory analysis — this
+        is what `tools/hlo_report.py` uses for static attribution."""
+        import jax
+
+        return self._jit_program.lower(
+            *self._program_args(data_stack, jax.random.PRNGKey(0)))
+
     # -- execution --------------------------------------------------------
     def run_stacked(self, data_stack: List[Any]):
         """Run K fused steps over pre-staged (K, ...) slot arrays.
         Returns stacked outputs (list of (K, ...) NDArrays) when
         collect_outputs, else None."""
         import jax
-        import jax.numpy as jnp
 
         from . import random as _rnd
 
         K = self._K
-        lr_rows = self._scan_step.host_sched(K)
         base_key = _rnd._next_key() if self._exec._has_rng \
             else jax.random.PRNGKey(0)
-        fixed_vals = [self._exec.arg_arrays[i]._data
-                      for i in self._fixed_idx]
         p, s, aux, outs = self._jit_program(
-            self._p_vals, self._s_tree, self._aux_vals, fixed_vals,
-            base_key, jnp.int32(self._t), data_stack, jnp.asarray(lr_rows))
+            *self._program_args(data_stack, base_key))
         self._p_vals, self._s_tree, self._aux_vals = p, s, aux
         self._t += K
         self._optimizer.commit_scan_steps(self._opt_indices, K)

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Static attribution of the fused train program's compiled HLO.
+
+Complements tools/profile_train.py (wall-clock phase attribution): this
+dumps what XLA actually compiled for the SAME ResNet-50 fused train
+program bench.py times — convolution count/dtypes/shapes, explicit
+transpose/copy ops that survived fusion, fusion kind histogram, XLA's
+own FLOP estimate (cost_analysis) vs the 12.3 GFLOP/img analytic
+number, and the peak memory analysis. Use it to decide whether an MFU
+gap is layout traffic (transposes/copies), dtype promotion (f32 convs
+under an amp scope), or genuine conv inefficiency (small spatial dims /
+channel counts vs the 128x128 MXU).
+
+Usage:  python tools/hlo_report.py --batch 128 --dtype bfloat16 --spp 2
+        JAX_PLATFORMS=cpu python tools/hlo_report.py --batch 8 --image 64
+"""
+import argparse
+import collections
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+TRAIN_GFLOP_PER_IMG_224 = 12.3
+
+
+def build(batch, image, dtype, spp):
+    import mxtpu as mx
+    from mxtpu import sym
+    from mxtpu.fused_train import FusedTrainLoop
+    from mxtpu.gluon.model_zoo import vision
+    from mxtpu.io.io import DataBatch
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    with mx.amp.scope(dtype if dtype != "float32" else None):
+        net = vision.resnet50_v1(classes=1000)
+        net.initialize(ctx=ctx)
+        x_trace = mx.nd.zeros((batch, 3, image, image), ctx=ctx)
+        out_sym, _, _ = net._trace_symbol(x_trace)
+        softmax = sym.SoftmaxOutput(data=out_sym,
+                                    label=sym.Variable("softmax_label"),
+                                    name="softmax")
+        mod = mx.mod.Module(softmax, data_names=("data0",),
+                            label_names=("softmax_label",))
+        mod.bind(data_shapes=[("data0", (batch, 3, image, image))],
+                 label_shapes=[("softmax_label", (batch,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.01,
+                                             "momentum": 0.9})
+    loop = FusedTrainLoop(mod, steps_per_program=spp)
+    rng = np.random.RandomState(0)
+    batches = [DataBatch(
+        data=[mx.nd.array(rng.rand(batch, 3, image, image)
+                          .astype(np.float32), ctx=ctx)],
+        label=[mx.nd.array(rng.randint(0, 1000, batch)
+                           .astype(np.float32), ctx=ctx)])
+        for _ in range(spp)]
+    stacked = loop.stack_batches(batches)
+    return loop, stacked
+
+
+def analyze_text(hlo):
+    """Histogram the optimized HLO: op kinds, conv dtypes/shapes,
+    surviving transposes/copies (layout traffic XLA could not fuse).
+
+    Ops inside `%fused_*` computation bodies are excluded — a transpose
+    folded into a fusion costs no extra HBM round-trip; only top-level
+    (entry / while-body / conditional) instructions are materialized."""
+    ops = collections.Counter()
+    convs = []
+    transposes = []
+    copies = 0
+    in_fusion_body = False
+    for line in hlo.splitlines():
+        s = line.strip()
+        if s.endswith("{") and "(" in s:  # computation header
+            name = s.lstrip("%").split()[0]
+            in_fusion_body = name.startswith(("fused_", "%fused_")) \
+                or ".fused" in name
+            continue
+        if s == "}":
+            in_fusion_body = False
+            continue
+        if in_fusion_body:
+            continue
+        m = re.match(r"\S+\s+=\s+(\w+)\[([\d,]*)\]\S*\s+(\S+?)\(", s)
+        if not m:
+            continue
+        dtype, shape, op = m.group(1), m.group(2), m.group(3)
+        ops[op] += 1
+        if op == "convolution":
+            convs.append((dtype, shape,
+                          ("window=" + re.search(r"window={([^}]*)}", s)
+                           .group(1)) if "window={" in s else ""))
+        elif op == "transpose":
+            transposes.append((dtype, shape))
+        elif op == "copy":
+            copies += 1
+    return ops, convs, transposes, copies
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--spp", type=int, default=2)
+    ap.add_argument("--dump", default="",
+                    help="also write full optimized HLO text here")
+    args = ap.parse_args()
+
+    loop, stacked = build(args.batch, args.image, args.dtype, args.spp)
+    compiled = loop.lower_stacked(stacked).compile()
+    hlo = compiled.as_text()
+    if args.dump:
+        with open(args.dump, "w") as f:
+            f.write(hlo)
+
+    ops, convs, transposes, copies = analyze_text(hlo)
+    cost = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        cost = {k: ca[k] for k in ("flops", "bytes accessed",
+                                   "transcendentals")
+                if k in ca}
+    except Exception as e:
+        cost = {"error": str(e)[:200]}
+    mem = {}
+    try:
+        ma = compiled.memory_analysis()
+        mem = {
+            "argument_mb": round(ma.argument_size_in_bytes / 2**20, 1),
+            "output_mb": round(ma.output_size_in_bytes / 2**20, 1),
+            "temp_mb": round(ma.temp_size_in_bytes / 2**20, 1),
+            # the fused program donates (params, opt-state, aux), so the
+            # outputs alias those argument buffers — peak is args+temps,
+            # NOT args+outputs+temps (outputs would double-count)
+            "peak_mb_args_plus_temp": round(
+                (ma.argument_size_in_bytes + ma.temp_size_in_bytes)
+                / 2**20, 1),
+        }
+    except Exception as e:
+        mem = {"error": str(e)[:200]}
+
+    images = args.batch * args.spp
+    analytic_gflop = images * TRAIN_GFLOP_PER_IMG_224 \
+        * (args.image / 224.0) ** 2
+    conv_dtypes = collections.Counter(d for d, _, _ in convs)
+    t_bytes = 0
+    dt_size = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+               "pred": 1, "s8": 1, "u8": 1}
+    for d, shape in transposes:
+        n = 1
+        for s in shape.split(","):
+            if s:
+                n *= int(s)
+        t_bytes += n * dt_size.get(d, 4)
+
+    report = {
+        "config": {"batch": args.batch, "image": args.image,
+                   "dtype": args.dtype, "spp": args.spp},
+        "op_histogram_top": dict(ops.most_common(15)),
+        "n_convolutions": len(convs),
+        "conv_dtypes": dict(conv_dtypes),
+        "n_transposes_surviving": len(transposes),
+        "transpose_traffic_mb": round(t_bytes / 2**20, 1),
+        "n_copies_surviving": copies,
+        "xla_cost_analysis": cost,
+        "analytic_gflop_per_program": round(analytic_gflop, 1),
+        "memory": mem,
+    }
+    if "flops" in cost:
+        report["xla_vs_analytic_flops"] = round(
+            float(cost["flops"]) / (analytic_gflop * 1e9), 3)
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()
