@@ -61,6 +61,8 @@ class CachedOp(object):
 
         self._jit_infer = jax.jit(fwd_infer)
         self._jit_train = jax.jit(fwd_train)
+        self._infer_fn = infer_fn
+        self._fused_jits: Dict[Tuple[int, ...], Any] = {}
         self._has_rng = any((not n.is_variable) and n.op.needs_rng
                             for n in sym._topo())
         # graphs without RNG ops get one fixed key (avoids a host-side
@@ -123,3 +125,70 @@ class CachedOp(object):
                 # detach from tape: aux updates carry no gradient
                 aux_arr._set_jax(new_val)
         return results
+
+    def call_fused(self, args: Sequence[NDArray],
+                   aux_arrays: Sequence[NDArray] = (),
+                   stacked_idx: Sequence[int] = ()):
+        """Forward-only inference over K batches in ONE device program.
+
+        Each arg whose index is in ``stacked_idx`` carries a leading K
+        dimension; the compiled program `lax.scan`s the graph over the
+        stacks while the remaining args (weights) are passed once.  The
+        inference analog of FusedTrainLoop: on a remote PJRT client the
+        per-dispatch round trip (~tens of ms) otherwise dominates
+        small-batch scoring (reference amortizes per-op scheduling via
+        engine bulking instead, `src/engine/threaded_engine.h:411`).
+        Returns stacked (K, ...) output NDArrays.  Aux stats are read,
+        never written (inference semantics); autograd is not supported
+        through this path."""
+        import jax
+        from jax import lax
+
+        if _ag.is_recording():
+            raise MXNetError("call_fused is inference-only; do not call "
+                             "it under autograd.record()")
+        stacked = tuple(sorted(int(i) for i in stacked_idx))
+        if not stacked:
+            raise MXNetError("call_fused needs at least one stacked arg")
+        n = len(self._arg_names)
+        cached = self._fused_jits.get(stacked)
+        if cached is None:
+            fixed = tuple(i for i in range(n) if i not in stacked)
+            infer_fn = self._infer_fn
+
+            def program(key, stack_vals, fixed_vals, aux_vals):
+                def body(carry, xs):
+                    step, data_vals = xs
+                    full = [None] * n
+                    for j, i in enumerate(stacked):
+                        full[i] = data_vals[j]
+                    for j, i in enumerate(fixed):
+                        full[i] = fixed_vals[j]
+                    outs, _unused_aux = infer_fn(
+                        full, list(aux_vals),
+                        jax.random.fold_in(key, step))
+                    return carry, tuple(outs)
+
+                import jax.numpy as jnp
+
+                K = stack_vals[0].shape[0]
+                _, outs = lax.scan(
+                    body, 0, (jnp.arange(K), tuple(stack_vals)),
+                    # XLA:CPU barely parallelizes inside loop bodies
+                    # (same rationale as FusedTrainLoop's unroll)
+                    unroll=(jax.default_backend() == "cpu"))
+                return outs
+
+            cached = (jax.jit(program), fixed)
+            self._fused_jits[stacked] = cached
+        jit_program, fixed = cached
+        K = args[stacked[0]].shape[0]
+        for i in stacked:
+            if args[i].shape[0] != K:
+                raise MXNetError("stacked args disagree on leading K")
+        stack_vals = tuple(args[i]._data for i in stacked)
+        fixed_vals = [args[i]._data for i in fixed]
+        aux_vals = [a._data for a in aux_arrays]
+        outs = jit_program(self._key(), stack_vals, fixed_vals, aux_vals)
+        ctx = args[stacked[0]].ctx
+        return [NDArray(o, ctx=ctx, _committed=True) for o in outs]

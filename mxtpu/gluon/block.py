@@ -474,6 +474,48 @@ class HybridBlock(Block):
         structured, _ = _regroup(list(out), self._out_fmt)
         return structured
 
+    def forward_fused(self, x, *args):
+        """Score K batches in ONE compiled program.
+
+        Every input carries a leading K dimension over the traced batch
+        shape (e.g. trace with (B, 3, H, W), call with (K, B, 3, H, W));
+        returns outputs stacked the same way.  Amortizes per-dispatch
+        latency exactly like FusedTrainLoop does for training — see
+        CachedOp.call_fused.  The block must be hybridized; the cache is
+        built from the first batch row if absent."""
+        if not self._active:
+            raise MXNetError("forward_fused requires hybridize()")
+        if self._cached_op is None:
+            # build the cache from batch row 0 of every input leaf —
+            # sliced per LEAF (a top-level [x][0] would grab the first
+            # structure element of a list input, not a batch row) and
+            # under pause() so the warm-up forward can't record a tape
+            # or write train-mode BN stats whatever scope the caller
+            # is in (call_fused itself never touches aux)
+            from .. import autograd as _ag
+
+            flat0, fmt0 = _flatten([x] + list(args), "input")
+            rows, _ = _regroup([a[0] for a in flat0], fmt0)
+            with _ag.pause():
+                self.forward(rows[0], *rows[1:])
+        flat_args, in_fmt = _flatten([x] + list(args), "input")
+        if in_fmt != self._in_fmt:
+            raise MXNetError("forward_fused input structure does not "
+                             "match the traced structure %r" % (self._in_fmt,))
+        inputs = []
+        stacked_idx = []
+        for pos, slot in enumerate(self._cached_arg_map):
+            if isinstance(slot, int):
+                inputs.append(flat_args[slot])
+                stacked_idx.append(pos)
+            else:
+                inputs.append(slot.data())
+        aux = [p.data() for p in self._cached_aux]
+        out = self._cached_op.call_fused(inputs, aux,
+                                         stacked_idx=stacked_idx)
+        structured, _ = _regroup(list(out), self._out_fmt)
+        return structured
+
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 

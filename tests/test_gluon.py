@@ -459,3 +459,61 @@ def test_embedding_grad_is_row_sparse_semantics():
     assert touched == {1, 3}
     np.testing.assert_allclose(g[1], 2.0)  # row 1 hit twice
     np.testing.assert_allclose(g[3], 1.0)
+
+
+def test_forward_fused_matches_per_batch_scoring():
+    """CachedOp.call_fused / HybridBlock.forward_fused: K batches in one
+    scanned program must reproduce K independent forward calls exactly
+    (inference semantics — BN moving stats read, never written)."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(6, kernel_size=3, padding=1),
+                nn.BatchNorm(),
+                nn.Activation("relu"),
+                nn.GlobalAvgPool2D(),
+                nn.Dense(5))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    net.hybridize()
+    rng = np.random.RandomState(7)
+    xs = nd.array(rng.randn(3, 2, 3, 8, 8).astype(np.float32))
+    net(xs[0])  # build cache at the per-batch shape
+
+    aux_before = [p.data().asnumpy().copy()
+                  for p in net._cached_aux]
+    fused = net.forward_fused(xs)
+    assert fused.shape == (3, 2, 5)
+    for k in range(3):
+        per = net(xs[k])
+        np.testing.assert_allclose(fused[k].asnumpy(), per.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+    # inference: fused scoring must not have touched the moving stats
+    for before, p in zip(aux_before, net._cached_aux):
+        np.testing.assert_array_equal(before, p.data().asnumpy())
+
+    # autograd through call_fused is rejected, not silently wrong
+    with pytest.raises(mx.base.MXNetError):
+        with autograd.record():
+            net.forward_fused(xs)
+
+
+def test_forward_fused_cold_start_never_writes_aux():
+    """Cold (un-cached) forward_fused must not corrupt BN moving stats
+    even when called inside a train-mode scope: the cache-building
+    warm-up forward runs under pause()."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, kernel_size=3, padding=1), nn.BatchNorm(),
+                nn.Dense(3))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    net.hybridize()
+    xs = nd.array(np.random.RandomState(3)
+                  .randn(2, 2, 3, 8, 8).astype(np.float32))
+    with autograd.train_mode():
+        out = net.forward_fused(xs)
+    assert out.shape == (2, 2, 3)
+    for p in net._cached_aux:
+        a = p.data().asnumpy()
+        if "mean" in p.name:
+            np.testing.assert_array_equal(a, np.zeros_like(a))
+        if "var" in p.name:
+            np.testing.assert_array_equal(a, np.ones_like(a))
