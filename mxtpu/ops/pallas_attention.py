@@ -62,6 +62,27 @@ def _interpret():
 # the kernel
 # ---------------------------------------------------------------------------
 
+def _dot_f32(a, b, contract=((1,), (1,))):
+    """MXU-friendly matmul: operands stay in their native (possibly
+    bf16) dtype so the systolic array runs single-pass multiplies, with
+    float32 accumulation via preferred_element_type.  Mixed f32 x bf16
+    pairs cast the f32 side DOWN (flash-attention standard: the
+    probability / dscore blocks re-enter the MXU in the activation
+    dtype; an f32 operand would force the multi-pass f32 matmul path).
+    Same-dtype f32 inputs are untouched — full-precision tests see
+    identical math."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    if a.dtype != b.dtype:
+        if a.dtype == jnp.float32:
+            a = a.astype(b.dtype)
+        elif b.dtype == jnp.float32:
+            b = b.astype(a.dtype)
+    return lax.dot_general(a, b, (contract, ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale, causal,
                   block_q, block_k, want_lse):
     if want_lse:
@@ -81,10 +102,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale, causal,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     def _step():
-        q = q_ref[0].astype(jnp.float32) * sm_scale   # (bq, d)
-        k = k_ref[0].astype(jnp.float32)              # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
-        s = jnp.dot(q, k.T)                           # (bq, bk) on MXU
+        # native-dtype operands on the MXU, f32 accumulate; the
+        # softmax scale applies to the f32 scores (not the bf16 q,
+        # which would round it into the inputs)
+        s = _dot_f32(q_ref[0], k_ref[0]) * sm_scale   # (bq, bk)
         if causal:
             q_idx = jnp.arange(block_q)[:, None] + i * block_q
             k_idx = jnp.arange(block_k)[None, :] + j * block_k
@@ -96,7 +117,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale, causal,
         p = jnp.exp(s - m_new)                        # (bq, bk)
         alpha = jnp.exp(m_prev - m_new)               # rescale old state
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(p, v)
+        acc_ref[:] = acc_ref[:] * alpha + _dot_f32(p, v_ref[0],
+                                                   ((1,), (0,)))
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -172,19 +194,19 @@ def _bwd_p_ds(q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref, i, j, *,
     the ds formula for both sweeps."""
     import jax.numpy as jnp
 
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    g = g_ref[0].astype(jnp.float32)
+    q = q_ref[0]                       # native dtype (see _dot_f32)
+    k = k_ref[0]
+    v = v_ref[0]
+    g = g_ref[0]
     lse = lse_ref[0][:, None]          # (bq, 1)
     dlt = dlt_ref[0][:, None]
-    s = jnp.dot(q, k.T) * sm_scale
+    s = _dot_f32(q, k) * sm_scale
     if causal:
         q_idx = jnp.arange(block_q)[:, None] + i * block_q
         k_idx = jnp.arange(block_k)[None, :] + j * block_k
         s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
     p = jnp.exp(s - lse)
-    dp = jnp.dot(g, v.T)
+    dp = _dot_f32(g, v)
     ds = p * (dp - dlt) * sm_scale
     return p, ds, q, k, g
 
@@ -209,7 +231,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref,
             q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref, i, j,
             sm_scale=sm_scale, causal=causal, block_q=block_q,
             block_k=block_k)
-        acc_ref[:] = acc_ref[:] + jnp.dot(ds, k)
+        acc_ref[:] = acc_ref[:] + _dot_f32(ds, k, ((1,), (0,)))
 
     if causal:
         pl.when(j * block_k <= (i + 1) * block_q - 1)(_step)
@@ -241,8 +263,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref,
             q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref, i, j,
             sm_scale=sm_scale, causal=causal, block_q=block_q,
             block_k=block_k)
-        dv_acc[:] = dv_acc[:] + jnp.dot(p.T, g)
-        dk_acc[:] = dk_acc[:] + jnp.dot(ds.T, q)
+        dv_acc[:] = dv_acc[:] + _dot_f32(p, g, ((0,), (0,)))
+        dk_acc[:] = dk_acc[:] + _dot_f32(ds, q, ((0,), (0,)))
 
     if causal:
         # q blocks strictly above this k block's diagonal see none of it
@@ -313,15 +335,18 @@ def _reference_attention_lse(q, k, v, sm_scale, causal):
     """Fused jnp reference; returns (out, per-row log-sum-exp)."""
     import jax.numpy as jnp
 
-    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * sm_scale
+    # native-dtype operands + f32 accumulation (MXU single-pass for
+    # bf16; identical math for f32 inputs) — see _dot_f32
+    s = jnp.einsum("bqd,bkd->bqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
     if causal:
         tq, tk = s.shape[-2:]
         mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
         s = jnp.where(mask, s, _NEG_INF)
     lse = jax.scipy.special.logsumexp(s, axis=-1)
     p = jnp.exp(s - lse[..., None])
-    out = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)) \
+    out = jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32) \
         .astype(q.dtype)
     return out, lse
 

@@ -50,6 +50,11 @@ def _online_block(q, k, v, acc, row_max, row_sum, mask_bias, scale):
     """
     import jax.numpy as jnp
 
+    # q/k/v stay in their native (possibly bf16) dtype: the MXU runs
+    # single-pass low-precision multiplies with f32 accumulation via
+    # preferred_element_type; an f32 operand (upcast q or v) would
+    # force the multi-pass f32 matmul path.  The probability block
+    # re-enters the MXU in v's dtype (flash-attention standard).
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if mask_bias is not None:
@@ -59,7 +64,7 @@ def _online_block(q, k, v, acc, row_max, row_sum, mask_bias, scale):
     p = jnp.exp(scores - new_max[..., None])
     new_sum = row_sum * correction + p.sum(axis=-1)
     new_acc = acc * correction[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
         preferred_element_type=jnp.float32)
     return new_acc, new_max, new_sum
 
@@ -109,10 +114,9 @@ def blockwise_attention(q, k, v, block_size: int = 512,
     else:
         kp, vp = k, v
 
-    q32 = q.astype(jnp.float32)
-    acc0 = _match_vma(jnp.zeros((B, H, Tq, D), jnp.float32), q32)
-    max0 = _match_vma(jnp.full((B, H, Tq), _NEG_INF, jnp.float32), q32)
-    sum0 = _match_vma(jnp.zeros((B, H, Tq), jnp.float32), q32)
+    acc0 = _match_vma(jnp.zeros((B, H, Tq, D), jnp.float32), q)
+    max0 = _match_vma(jnp.full((B, H, Tq), _NEG_INF, jnp.float32), q)
+    sum0 = _match_vma(jnp.zeros((B, H, Tq), jnp.float32), q)
 
     # decode-style alignment: when Tq < Tk the queries are the LAST Tq
     # positions of the key sequence (standard causal cross/decode case)
@@ -128,7 +132,7 @@ def blockwise_attention(q, k, v, block_size: int = 512,
             bias = bias + jnp.where(k_pos[None, :] > q_pos[:, None],
                                     _NEG_INF, 0.0)
         bias = bias[None, None]  # [1,1,Tq,block]
-        return _online_block(q32, kb, vb, acc, m, s, bias, scale)
+        return _online_block(q, kb, vb, acc, m, s, bias, scale)
 
     acc, m, s = jax.lax.fori_loop(0, n_blocks, body, (acc0, max0, sum0))
     out = acc / jnp.maximum(s, 1e-30)[..., None]
@@ -153,10 +157,9 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
 
-    q32 = q.astype(jnp.float32)
-    acc0 = _match_vma(jnp.zeros((B, H, T, D), jnp.float32), q32)
-    max0 = _match_vma(jnp.full((B, H, T), _NEG_INF, jnp.float32), q32)
-    sum0 = _match_vma(jnp.zeros((B, H, T), jnp.float32), q32)
+    acc0 = _match_vma(jnp.zeros((B, H, T, D), jnp.float32), q)
+    max0 = _match_vma(jnp.full((B, H, T), _NEG_INF, jnp.float32), q)
+    sum0 = _match_vma(jnp.zeros((B, H, T), jnp.float32), q)
 
     q_pos = my_idx * T + jnp.arange(T)
 
@@ -171,15 +174,16 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
                              _NEG_INF, 0.0)[None, None]
         else:
             bias = None
-        acc, m, s = _online_block(q32, kb, vb, acc, m, s, bias, scale)
-        # rotate for next step (XLA overlaps this with the block math)
+        acc, m, s = _online_block(q, kb, vb, acc, m, s, bias, scale)
+        # rotate for next step (XLA overlaps this with the block math);
+        # K/V ride the ring in their NATIVE dtype — for bf16 inputs
+        # that halves the per-hop ppermute bytes on ICI
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
         return acc, m, s, kb, vb
 
     acc, m, s, _, _ = jax.lax.fori_loop(
-        0, sp_size, body, (acc0, max0, sum0, k.astype(jnp.float32),
-                           v.astype(jnp.float32)))
+        0, sp_size, body, (acc0, max0, sum0, k, v))
     out = acc / jnp.maximum(s, 1e-30)[..., None]
     return out.astype(q.dtype)
 
