@@ -268,7 +268,9 @@ def _dense_ffn(x, w1, w2):
     import jax
     import jax.numpy as jnp
 
-    h = jax.nn.gelu((x @ w1).astype(jnp.float32)).astype(x.dtype)
+    h = jax.nn.gelu(jnp.einsum(
+        "bte,ef->btf", x, w1,
+        preferred_element_type=jnp.float32)).astype(x.dtype)
     return jax.lax.psum(h @ w2, AXIS_TP)
 
 
@@ -321,8 +323,11 @@ def _moe_ffn(cfg, x, router, we1, we2, ep_size):
     else:
         b = buckets.reshape(ne_loc, cap, E)
 
-    h = jax.nn.gelu(jnp.einsum("nce,nef->ncf", b.astype(jnp.float32),
-                               we1.astype(jnp.float32))).astype(x.dtype)
+    # native-dtype operands on the MXU, f32 accumulate + f32 gelu
+    # (upcasting b/we1 would force the multi-pass f32 matmul path)
+    h = jax.nn.gelu(jnp.einsum(
+        "nce,nef->ncf", b, we1,
+        preferred_element_type=jnp.float32)).astype(x.dtype)
     y = jnp.einsum("ncf,nfe->nce", h, we2)
     y = jax.lax.psum(y, AXIS_TP)                           # row-parallel
 
@@ -435,7 +440,10 @@ def _build_loss_fn(cfg: TransformerConfig, mesh, n_micro: int):
         emb = jnp.where(
             in_shard[..., None],
             params["embed"][jnp.clip(local_tok, 0, V_loc - 1)], 0.0)
-        emb = jax.lax.psum(emb.astype(jnp.float32), AXIS_TP)
+        # exactly one tp shard contributes a non-zero row per token
+        # (vocab-sharded one-hot), so a native-dtype psum is exact
+        # and halves the ICI bytes vs upcasting to f32 first
+        emb = jax.lax.psum(emb, AXIS_TP)
         pos_global = sp_idx * T + jnp.arange(T)
         x = (emb + params["pos"][pos_global][None]).astype(
             jnp.dtype(cfg.dtype))                         # [B, T, E]
@@ -666,7 +674,10 @@ def make_forward(cfg: TransformerConfig, mesh):
         emb = jnp.where(in_shard[..., None],
                         params["embed"][jnp.clip(local_tok, 0,
                                                  V_loc - 1)], 0.0)
-        emb = jax.lax.psum(emb.astype(jnp.float32), AXIS_TP)
+        # exactly one tp shard contributes a non-zero row per token
+        # (vocab-sharded one-hot), so a native-dtype psum is exact
+        # and halves the ICI bytes vs upcasting to f32 first
+        emb = jax.lax.psum(emb, AXIS_TP)
         pos_global = sp_idx * T + jnp.arange(T)
         x = (emb + params["pos"][pos_global][None]).astype(
             jnp.dtype(cfg.dtype))
