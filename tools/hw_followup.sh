@@ -1,0 +1,52 @@
+#!/bin/bash
+# Post-recovery hardware measurement queue. Waits for tools/tpu_watch.sh
+# to finish its bench run (it exits 0 after publishing
+# BENCH_r05_live.json), then runs the remaining chip measurements
+# SEQUENTIALLY with no external kill timeouts — a SIGTERM mid-TPU-op
+# wedges the tunnel (BENCH_NOTES_r05.md). Each phase has internal
+# budgets/try-excepts and appends to /tmp/hw_followup.log.
+cd /root/repo
+LOG=/tmp/hw_followup.log
+echo "== hw_followup start $(date +%H:%M:%S)" >> "$LOG"
+
+# wait (up to the deadline) for the watcher to exit successfully
+DEADLINE=$(( $(date +%s) + ${HW_FOLLOWUP_DEADLINE_S:-28800} ))
+while pgrep -f "tools/tpu_watch.sh" > /dev/null; do
+  if [ "$(date +%s)" -gt "$DEADLINE" ]; then
+    echo "deadline waiting for watcher" >> "$LOG"; exit 7
+  fi
+  sleep 60
+done
+# watcher gone: did it publish? (rc isn't observable here; check probe)
+STATE=$(timeout 130 python -c "from bench import _probe_tpu; print(_probe_tpu(timeout=100))" 2>/dev/null | tail -1)
+echo "watcher done, probe=$STATE $(date +%H:%M:%S)" >> "$LOG"
+if [ "$STATE" != "ok" ]; then
+  echo "tunnel not usable; aborting follow-up" >> "$LOG"; exit 6
+fi
+
+echo "-- bandwidth (device merge, single chip)" >> "$LOG"
+python tools/bandwidth/measure.py --kv-store device --size-mb 50 \
+  --num-keys 10 --iters 5 >> "$LOG" 2>&1
+echo "-- bandwidth (kvstore=tpu fused allreduce path)" >> "$LOG"
+python tools/bandwidth/measure.py --kv-store tpu --size-mb 50 \
+  --num-keys 10 --iters 5 >> "$LOG" 2>&1
+
+echo "-- flash attention sweep" >> "$LOG"
+python benchmark/python/bench_attention.py --seqs 512,1024,2048,4096 \
+  --iters 5 >> "$LOG" 2>&1
+
+echo "-- inference scoring fp32" >> "$LOG"
+( cd examples/image-classification && \
+  python benchmark_score.py --networks resnet50_v1 \
+    --batch-sizes 32,128,256 --iters 20 --fused 8 ) >> "$LOG" 2>&1
+echo "-- inference scoring bf16" >> "$LOG"
+( cd examples/image-classification && \
+  python benchmark_score.py --networks resnet50_v1 \
+    --batch-sizes 32,128 --iters 20 --fused 8 --dtype bfloat16 ) \
+  >> "$LOG" 2>&1
+
+echo "-- profile_train attribution (bf16 NCHW only, with trace)" >> "$LOG"
+python tools/profile_train.py --iters 3 --configs bfloat16: \
+  --trace-dir /tmp/mxtpu_trace_r05 >> "$LOG" 2>&1
+
+echo "== hw_followup done $(date +%H:%M:%S)" >> "$LOG"
