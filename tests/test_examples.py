@@ -333,3 +333,14 @@ def test_example_kaggle_ndsb2():
     out = _run_example("kaggle-ndsb2/heart_volume_rnn.py",
                        "--epochs", "10", timeout=560)
     assert _final_metric(out, "FINAL_CRPS") < 0.18
+
+
+def test_example_transformer_lm_sharded_convergence():
+    """Flagship SPMD TransformerLM example: dp*tp*sp mesh, ZeRO-1 Adam,
+    ring attention — must converge on the periodic-sequence task (the
+    reference has no transformer; SURVEY §2.4 new-capability row)."""
+    out = _run_example(
+        "transformer_lm/train.py", "--steps", "40",
+        env_extra={"XLA_FLAGS":
+                   "--xla_force_host_platform_device_count=8"})
+    assert "CONVERGED" in out

@@ -9,11 +9,24 @@ cd /root/repo
 LOG=/tmp/hw_followup.log
 echo "== hw_followup start $(date +%H:%M:%S)" >> "$LOG"
 
-# wait (up to the deadline) for the watcher to exit successfully
-DEADLINE=$(( $(date +%s) + ${HW_FOLLOWUP_DEADLINE_S:-28800} ))
-while pgrep -f "tools/tpu_watch.sh" > /dev/null; do
+# Wait (up to the deadline) for the watcher to finish its bench run.
+# Process-absence alone races a not-yet-started watcher, so require
+# EITHER the published bench artifact to be newer than this script's
+# start OR a positive sighting of the watcher before its exit.
+START_TS=$(date +%s)
+DEADLINE=$(( START_TS + ${HW_FOLLOWUP_DEADLINE_S:-28800} ))
+SAW_WATCHER=0
+while :; do
+  if pgrep -f "tools/tpu_watch.sh" > /dev/null; then
+    SAW_WATCHER=1
+  elif [ "$SAW_WATCHER" = "1" ]; then
+    break                       # watcher ran and has now exited
+  elif [ -f BENCH_r05_live.json ] && \
+       [ "$(stat -c %Y BENCH_r05_live.json)" -gt "$START_TS" ]; then
+    break                       # bench already republished before we saw it
+  fi
   if [ "$(date +%s)" -gt "$DEADLINE" ]; then
-    echo "deadline waiting for watcher" >> "$LOG"; exit 7
+    echo "deadline waiting for watcher/bench" >> "$LOG"; exit 7
   fi
   sleep 60
 done
