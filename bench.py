@@ -231,6 +231,72 @@ def _mfu(ips):
     return round(ips * TRAIN_GFLOP_PER_IMG / (PEAK_TFLOPS * 1e3), 4)
 
 
+def run_transformer(iters=6, warmup=2, B=8, T=1024, d_model=1024,
+                    n_layers=8, d_ff=4096, vocab=8192):
+    """Second flagship metric: sharded-TransformerLM training tokens/s
+    on one chip (1-device mesh — collectives elide; the SAME
+    make_train_step the multichip dryrun compiles at 8/16/32 devices).
+    bf16, ZeRO-1-capable Adam path, flash attention via Pallas when the
+    kernel compiles on this backend (falls back to the blocked jnp
+    path otherwise).  The reference has no transformer; this row
+    anchors the new-capability stack's single-chip performance.
+    Returns (tokens_per_sec, est_mfu, used_pallas)."""
+    import numpy as np
+    import jax
+
+    from mxtpu.parallel import transformer as tf
+    from mxtpu.parallel.mesh import (create_mesh, AXIS_DP, AXIS_PP,
+                                     AXIS_TP, AXIS_SP, AXIS_EP)
+
+    used_pallas = False
+    try:  # tiny standalone probe: does a Pallas kernel run here?
+        from mxtpu.ops.pallas_attention import flash_attention
+        import jax.numpy as jnp
+
+        os.environ["MXTPU_USE_PALLAS"] = "1"
+        x = jnp.ones((2, 128, 64), jnp.bfloat16)
+        jax.block_until_ready(flash_attention(x, x, x, causal=True))
+        used_pallas = True
+    except Exception:
+        os.environ.pop("MXTPU_USE_PALLAS", None)
+
+    cfg = tf.TransformerConfig(vocab=vocab, d_model=d_model, n_heads=8,
+                               n_layers=n_layers, d_ff=d_ff, max_len=T,
+                               dtype="bfloat16")
+    mesh = create_mesh({AXIS_DP: 1, AXIS_PP: 1, AXIS_TP: 1,
+                        AXIS_SP: 1, AXIS_EP: 1},
+                       devices=jax.devices()[:1])
+    params = tf.init_params(cfg, mesh, seed=0)
+    opt = tf.init_opt_state(cfg, mesh)
+    step, sh = tf.make_train_step(cfg, mesh, lr=1e-3, optimizer="adam")
+    rng = np.random.RandomState(0)
+    toks = jax.device_put(rng.randint(0, cfg.vocab, (B, T))
+                          .astype(np.int32), sh["data"])
+    labs = jax.device_put(rng.randint(0, cfg.vocab, (B, T))
+                          .astype(np.int32), sh["data"])
+    for _ in range(warmup):
+        params, opt, loss = step(params, opt, toks, labs)
+    jax.block_until_ready(loss)
+    # compile+warmup may have eaten the driver budget: shrink or bail
+    # BEFORE the timed loop so the resnet JSON line always gets out
+    # (the round-3 rc!=0-no-record failure mode)
+    if _budget_left() < 60:
+        raise RuntimeError("budget exhausted after transformer warmup")
+    iters = max(1, min(iters, int(_budget_left() // 30)))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt, loss = step(params, opt, toks, labs)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    tps = B * T * iters / dt
+    # 6*N FLOP/token (fwd+bwd) + attention 12*L*d*T, causal-halved
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    flop_tok = 6.0 * n_params + 0.5 * 12.0 * cfg.n_layers \
+        * cfg.d_model * T
+    est_mfu = tps * flop_tok / (PEAK_TFLOPS * 1e12)
+    return round(tps, 1), round(est_mfu, 4), used_pallas
+
+
 def main():
     global SPP, ITERS, WINDOWS, WARMUP, BATCH
     tpu_ok = wait_for_tpu()
@@ -303,6 +369,18 @@ def main():
         if _budget_left() >= 180:
             extra["fp32_bs%d_per_step_dispatch" % BATCH] = round(
                 run_per_step_fp32(BATCH), 2)
+        # second flagship: transformer-LM tokens/s (new-capability
+        # stack; never lets a failure sink the resnet record — errors
+        # are caught here and run_transformer re-checks the budget
+        # after its compile/warmup phase)
+        if _budget_left() >= 420:
+            try:
+                tps, tmfu, pallas = run_transformer()
+                extra["transformer_lm_tokens_per_sec"] = tps
+                extra["transformer_lm_mfu"] = tmfu
+                extra["transformer_lm_pallas"] = pallas
+            except Exception as e:
+                extra["transformer_lm_error"] = str(e)[:300]
     result["extra"] = extra
     print(json.dumps(result))
 

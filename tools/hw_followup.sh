@@ -21,9 +21,12 @@ while :; do
     SAW_WATCHER=1
   elif [ "$SAW_WATCHER" = "1" ]; then
     break                       # watcher ran and has now exited
-  elif [ -f BENCH_r05_live.json ] && \
-       [ "$(stat -c %Y BENCH_r05_live.json)" -gt "$START_TS" ]; then
-    break                       # bench already republished before we saw it
+  else
+    # watcher not running and never seen: either it already finished
+    # (its log records the bench hand-off) or it crashed/never started
+    # — in both cases the probe gate below is the real protection, so
+    # proceed rather than hanging to the deadline
+    break
   fi
   if [ "$(date +%s)" -gt "$DEADLINE" ]; then
     echo "deadline waiting for watcher/bench" >> "$LOG"; exit 7
