@@ -53,8 +53,10 @@ def main():
             try:
                 sig = str(inspect.signature(opdef.fn))
                 # function-object defaults repr as '<function f at 0x..>'
-                # — nondeterministic addresses churn the generated file
-                sig = re.sub(r"=<[^>]*>", "=<fn>", sig)
+                # (possibly '<function <lambda> at 0x..>' — nested
+                # brackets) — nondeterministic addresses churn the
+                # generated file; eat to the parameter boundary
+                sig = re.sub(r"=<[^,)]*", "=<fn>", sig)
             except (TypeError, ValueError):
                 sig = "(...)"
             flags = []
