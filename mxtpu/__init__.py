@@ -64,6 +64,8 @@ from .ndarray import NDArray
 from . import symbol
 from . import symbol as sym
 from .symbol import Symbol
+from .symbol import AttrScope                 # mx.AttrScope parity
+from . import name                            # mx.name.Prefix parity
 from .executor import Executor
 from .cached_op import CachedOp
 from . import subgraph

@@ -1,0 +1,20 @@
+"""Automatic symbol naming — `mx.name.NameManager` / `mx.name.Prefix`
+(reference `python/mxnet/name.py`).  NameManager lives with the Symbol
+machinery; Prefix specializes it to prepend a fixed prefix to every
+auto-generated name (explicit names pass through prefixed too, matching
+the reference's use for module namespacing)."""
+from .symbol.symbol import NameManager
+
+__all__ = ["NameManager", "Prefix"]
+
+
+class Prefix(NameManager):
+    """`with mx.name.Prefix("enc_"):` — every symbol created in the
+    scope gets the prefix (reference `name.py:93`)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)

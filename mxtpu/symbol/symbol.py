@@ -45,12 +45,18 @@ class NameManager(object):
 
     @classmethod
     def current(cls) -> "NameManager":
-        if not hasattr(cls._current, "value"):
+        # a scope exit restores `value = None` when no manager was
+        # active before it — treat that the same as never-initialized
+        if getattr(cls._current, "value", None) is None:
             cls._current.value = NameManager()
         return cls._current.value
 
     def __enter__(self):
-        self._old = getattr(NameManager._current, "value", None)
+        # snapshot a REAL manager (creating the thread's default on
+        # demand), never None: restoring None on exit would make the
+        # next current() call manufacture a fresh manager with reset
+        # counters -> duplicate auto-names colliding at bind time
+        self._old = NameManager.current()
         NameManager._current.value = self
         return self
 

@@ -364,3 +364,52 @@ def test_variable_lr_mult_scales_module_updates():
     args, _ = mod.get_params()
     np.testing.assert_allclose(args["w_slow"].asnumpy(), slow0)
     assert np.abs(args["w_fast"].asnumpy() - 1.0).sum() > 0
+
+
+def test_attrscope_and_name_prefix_reference_paths():
+    """mx.AttrScope / mx.name.Prefix at the reference import paths
+    (reference python/mxnet/attribute.py + name.py)."""
+    import mxtpu as mx
+
+    with mx.AttrScope(ctx_group="dev1", lr_mult="2"):
+        v = sym.Variable("v")
+    assert v.attr("ctx_group") == "dev1"
+    assert v.attr("lr_mult") == "2"
+
+    with mx.name.Prefix("blk_"):
+        s = sym.FullyConnected(data=sym.Variable("x"), num_hidden=3)
+        named = sym.FullyConnected(data=sym.Variable("y"), num_hidden=3,
+                                   name="fc_explicit")
+    assert s.name.startswith("blk_")
+    # the reference's Prefix prefixes explicit names too
+    assert named.name == "blk_fc_explicit"
+    # auto-name counters are per-manager: outside the scope no prefix
+    t = sym.FullyConnected(data=sym.Variable("z"), num_hidden=3)
+    assert not t.name.startswith("blk_")
+
+
+def test_default_name_manager_survives_scope_exits():
+    """The thread's DEFAULT manager must be one persistent object
+    across scope entries/exits — pre-fix, every exit restored None and
+    the next use minted a fresh manager with reset counters, so two
+    scopeless symbols created around scopes collided (same auto-name
+    -> same weight arg name -> silent param aliasing at bind)."""
+    import threading
+
+    names = []
+
+    def worker():
+        from mxtpu.symbol.symbol import NameManager
+        with NameManager():
+            sym.FullyConnected(data=sym.Variable("a"), num_hidden=2)
+        b = sym.FullyConnected(data=sym.Variable("b"), num_hidden=2)
+        with NameManager():
+            sym.FullyConnected(data=sym.Variable("c"), num_hidden=2)
+        d = sym.FullyConnected(data=sym.Variable("d"), num_hidden=2)
+        names.extend([b.name, d.name])
+
+    t = threading.Thread(target=worker)
+    t.start(); t.join()
+    # b and d both came from the thread default manager: counters must
+    # have advanced, not reset, across the second scope
+    assert len(set(names)) == 2, names
