@@ -66,6 +66,9 @@ from . import symbol as sym
 from .symbol import Symbol
 from .symbol import AttrScope                 # mx.AttrScope parity
 from . import name                            # mx.name.Prefix parity
+from . import log                             # mx.log.get_logger
+from . import util                            # mx.util.makedirs
+from . import libinfo                         # capability report
 from .executor import Executor
 from .cached_op import CachedOp
 from . import subgraph

@@ -1,0 +1,56 @@
+"""Build/runtime capability report (reference `python/mxnet/libinfo.py`
++ the runtime-feature idea).  The reference enumerates compiled-in
+features (CUDA, MKLDNN, OPENMP...); the TPU-native analogs are probed
+live, since there is no compile-time feature matrix — JAX backends and
+the optional native runtime decide what exists."""
+import os
+
+__version__ = "0.1.0"
+
+__all__ = ["features", "find_lib_path", "__version__"]
+
+
+def find_lib_path():
+    """Paths of the native runtime libraries that exist (analog of the
+    reference's libmxnet.so discovery)."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = []
+    for name in ("libmxtpu_runtime.so", "libmxtpu_predict.so",
+                 "libmxtpu_c.so"):
+        p = os.path.join(here, "src", "build", name)
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def features():
+    """Dict of capability-name -> bool, probed from the live session."""
+    feats = {}
+    try:
+        import jax  # noqa: F401
+
+        feats["CPU_MESH"] = True       # virtual host mesh always works
+    except Exception:
+        feats["CPU_MESH"] = False
+    try:
+        import jax
+
+        # separate probe: backend init can fail (e.g. broken TPU
+        # driver) while jax itself — and CPU meshes — work fine
+        feats["TPU"] = any(d.platform != "cpu" for d in jax.devices())
+    except Exception:
+        feats["TPU"] = False
+    try:
+        from jax.experimental import pallas  # noqa: F401
+
+        feats["PALLAS"] = True
+    except Exception:
+        feats["PALLAS"] = False
+    libs = find_lib_path()
+    feats["NATIVE_ENGINE"] = any("runtime" in p for p in libs)
+    feats["C_PREDICT_ABI"] = any("predict" in p for p in libs)
+    feats["C_API"] = any(p.endswith("libmxtpu_c.so") for p in libs)
+    feats["BF16"] = True           # every XLA backend lowers bfloat16
+    feats["INT8_QUANTIZATION"] = True
+    feats["DIST_KVSTORE"] = True   # TCP PS needs no optional deps
+    return feats

@@ -1,0 +1,60 @@
+"""Logging helpers — `mx.log.get_logger` (reference
+`python/mxnet/log.py:80`).  Keeps the reference's colored-level
+formatter idea in a simplified TTY-aware form."""
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger"]
+
+_COLORS = {"WARNING": "\x1b[0;33m", "ERROR": "\x1b[0;31m",
+           "CRITICAL": "\x1b[0;35m", "DEBUG": "\x1b[0;34m",
+           "INFO": "\x1b[0;32m"}
+
+
+class _Formatter(logging.Formatter):
+    def __init__(self, colored):
+        self._colored = colored
+        super().__init__(datefmt="%m%d %H:%M:%S")
+
+    def format(self, record):
+        label = record.levelname[0]
+        if self._colored:
+            label = (_COLORS.get(record.levelname, "") + label
+                     + "\x1b[0m")
+        self._style._fmt = ("[%s %%(asctime)s %%(name)s] %%(message)s"
+                            % label)
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None,
+               level=logging.WARNING):
+    """Logger with the framework's level-tagged format; file target when
+    `filename` is given, colored on TTY stderr otherwise.  Handler and
+    level install on FIRST init only (a later bare get_logger must not
+    reset a level the user set), and the root logger (name=None) is
+    returned untouched — installing a handler there would duplicate
+    every propagating record and override unrelated libraries (same
+    guard as the reference, `log.py:80`)."""
+    logger = logging.getLogger(name)
+    if name is None or getattr(logger, "_mxtpu_init", False):
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+        colored = False
+    else:
+        handler = logging.StreamHandler()
+        colored = getattr(sys.stderr, "isatty", lambda: False)()
+    handler.setFormatter(_Formatter(colored))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxtpu_init = True
+    return logger
+
+
+def getLogger(*args, **kwargs):
+    """Deprecated alias kept for reference compatibility."""
+    import warnings
+
+    warnings.warn("getLogger is deprecated, use get_logger",
+                  DeprecationWarning)
+    return get_logger(*args, **kwargs)

@@ -1,0 +1,10 @@
+"""Small user-facing utilities (reference `python/mxnet/util.py`)."""
+import os
+
+__all__ = ["makedirs"]
+
+
+def makedirs(d):
+    """Create directory recursively; no error if it exists (reference
+    `util.py:23` — predates exist_ok, kept for API parity)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)

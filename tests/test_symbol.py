@@ -413,3 +413,39 @@ def test_default_name_manager_survives_scope_exits():
     # b and d both came from the thread default manager: counters must
     # have advanced, not reset, across the second scope
     assert len(set(names)) == 2, names
+
+
+def test_log_util_libinfo_shims():
+    """mx.log / mx.util / mx.libinfo at the reference import paths."""
+    import tempfile
+
+    import mxtpu as mx
+
+    lg = mx.log.get_logger("shim_test", level=20)
+    assert lg.level == 20
+    # idempotent: second call must not stack handlers NOR reset the
+    # level via its default argument
+    n = len(lg.handlers)
+    again = mx.log.get_logger("shim_test")
+    assert len(again.handlers) == n and again.level == 20
+    # root logger is returned untouched (no handler/level install)
+    import logging
+    root_handlers = len(logging.getLogger().handlers)
+    mx.log.get_logger()
+    assert len(logging.getLogger().handlers) == root_handlers
+
+    d = tempfile.mkdtemp() + "/x/y"
+    mx.util.makedirs(d)
+    mx.util.makedirs(d)  # exist_ok
+
+    f = mx.libinfo.features()
+    assert f["BF16"] and f["CPU_MESH"]
+    # this repo builds the native runtime: discovery must actually
+    # find it (and the feature flags must reflect the found libs)
+    import os
+    build = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(mx.__file__))), "src", "build")
+    if os.path.isdir(build):
+        libs = mx.libinfo.find_lib_path()
+        assert any(p.endswith("libmxtpu_runtime.so") for p in libs)
+        assert f["NATIVE_ENGINE"]
