@@ -14,8 +14,11 @@ ppermute concurrently with the matmuls inside the `lax.fori_loop` body.
 `blockwise_attention` is the single-device building block (blocked
 softmax accumulation — the same math, looping over local K/V blocks);
 `ring_attention` composes it across the ring.  Both are jit-traceable
-and differentiable (the backward re-runs the ring in reverse via JAX AD
-of the loop).
+and differentiable: blockwise via JAX AD of the loop, ring via a
+custom recompute backward (a second ring pass against the saved
+log-sum-exp) that keeps residual memory O(local shard) — AD through
+the forward loop would stash every visiting K/V block, i.e. the full
+sequence per device.
 """
 from __future__ import annotations
 
@@ -139,41 +142,38 @@ def blockwise_attention(q, k, v, block_size: int = 512,
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
-                   scale: Optional[float] = None):
-    """Ring attention inside shard_map: q/k/v are the LOCAL sequence
-    shards [B, H, T_local, D]; the full sequence is T_local * sp_size.
+def _ring_causal_bias(causal, src, my_idx, T):
+    import jax.numpy as jnp
 
-    K/V rotate around the "sp" ring; each step attends the local Q
-    against the visiting K/V shard with online-softmax accumulation.
-    Causal masking uses global positions derived from the ring index.
-    """
+    if not causal:
+        return None
+    q_pos = my_idx * T + jnp.arange(T)
+    k_pos = src * T + jnp.arange(T)
+    return jnp.where(k_pos[None, :] > q_pos[:, None],
+                     _NEG_INF, 0.0)[None, None]
+
+
+def _ring_forward(q, k, v, axis_name, causal, scale):
+    """Forward ring pass; returns (out, lse) with lse = m + log(s) —
+    the per-row log-sum-exp the recompute backward needs."""
     import jax
     import jax.numpy as jnp
 
     sp_size = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, H, T, D = q.shape
-    scale = scale if scale is not None else 1.0 / (D ** 0.5)
     perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
 
     acc0 = _match_vma(jnp.zeros((B, H, T, D), jnp.float32), q)
     max0 = _match_vma(jnp.full((B, H, T), _NEG_INF, jnp.float32), q)
     sum0 = _match_vma(jnp.zeros((B, H, T), jnp.float32), q)
 
-    q_pos = my_idx * T + jnp.arange(T)
-
     def body(step, carry):
         acc, m, s, kb, vb = carry
         # the K/V shard visiting at `step` originated on rank
         # (my_idx - step) mod sp
         src = (my_idx - step) % sp_size
-        if causal:
-            k_pos = src * T + jnp.arange(T)
-            bias = jnp.where(k_pos[None, :] > q_pos[:, None],
-                             _NEG_INF, 0.0)[None, None]
-        else:
-            bias = None
+        bias = _ring_causal_bias(causal, src, my_idx, T)
         acc, m, s = _online_block(q, kb, vb, acc, m, s, bias, scale)
         # rotate for next step (XLA overlaps this with the block math);
         # K/V ride the ring in their NATIVE dtype — for bf16 inputs
@@ -184,8 +184,115 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
 
     acc, m, s, _, _ = jax.lax.fori_loop(
         0, sp_size, body, (acc0, max0, sum0, k, v))
-    out = acc / jnp.maximum(s, 1e-30)[..., None]
-    return out.astype(q.dtype)
+    s = jnp.maximum(s, 1e-30)
+    out = acc / s[..., None]
+    return out.astype(q.dtype), m + jnp.log(s)
+
+
+def _ring_backward(q, k, v, out, lse, g, axis_name, causal, scale):
+    """Recompute backward: a SECOND ring pass rebuilds each visiting
+    block's probabilities from the saved LSE (flash attention §3.1
+    applied across the ring).  The visiting shard's dk/dv accumulators
+    ride the ring WITH it, so after sp_size hops every shard is home
+    with contributions from every rank.  Residual memory is O(local
+    shard) — JAX AD of the forward loop would instead stash the
+    visiting K/V of every step (sp_size x local, i.e. the full
+    sequence per device, defeating sequence parallelism's memory win).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    sp_size = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, H, T, D = q.shape
+    perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
+
+    g32 = g.astype(jnp.float32)
+    delta = (out.astype(jnp.float32) * g32).sum(-1)     # [B,H,T]
+    dq0 = _match_vma(jnp.zeros((B, H, T, D), jnp.float32), q)
+    dk0 = _match_vma(jnp.zeros((B, H, T, D), jnp.float32), q)
+    dv0 = _match_vma(jnp.zeros((B, H, T, D), jnp.float32), q)
+
+    def body(step, carry):
+        dq, dkb, dvb, kb, vb = carry
+        src = (my_idx - step) % sp_size
+        bias = _ring_causal_bias(causal, src, my_idx, T)
+        s_blk = jnp.einsum("bhqd,bhkd->bhqk", q, kb,
+                           preferred_element_type=jnp.float32) * scale
+        if bias is not None:
+            s_blk = s_blk + bias
+        p = jnp.exp(s_blk - lse[..., None])              # [B,H,Tq,Tk]
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g, vb,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale
+        # p/ds re-enter the MXU in the activation dtype (_dot_f32
+        # convention in ops/pallas_attention.py); accumulators stay f32
+        ds_lp = ds.astype(q.dtype)
+        p_lp = p.astype(q.dtype)
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds_lp, kb,
+                             preferred_element_type=jnp.float32)
+        dkb = dkb + jnp.einsum("bhqk,bhqd->bhkd", ds_lp, q,
+                               preferred_element_type=jnp.float32)
+        dvb = dvb + jnp.einsum("bhqk,bhqd->bhkd", p_lp, g,
+                               preferred_element_type=jnp.float32)
+        # rotate the visiting shard AND its gradient accumulators
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        dkb = jax.lax.ppermute(dkb, axis_name, perm)
+        dvb = jax.lax.ppermute(dvb, axis_name, perm)
+        return dq, dkb, dvb, kb, vb
+
+    dq, dk, dv, _, _ = jax.lax.fori_loop(
+        0, sp_size, body, (dq0, dk0, dv0, k, v))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _ring_fwd_rule(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_forward(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd_rule(axis_name, causal, scale, res, g):
+    q, k, v, out, lse = res
+    return _ring_backward(q, k, v, out, lse, g, axis_name, causal,
+                          scale)
+
+
+_RING = None
+
+
+def _get_ring():
+    """Build the custom_vjp wrapper on first use — decorating at import
+    would need a module-level jax import, breaking the package's
+    lazy-jax convention."""
+    global _RING
+    if _RING is None:
+        import jax
+
+        ring = jax.custom_vjp(
+            lambda q, k, v, axis_name, causal, scale:
+            _ring_forward(q, k, v, axis_name, causal, scale)[0],
+            nondiff_argnums=(3, 4, 5))
+        ring.defvjp(_ring_fwd_rule, _ring_bwd_rule)
+        _RING = ring
+    return _RING
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Ring attention inside shard_map: q/k/v are the LOCAL sequence
+    shards [B, H, T_local, D]; the full sequence is T_local * sp_size.
+
+    K/V rotate around the "sp" ring; each step attends the local Q
+    against the visiting K/V shard with online-softmax accumulation.
+    Causal masking uses global positions derived from the ring index.
+    Differentiation uses a custom recompute backward (second ring pass
+    against the saved log-sum-exp) so residuals stay O(local shard)
+    instead of AD stashing every visiting K/V block.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _get_ring()(q, k, v, axis_name, bool(causal), float(scale))
 
 
 def ring_self_attention(x, wq, wk, wv, wo, n_heads: int,
