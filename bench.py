@@ -248,14 +248,36 @@ def run_transformer(iters=6, warmup=2, B=8, T=1024, d_model=1024,
     from mxtpu.parallel.mesh import (create_mesh, AXIS_DP, AXIS_PP,
                                      AXIS_TP, AXIS_SP, AXIS_EP)
 
+    mesh = create_mesh({AXIS_DP: 1, AXIS_PP: 1, AXIS_TP: 1,
+                        AXIS_SP: 1, AXIS_EP: 1},
+                       devices=jax.devices()[:1])
     used_pallas = False
-    try:  # tiny standalone probe: does a Pallas kernel run here?
-        from mxtpu.ops.pallas_attention import flash_attention
+    try:
+        # probe the kernel in a REPRESENTATIVE context: inside
+        # shard_map over the SAME mesh the train step uses, gradients
+        # included (a bare-call probe can pass while the
+        # manual-sharding trace path fails)
+        from jax.sharding import PartitionSpec as P
         import jax.numpy as jnp
 
+        from mxtpu.ops.pallas_attention import _use_pallas, \
+            flash_attention
+
+        if not _use_pallas():
+            raise RuntimeError("no pallas backend")
         os.environ["MXTPU_USE_PALLAS"] = "1"
+
+        def probe(x):
+            def loss(x):
+                return flash_attention(x, x, x, causal=True) \
+                    .astype(jnp.float32).sum()
+
+            return jax.grad(loss)(x)
+
         x = jnp.ones((2, 128, 64), jnp.bfloat16)
-        jax.block_until_ready(flash_attention(x, x, x, causal=True))
+        sm = jax.jit(jax.shard_map(
+            probe, mesh=mesh, in_specs=P(), out_specs=P()))
+        jax.block_until_ready(sm(x))
         used_pallas = True
     except Exception:
         os.environ.pop("MXTPU_USE_PALLAS", None)
@@ -263,9 +285,6 @@ def run_transformer(iters=6, warmup=2, B=8, T=1024, d_model=1024,
     cfg = tf.TransformerConfig(vocab=vocab, d_model=d_model, n_heads=8,
                                n_layers=n_layers, d_ff=d_ff, max_len=T,
                                dtype="bfloat16")
-    mesh = create_mesh({AXIS_DP: 1, AXIS_PP: 1, AXIS_TP: 1,
-                        AXIS_SP: 1, AXIS_EP: 1},
-                       devices=jax.devices()[:1])
     params = tf.init_params(cfg, mesh, seed=0)
     opt = tf.init_opt_state(cfg, mesh)
     step, sh = tf.make_train_step(cfg, mesh, lr=1e-3, optimizer="adam")

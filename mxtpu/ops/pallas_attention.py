@@ -41,6 +41,59 @@ from .registry import register
 _NEG_INF = -1e30
 
 
+def _vma_union(likes):
+    """Union of the varying-manual-axes of `likes`, or None when the
+    jax version has no vma tracking."""
+    import jax
+
+    out = set()
+    for like in likes:
+        try:
+            out |= set(jax.typeof(like).vma)
+        except (AttributeError, TypeError):
+            return None
+    return out
+
+
+def _vma_like(x, *likes):
+    """Mark `x` as varying over every manual mesh axis ANY of `likes`
+    varies over (loop carries under shard_map need it — and a carry fed
+    by q, k, v and g must cover all four, they can shard differently);
+    no-op outside shard_map.  Twin of
+    parallel.ring_attention._match_vma, duplicated here to keep the ops
+    package import-independent of parallel."""
+    import jax
+
+    want = _vma_union(likes)
+    if want is None:
+        return x
+    try:
+        want = want - set(jax.typeof(x).vma)
+    except (AttributeError, TypeError):
+        return x
+    if want:
+        x = jax.lax.pcast(x, tuple(want), to="varying")
+    return x
+
+
+def _sds(shape, dtype, *likes):
+    """ShapeDtypeStruct for a pallas_call output; inside shard_map the
+    struct must declare its varying-manual-axes (check_vma) — the
+    UNION of the operands', since an output varies wherever any input
+    does.  Pass vma even when empty: a None-vma struct is rejected
+    outright under check_vma, and a replicated operand legitimately
+    varies over no axes."""
+    import jax
+
+    vma = _vma_union(likes)
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    except TypeError:      # jax without the vma kwarg
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _use_pallas():
     if os.environ.get("MXTPU_PALLAS_INTERPRET", "0") == "1":
         return True
@@ -49,7 +102,12 @@ def _use_pallas():
     import jax
 
     try:
-        return jax.devices()[0].platform == "tpu"
+        d = jax.devices()[0]
+        # TPU chips can surface under plugin platform names (the axon
+        # tunnel registers platform='axon' with device_kind 'TPU v5
+        # lite') — gate on either signal, not the platform string alone
+        return d.platform == "tpu" or \
+            "tpu" in getattr(d, "device_kind", "").lower()
     except Exception:
         return False
 
@@ -157,12 +215,12 @@ def _flash_forward_pallas(q, k, v, sm_scale, causal, block_q, block_k,
     kernel = functools.partial(_flash_kernel, sm_scale=sm_scale,
                                causal=causal, block_q=block_q,
                                block_k=block_k, want_lse=want_lse)
-    out_shape = [jax.ShapeDtypeStruct((bh, tq, d), q.dtype)]
+    out_shape = [_sds((bh, tq, d), q.dtype, q, k, v)]
     out_specs = [pl.BlockSpec((1, block_q, d),
                               lambda b, i, j: (b, i, 0))]
     if want_lse:
         out_shape.append(
-            jax.ShapeDtypeStruct((bh, tq, 128), jnp.float32))
+            _sds((bh, tq, 128), jnp.float32, q, k, v))
         out_specs.append(pl.BlockSpec((1, block_q, 128),
                                       lambda b, i, j: (b, i, 0)))
     outs = pl.pallas_call(
@@ -303,7 +361,7 @@ def _flash_backward_pallas(q, k, v, g, out, lse, sm_scale, causal,
         functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q,
                           block_k=block_k),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_shape=_sds((bh, tq, d), q.dtype, q, k, v, g),
         grid=(bh, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, rspec, rspec],
         out_specs=qspec,
@@ -319,8 +377,8 @@ def _flash_backward_pallas(q, k, v, g, out, lse, sm_scale, causal,
         functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q,
                           block_k=block_k),
-        out_shape=(jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, tk, d), v.dtype)),
+        out_shape=(_sds((bh, tk, d), k.dtype, q, k, v, g),
+                   _sds((bh, tk, d), v.dtype, q, k, v, g)),
         grid=(bh, nk, nq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rspec2, rspec2],
         out_specs=(kspec2, kspec2),
@@ -470,7 +528,11 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
         # causal: k blocks past this q block's diagonal are all-masked
         nk_i = jnp.minimum((i * bq + bq - 1) // bk + 1, nk) \
             if causal else nk
-        acc0 = jnp.zeros((B, bq, D), jnp.float32)
+        # inside shard_map the carry must carry the same varying-
+        # manual-axes marking the body output has (see
+        # parallel.ring_attention._match_vma)
+        acc0 = _vma_like(jnp.zeros((B, bq, D), jnp.float32),
+                         q32, k32, v32, g32)
         return _, lax.fori_loop(0, nk_i, body, acc0)
 
     _, dq_blocks = lax.scan(dq_for_block, None, jnp.arange(nq))
@@ -496,7 +558,8 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
 
         # causal: q blocks before this k block's diagonal see none of it
         i0 = jnp.minimum((j * bk) // bq, nq) if causal else 0
-        z = jnp.zeros((B, bk, D), jnp.float32)
+        z = _vma_like(jnp.zeros((B, bk, D), jnp.float32),
+                      q32, k32, v32, g32)
         return _, lax.fori_loop(i0, nq, body, (z, z))
 
     _, (dk_blocks, dv_blocks) = lax.scan(dkv_for_block, None,

@@ -292,6 +292,23 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    import os
+
+    import jax
+
+    # degenerate ring (sp=1, e.g. a single chip or an sp-less mesh):
+    # no rotation to do — route square attention through the Pallas
+    # flash kernel (fwd + recompute bwd) when it is actually enabled.
+    # WITHOUT the kernel, stay on the custom-vjp ring (valid at
+    # sp_size=1: one step, identity permute): blockwise's jnp path is
+    # differentiated by JAX AD through its block loop, which stashes
+    # O(T^2/block) probability residuals — exactly the memory blowup
+    # this module's recompute backward exists to avoid.
+    if jax.lax.axis_size(axis_name) == 1 \
+            and os.environ.get("MXTPU_USE_PALLAS", "0") == "1" \
+            and q.shape[2] == k.shape[2]:
+        return blockwise_attention(q, k, v, causal=causal, scale=scale,
+                                   use_pallas=True)
     return _get_ring()(q, k, v, axis_name, bool(causal), float(scale))
 
 
