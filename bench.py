@@ -265,7 +265,6 @@ def run_transformer(iters=6, warmup=2, B=8, T=1024, d_model=1024,
 
         if not _use_pallas():
             raise RuntimeError("no pallas backend")
-        os.environ["MXTPU_USE_PALLAS"] = "1"
 
         def probe(x):
             def loss(x):
@@ -280,7 +279,10 @@ def run_transformer(iters=6, warmup=2, B=8, T=1024, d_model=1024,
         jax.block_until_ready(sm(x))
         used_pallas = True
     except Exception:
-        os.environ.pop("MXTPU_USE_PALLAS", None)
+        # kernel can't run here — flip the kill switch so the train
+        # step's automatic routing takes the jnp attention path
+        # instead of failing the same way and costing the whole row
+        os.environ["MXTPU_NO_PALLAS"] = "1"
 
     cfg = tf.TransformerConfig(vocab=vocab, d_model=d_model, n_heads=8,
                                n_layers=n_layers, d_ff=d_ff, max_len=T,

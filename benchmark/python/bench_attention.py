@@ -71,7 +71,6 @@ def main():
 
     b, h, d = args.batch, args.heads, args.head_dim
     dt = jnp.dtype(args.dtype)
-    on_tpu = jax.default_backend() not in ("cpu",)
 
     for t in [int(s) for s in args.seqs.split(",") if s]:
         rng = np.random.RandomState(t)
@@ -81,8 +80,8 @@ def main():
         sm = 1.0 / float(np.sqrt(d))
 
         paths = {}
-        if on_tpu or os.environ.get("MXTPU_USE_PALLAS") == "1":
-            os.environ["MXTPU_USE_PALLAS"] = "1"
+        if pa._use_pallas():
+            # flash_attention's routing is automatic on this backend
             paths["pallas_flash"] = functools.partial(
                 pa.flash_attention, causal=args.causal)
         ref = functools.partial(pa._reference_attention,
@@ -90,9 +89,6 @@ def main():
         paths["jnp_materialized"] = lambda q, k, v: ref(q, k, v)
 
         for name, fn in paths.items():
-            prev = os.environ.get("MXTPU_USE_PALLAS")
-            os.environ["MXTPU_USE_PALLAS"] = \
-                "1" if name == "pallas_flash" else "0"
             try:
                 fwd = jax.jit(fn)
 
@@ -114,11 +110,6 @@ def main():
             except Exception as e:
                 print(json.dumps({"path": name, "seq": t,
                                   "error": str(e)[:300]}))
-            finally:
-                if prev is None:
-                    os.environ.pop("MXTPU_USE_PALLAS", None)
-                else:
-                    os.environ["MXTPU_USE_PALLAS"] = prev
 
 
 if __name__ == "__main__":

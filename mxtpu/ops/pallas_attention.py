@@ -25,8 +25,8 @@ non-TPU fallback.
 
 Registered as `_contrib_flash_attention` (q, k, v of shape
 (batch, heads, seq, head_dim)).  `mxtpu.parallel`'s blockwise /
-ring attention can route its local-chunk compute here with
-MXTPU_USE_PALLAS=1.
+ring attention routes its local-chunk compute here automatically
+wherever the kernel backend exists (see `_use_pallas`).
 """
 from __future__ import annotations
 
@@ -95,10 +95,15 @@ def _sds(shape, dtype, *likes):
 
 
 def _use_pallas():
-    if os.environ.get("MXTPU_PALLAS_INTERPRET", "0") == "1":
-        return True
+    """THE authoritative kernel-availability predicate — the flash
+    entry, blockwise_attention's routing default, and ring_attention's
+    sp=1 shortcut all share it, so route and kernel can never disagree.
+    Precedence: MXTPU_NO_PALLAS=1 (kill switch) > interpret mode >
+    TPU-backend detection."""
     if os.environ.get("MXTPU_NO_PALLAS", "0") == "1":
         return False
+    if os.environ.get("MXTPU_PALLAS_INTERPRET", "0") == "1":
+        return True
     import jax
 
     try:

@@ -30,6 +30,17 @@ __all__ = ["ring_attention", "blockwise_attention", "ring_self_attention"]
 _NEG_INF = -1e30
 
 
+def _pallas_enabled() -> bool:
+    """Shared routing default — exactly flash_attention's own
+    kernel-availability predicate, so the router can never send work to
+    a kernel that won't engage (which would land in the dense jnp
+    reference and materialize the T×T score matrix).  Force the route
+    explicitly with ``use_pallas=True`` where needed (tests)."""
+    from ..ops.pallas_attention import _use_pallas
+
+    return _use_pallas()
+
+
 def _match_vma(x, like):
     """Mark `x` as varying over the manual mesh axes `like` varies over
     (required for lax loop carries under jax>=0.8 shard_map vma
@@ -83,12 +94,15 @@ def blockwise_attention(q, k, v, block_size: int = 512,
     sequence lengths the reference could not.
 
     `use_pallas` selects the Pallas flash kernel for the square
-    self-attention case; when None it falls back to the
-    ``MXTPU_USE_PALLAS`` env var.  Both paths accumulate in float32 and
-    return ``q.dtype``.  NOTE: the routing decision is STATIC — under
-    ``jit`` it is resolved once at trace time, so flipping the env var
-    after the first compiled call has no effect on cached executables
-    (pass ``use_pallas`` explicitly, or set the env var before tracing).
+    self-attention case; when None it auto-enables exactly where the
+    kernel backend exists (TPU, or ``MXTPU_PALLAS_INTERPRET=1``;
+    ``MXTPU_NO_PALLAS=1`` is the kill switch) — the same predicate
+    ``flash_attention`` itself gates on.  Both paths accumulate in
+    float32 and return ``q.dtype``.  NOTE: the routing decision is
+    STATIC — under ``jit`` it is resolved once at trace time, so
+    flipping the env vars after the first compiled call has no effect
+    on cached executables (pass ``use_pallas`` explicitly, or set the
+    env before tracing).
     """
     import jax
     import jax.numpy as jnp
@@ -96,13 +110,11 @@ def blockwise_attention(q, k, v, block_size: int = 512,
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
-    # opt-in Pallas kernel for the square self-attention case (the
-    # kernel's causal mask assumes aligned q/k positions; the decode
-    # and shard_map-collective paths keep the jnp formulation)
-    import os
-
+    # Pallas kernel for the square self-attention case (the kernel's
+    # causal mask assumes aligned q/k positions; the decode and
+    # shard_map-collective paths keep the jnp formulation)
     if use_pallas is None:
-        use_pallas = os.environ.get("MXTPU_USE_PALLAS", "0") == "1"
+        use_pallas = _pallas_enabled()
     if Tq == Tk and use_pallas:
         from ..ops.pallas_attention import flash_attention
 
@@ -292,8 +304,6 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    import os
-
     import jax
 
     # degenerate ring (sp=1, e.g. a single chip or an sp-less mesh):
@@ -304,8 +314,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     # differentiated by JAX AD through its block loop, which stashes
     # O(T^2/block) probability residuals — exactly the memory blowup
     # this module's recompute backward exists to avoid.
-    if jax.lax.axis_size(axis_name) == 1 \
-            and os.environ.get("MXTPU_USE_PALLAS", "0") == "1" \
+    if jax.lax.axis_size(axis_name) == 1 and _pallas_enabled() \
             and q.shape[2] == k.shape[2]:
         return blockwise_attention(q, k, v, causal=causal, scale=scale,
                                    use_pallas=True)

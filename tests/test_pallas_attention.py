@@ -86,9 +86,10 @@ def test_flash_gradients_match_reference():
                                    err_msg="d%s" % name)
 
 
-def test_blockwise_attention_pallas_route(monkeypatch):
-    """MXTPU_USE_PALLAS=1 routes square blockwise attention through the
-    kernel with identical numerics."""
+def test_blockwise_attention_pallas_route():
+    """The kernel route must match the jnp blockwise path's numerics —
+    forced via explicit use_pallas args so the baseline stays the jnp
+    loop whatever the ambient routing default resolves to."""
     import jax.numpy as jnp
 
     from mxtpu.parallel import blockwise_attention
@@ -97,10 +98,11 @@ def test_blockwise_attention_pallas_route(monkeypatch):
     q, k, v = (jnp.asarray(rng.normal(0, 1, (1, 2, 256, 32))
                            .astype(np.float32)) for _ in range(3))
     base = np.asarray(blockwise_attention(q, k, v, causal=True,
-                                          block_size=128))
-    monkeypatch.setenv("MXTPU_USE_PALLAS", "1")
+                                          block_size=128,
+                                          use_pallas=False))
     got = np.asarray(blockwise_attention(q, k, v, causal=True,
-                                         block_size=128))
+                                         block_size=128,
+                                         use_pallas=True))
     np.testing.assert_allclose(got, base, rtol=2e-4, atol=2e-5)
 
 
