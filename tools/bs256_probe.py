@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Probe large-batch bf16 ResNet-50 training throughput (bs256).
+
+The round-5 roofline analysis (BENCH_NOTES_r05.md) showed bf16 bs128
+training is HBM-bound at ~63% of the memory roofline; the remaining
+MFU lever is a bigger batch (better arithmetic intensity on the BN
+reduces and wgrad convs).  A first bs256 attempt died to an EXTERNAL
+shell timeout mid-compile and wedged the tunnel — this script instead
+runs with NO external kill (launch via `setsid nohup`), budgets
+internally, and always writes a JSON record to --out even on failure.
+
+Usage: setsid nohup python tools/bs256_probe.py \
+           --out /tmp/bs256_probe.json > /tmp/bs256_probe.log 2>&1 &
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("MXTPU_BENCH_SPP", "4")   # keep the data stack
+# small (4*256*3*224*224*4B fp32 staging buffer ~= 616 MB like bs128
+# spp=16) and the compiled program's live range moderate
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--out", default="/tmp/bs256_probe.json")
+    ap.add_argument("--budget-s", type=float, default=1800.0)
+    args = ap.parse_args()
+
+    rec = {"batch": args.batch, "dtype": args.dtype, "ok": False}
+    t_start = time.time()
+    try:
+        import bench
+        if bench._probe_tpu(timeout=100) != "ok":
+            rec["error"] = "tpu_not_usable"
+            raise SystemExit(0)
+        t0 = time.time()
+        ips, windows, _ = bench.run_config(args.batch, args.dtype)
+        rec.update(ok=True,
+                   imgs_per_sec=round(ips, 2),
+                   windows=[round(w, 1) for w in windows],
+                   mfu=bench._mfu(ips),
+                   total_s=round(time.time() - t0, 1),
+                   steps_per_program=bench.SPP)
+    except SystemExit:
+        pass
+    except BaseException as e:  # noqa: BLE001 — record, never re-raise
+        rec["error"] = "%s: %s" % (type(e).__name__, str(e)[:400])
+    rec["wall_s"] = round(time.time() - t_start, 1)
+    with open(args.out, "w") as f:
+        json.dump(rec, f)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
