@@ -231,7 +231,7 @@ def _mfu(ips):
     return round(ips * TRAIN_GFLOP_PER_IMG / (PEAK_TFLOPS * 1e3), 4)
 
 
-def run_transformer(iters=6, warmup=2, B=8, T=1024, d_model=1024,
+def run_transformer(iters=12, warmup=2, B=8, T=1024, d_model=1024,
                     n_layers=8, d_ff=4096, vocab=8192):
     """Second flagship metric: sharded-TransformerLM training tokens/s
     on one chip (1-device mesh — collectives elide; the SAME
@@ -297,7 +297,28 @@ def run_transformer(iters=6, warmup=2, B=8, T=1024, d_model=1024,
                           .astype(np.int32), sh["data"])
     for _ in range(warmup):
         params, opt, loss = step(params, opt, toks, labs)
-    jax.block_until_ready(loss)
+    # SYNC BY VALUE, not by buffer readiness: with donate_argnums every
+    # step output aliases a donated input, and (measured live, r5s3)
+    # block_until_ready on such aliased buffers can return BEFORE the
+    # execution finishes on the tunneled runtime — one bench run
+    # reported a fantasy 64M tokens/s that way.  A value fetch is a
+    # true data dependency; loss alone only pins the final forward
+    # pass, so ALSO fetch a scalar derived from the UPDATED params,
+    # which pins the last backward + optimizer update.  The two tiny
+    # transfers are amortized over the window and keep the number
+    # strictly conservative.
+    import jax.numpy as jnp
+
+    def _value_sync(params, loss):
+        lv = float(loss)
+        leaf = jax.tree_util.tree_leaves(params)[0]
+        float(jnp.ravel(leaf)[0])      # depends on the applied update
+        return lv
+
+    # the warmup drain must sync the same way, BEFORE the budget check
+    # — otherwise in-flight warmup work makes _budget_left() overstate
+    # what remains and the clamp below turns too generous
+    _value_sync(params, loss)
     # compile+warmup may have eaten the driver budget: shrink or bail
     # BEFORE the timed loop so the resnet JSON line always gets out
     # (the round-3 rc!=0-no-record failure mode)
@@ -307,8 +328,10 @@ def run_transformer(iters=6, warmup=2, B=8, T=1024, d_model=1024,
     t0 = time.perf_counter()
     for _ in range(iters):
         params, opt, loss = step(params, opt, toks, labs)
-    jax.block_until_ready(loss)
+    lv = _value_sync(params, loss)
     dt = time.perf_counter() - t0
+    if not np.isfinite(lv):
+        raise RuntimeError("transformer loss diverged: %r" % lv)
     tps = B * T * iters / dt
     # 6*N FLOP/token (fwd+bwd) + attention 12*L*d*T, causal-halved
     n_params = sum(int(np.prod(v.shape)) for v in params.values())

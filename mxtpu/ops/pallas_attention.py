@@ -261,8 +261,8 @@ def _bwd_p_ds(q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref, i, j, *,
     k = k_ref[0]
     v = v_ref[0]
     g = g_ref[0]
-    lse = lse_ref[0][:, None]          # (bq, 1)
-    dlt = dlt_ref[0][:, None]
+    lse = lse_ref[:]                   # (bq, 1) — bh dim is squeezed
+    dlt = dlt_ref[:]                   # by the None in its BlockSpec
     s = _dot_f32(q, k) * sm_scale
     if causal:
         q_idx = jnp.arange(block_q)[:, None] + i * block_q
@@ -351,16 +351,21 @@ def _flash_backward_pallas(q, k, v, g, out, lse, sm_scale, causal,
 
     bh, tq, d = q.shape
     tk = k.shape[1]
-    # (bh, tq) row vectors enter as (1, block_q) blocks — no
-    # lane-replication blow-up in HBM
+    # per-row residuals travel as (bh, tq, 1) columns: the bh dim is a
+    # squeezed (None) block dim, so Mosaic's (8,128) tiling check sees
+    # (block_q, 1) — sublanes divisible by 8, lane dim equal to the
+    # array's.  A (1, block_q) rank-2 block would fail that check
+    # whenever bh is neither 1 nor a multiple of 8.
     delta = (out.astype(jnp.float32) * g.astype(jnp.float32)) \
         .sum(axis=-1)
+    lse3 = lse[..., None]
+    delta3 = delta[..., None]
     nq = tq // block_q
     nk = tk // block_k
 
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
-    rspec = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    rspec = pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
@@ -372,12 +377,13 @@ def _flash_backward_pallas(q, k, v, g, out, lse, sm_scale, causal,
         out_specs=qspec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse3, delta3)
 
     # dkv grid: (bh, nk, nq) — q innermost; index maps swap (i, j)
     qspec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
     kspec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    rspec2 = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
+    rspec2 = pl.BlockSpec((None, block_q, 1),
+                          lambda b, j, i: (b, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q,
@@ -390,7 +396,7 @@ def _flash_backward_pallas(q, k, v, g, out, lse, sm_scale, causal,
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse3, delta3)
     return dq, dk, dv
 
 
