@@ -41,16 +41,60 @@ def attn_flops(b, h, t, d, causal, bwd):
     return f * (3.5 if bwd else 1.0)
 
 
-def run(fn, args, iters):
+def _value_sync(out):
+    """True data-dependency sync: fetch one element of every output
+    leaf.  Buffer-readiness events through the tunneled runtime are
+    unreliable after a pallas execution (measured r5s3 — they report
+    ready before the program finishes; see BENCH_NOTES_r05.md), so
+    block_until_ready is NOT a valid timing fence here; a value fetch
+    is, because the bytes must come from the finished computation."""
     import jax
+    import jax.numpy as jnp
 
+    for leaf in jax.tree_util.tree_leaves(out):
+        float(jnp.ravel(leaf)[0])
+
+
+def run(fn, args, iters, min_window_s=0.5, max_iters=1000):
+    """Differential timing: close a K-iteration and a 2K-iteration
+    window with the same value fetch; (t_2K - t_K)/K cancels both the
+    fetch's host round-trip and any constant per-window overhead.
+    Device programs execute in dispatch order, so fetching the last
+    output's value drains the whole window.
+
+    K auto-scales from a pilot window so the differential stays well
+    above the tunnel's RTT jitter (~10 ms) — with fast kernels a
+    fixed K makes (t_2K - t_K) - (t_K - t_0) pure noise (first fixed
+    run printed 0.0 ms / 1.5e8 TFLOPS rows for the short sequences)."""
     out = fn(*args)                      # compile
-    jax.block_until_ready(out)
+    _value_sync(out)
+    # fetch round-trip on an already-computed result: deducted from the
+    # pilot so K is sized by actual per-iter device time, not RTT
+    t0 = time.perf_counter()
+    _value_sync(out)
+    rtt = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    _value_sync(out)
+    pilot = max((time.perf_counter() - t0) - rtt, 1e-6 * iters) / iters
+    k = int(min(max(iters, min_window_s / max(pilot, 1e-7)), max_iters))
+    t0 = time.perf_counter()
+    for _ in range(k):
+        out = fn(*args)
+    _value_sync(out)
+    t1 = time.perf_counter()
+    for _ in range(2 * k):
+        out = fn(*args)
+    _value_sync(out)
+    t2 = time.perf_counter()
+    diff = (t2 - t1) - (t1 - t0)
+    if diff <= 0:
+        # window smaller than the RTT jitter even at max_iters: there
+        # is no honest number here — report it as such rather than
+        # flooring to an absurd TFLOPS row
+        return float("nan")
+    return diff / k
 
 
 def main():
@@ -59,7 +103,7 @@ def main():
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--head-dim", type=int, default=128)
     ap.add_argument("--seqs", default="512,1024,2048,4096,8192")
-    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--causal", action="store_true", default=True)
     args = ap.parse_args()
@@ -99,6 +143,12 @@ def main():
                 t_f = run(fwd, (q, k, v), args.iters)
                 t_b = run(fwdbwd, (q, k, v), args.iters)
                 for mode, tt in (("fwd", t_f), ("fwd+bwd", t_b)):
+                    if tt != tt:       # NaN: noise-dominated window
+                        print(json.dumps({
+                            "path": name, "seq": t, "mode": mode,
+                            "error": "window below RTT jitter even at "
+                                     "max_iters; no honest number"}))
+                        continue
                     fl = attn_flops(1, b * h, t, d, args.causal,
                                     mode != "fwd")
                     print(json.dumps({

@@ -583,12 +583,19 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, sm_scale=None, causal=False, block_q=128,
-                    block_k=128):
+def flash_attention(q, k, v, sm_scale=None, causal=False, block_q=512,
+                    block_k=512):
     """Multi-head attention, flash-style.
 
     q/k/v: (batch, heads, seq, head_dim) or (batch*heads, seq,
     head_dim).  Returns the same layout as the input.
+
+    Default 512x512 blocks: measured on chip (r5s3 sweep, d=128
+    bf16 causal fwd+bwd) they run 63-70 TFLOPS vs 12-14 at the old
+    128x128 — small blocks pay Mosaic per-grid-step overhead on
+    ~2 MFLOP matmuls and re-stream K/V tiles 4x as often.  Blocks
+    are clamped to the sequence lengths below, so short-sequence and
+    unit-test shapes are unaffected.
     """
     import jax.numpy as jnp
 
@@ -600,9 +607,19 @@ def flash_attention(q, k, v, sm_scale=None, causal=False, block_q=128,
         v = v.reshape(b * h, v.shape[2], d)
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
-    # clamp blocks to the sequence lengths (tiny test shapes)
-    block_q = int(min(block_q, q.shape[1]))
-    block_k = int(min(block_k, k.shape[1]))
+    # fit blocks to the sequence lengths: clamp, then halve (512 ->
+    # 256 -> 128) until the block divides the sequence — a seq like
+    # 640 or 6784 must keep the kernel at a smaller block rather than
+    # silently falling to the materializing reference path (whose
+    # (T, T) score tensor is exactly what flash exists to avoid)
+    def _fit(block, t):
+        b = int(min(block, t))
+        while b > 128 and t % b:
+            b //= 2
+        return b
+
+    block_q = _fit(block_q, q.shape[1])
+    block_k = _fit(block_k, k.shape[1])
     out = _flash(q, k, v, float(sm_scale), bool(causal), block_q,
                  block_k)
     if squeeze4:
@@ -612,7 +629,7 @@ def flash_attention(q, k, v, sm_scale=None, causal=False, block_q=128,
 
 @register("_contrib_flash_attention")
 def _contrib_flash_attention(q, k, v, sm_scale=None, causal=False,
-                             block_q=128, block_k=128):
+                             block_q=512, block_k=512):
     """Flash attention op over (batch, heads, seq, head_dim) inputs
     (kernel above; reference has no analog — attention in MXNet 1.5 is
     composed from batch_dot/softmax, which materializes the score

@@ -118,8 +118,11 @@ def blockwise_attention(q, k, v, block_size: int = 512,
     if Tq == Tk and use_pallas:
         from ..ops.pallas_attention import flash_attention
 
+        # pass BOTH blocks so the kernel's q tiling follows the
+        # caller's block_size too — a default bigger than the local
+        # shard would pad q and trip the backward's divisibility gate
         return flash_attention(q, k, v, sm_scale=scale, causal=causal,
-                               block_k=block_size)
+                               block_q=block_size, block_k=block_size)
     block_size = min(block_size, Tk)
     n_blocks = (Tk + block_size - 1) // block_size
     pad = n_blocks * block_size - Tk
