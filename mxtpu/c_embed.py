@@ -526,3 +526,206 @@ def profiler_aggregate_stats(reset: int) -> str:
     from mxtpu import profiler
 
     return profiler.dumps(reset=bool(reset))
+
+
+# -- NDArray views / serialization widening (r5s3; reference
+#    c_api.h: MXNDArrayReshape/Slice/At/Detach/GetStorageType/
+#    SaveRawBytes/LoadFromRawBytes/LoadFromBuffer/SyncCopyFromNDArray/
+#    WaitToRead/WaitToWrite/CreateNone/Get+SetGradState) ------------------
+
+_STYPE_CODES = {"undefined": -1, "default": 0, "row_sparse": 1, "csr": 2}
+
+
+def nd_create_none():
+    """MXNDArrayCreateNone: a placeholder handle (reference returns an
+    empty NDArray; here a zero-size f32 vector on cpu)."""
+    mx = _mx()
+    return mx.nd.zeros((0,))
+
+
+def nd_reshape(arr, shape):
+    """MXNDArrayReshape/Reshape64 (supports -1 wildcard like the
+    reference's TShape inference)."""
+    return arr.reshape(tuple(int(s) for s in shape))
+
+
+def nd_slice(arr, begin: int, end: int):
+    """MXNDArraySlice: axis-0 contiguous range.  XLA arrays are
+    immutable values, so unlike the reference this is a copy, not an
+    aliasing view — documented divergence (docs/c_api.md)."""
+    return arr[int(begin):int(end)]
+
+
+def nd_at(arr, idx: int):
+    """MXNDArrayAt: axis-0 single index (rank reduces by one)."""
+    return arr[int(idx)]
+
+
+def nd_detach(arr):
+    return arr.detach()
+
+
+def nd_storage_type(arr) -> int:
+    return _STYPE_CODES.get(getattr(arr, "stype", "default"), -1)
+
+
+def nd_wait_to_read(arr) -> None:
+    arr.wait_to_read()
+
+
+def nd_wait_to_write(arr) -> None:
+    # PJRT buffers are immutable; every write makes a new buffer, so
+    # write-readiness == read-readiness of the current value
+    arr.wait_to_read()
+
+
+_FRESH_GRAD: dict = {}  # id(arr) -> bool; entries die with the array
+
+
+def nd_grad_state(arr) -> int:
+    """MXNDArrayGetGradState: the reference's fresh_out_grad bit —
+    frontend bookkeeping for 'grad was just written by backward',
+    NOT the requires-grad/taping flag (touching `_marked` here would
+    silently enable/disable autograd tracking).  Kept in an
+    identity-keyed side table like the reference keeps `_fresh_grad`
+    on the Python object (a WeakKeyDictionary would compare keys with
+    NDArray's elementwise `__eq__`)."""
+    return 1 if _FRESH_GRAD.get(id(arr)) else 0
+
+
+def nd_set_grad_state(arr, state: int) -> None:
+    import weakref
+
+    key = id(arr)
+    if key not in _FRESH_GRAD:
+        weakref.finalize(arr, _FRESH_GRAD.pop, key, None)
+    _FRESH_GRAD[key] = bool(state)
+
+
+def nd_save_raw_bytes(arr) -> bytes:
+    """MXNDArraySaveRawBytes: self-describing single-array payload
+    (the same container nd.save uses, so it round-trips with
+    LoadFromRawBytes across processes)."""
+    import io
+
+    mx = _mx()
+    buf = io.BytesIO()
+    mx.nd.save(buf, [arr])
+    return buf.getvalue()
+
+
+def nd_load_from_raw_bytes(data: bytes):
+    """MXNDArrayLoadFromRawBytes: inverse of nd_save_raw_bytes."""
+    arrays, _ = _load_from_bytes(data)
+    if len(arrays) != 1:
+        raise ValueError("raw-bytes payload holds %d arrays, expected 1"
+                         % len(arrays))
+    return arrays[0]
+
+
+def _load_from_bytes(data: bytes):
+    import io
+
+    mx = _mx()
+    loaded = mx.nd.load(io.BytesIO(bytes(data)))
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        return [loaded[k] for k in names], names
+    return list(loaded), []
+
+
+def nd_load_from_buffer(data: bytes):
+    """MXNDArrayLoadFromBuffer -> (arrays, names): in-memory analog of
+    MXNDArrayLoad (same container format)."""
+    return _load_from_bytes(data)
+
+
+def nd_sync_copy_from_ndarray(dst, src) -> None:
+    """MXNDArraySyncCopyFromNDArray: dst[:] = src (shape/dtype adapt
+    follows the reference's CopyFromTo semantics: shapes must match)."""
+    if tuple(dst.shape) != tuple(src.shape):
+        raise ValueError(
+            "MXNDArraySyncCopyFromNDArray: shape mismatch %r vs %r"
+            % (tuple(src.shape), tuple(dst.shape)))
+    src.copyto(dst)
+    dst.wait_to_read()
+
+
+# -- RecordIO (reference MXRecordIOReader*/Writer*; backed by the same
+#    wire-compatible mxtpu.recordio used from Python) ----------------------
+
+def recordio_writer_create(path: str):
+    from mxtpu import recordio
+
+    return recordio.MXRecordIO(path, "w")
+
+
+def recordio_reader_create(path: str):
+    from mxtpu import recordio
+
+    return recordio.MXRecordIO(path, "r")
+
+
+def recordio_write(rec, data: bytes) -> None:
+    rec.write(bytes(data))
+
+
+def recordio_read(rec):
+    """Returns the next record's bytes, or None at EOF (the C shim maps
+    None to size=0, the reference's EOF convention)."""
+    return rec.read()
+
+
+def recordio_tell(rec) -> int:
+    return int(rec.tell())
+
+
+def recordio_seek(rec, pos: int) -> None:
+    rec.seek(int(pos))
+
+
+def recordio_close(rec) -> None:
+    rec.close()
+
+
+# -- KVStore role/config queries (reference MXKVStoreGetType/
+#    GetNumDeadNode/IsWorkerNode/IsServerNode/IsSchedulerNode/
+#    SetGradientCompression) ----------------------------------------------
+
+def kv_type(kv) -> str:
+    return str(kv.type)
+
+
+def kv_num_dead_node(kv, node_id: int) -> int:
+    if hasattr(kv, "get_num_dead_node"):
+        return int(kv.get_num_dead_node(node_id))
+    return 0
+
+
+def kv_role() -> str:
+    """Node role from the PS env (reference: role env var drives
+    MXKVStoreIs{Worker,Server,Scheduler}Node)."""
+    import os
+
+    return os.environ.get("DMLC_ROLE", "worker")
+
+
+def kv_set_gradient_compression(kv, keys, vals) -> None:
+    kv.set_gradient_compression(dict(zip(keys, vals)))
+
+
+# -- misc (reference MXGetGPUCount/MXEngineSetBulkSize) --------------------
+
+def accelerator_count() -> int:
+    """MXGetGPUCount analog: number of accelerator devices (TPU here)."""
+    mx = _mx()
+    return int(mx.num_tpus())
+
+
+def engine_set_bulk_size(size: int) -> int:
+    """MXEngineSetBulkSize: XLA fuses whole programs, so bulking is a
+    no-op here; accept and echo the previous value for ABI parity."""
+    global _BULK_SIZE
+    prev = globals().get("_BULK_SIZE", 0)
+    _BULK_SIZE = int(size)
+    return int(prev)

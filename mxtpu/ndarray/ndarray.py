@@ -798,7 +798,9 @@ def waitall():
 #    python `ndarray/utils.py:149-222`; format here is npz, not the
 #    reference binary layout — same API, container swapped) ----------------
 
-def save(fname: str, data):
+def save(fname, data):
+    """`fname` may be a path or a writable binary file object (the C
+    ABI's MXNDArraySaveRawBytes serializes through a BytesIO)."""
     if isinstance(data, NDArray):
         payload = {"0": data.asnumpy()}
         keys = None
@@ -810,12 +812,17 @@ def save(fname: str, data):
         keys = list(data.keys())
     else:
         raise TypeError("unsupported data for save: %r" % type(data))
-    with open(fname, "wb") as f:
-        np.savez(f, __keys__=np.array(keys if keys is not None else [],
-                                      dtype=object), **payload)
+    kw = dict(__keys__=np.array(keys if keys is not None else [],
+                                dtype=object), **payload)
+    if hasattr(fname, "write"):
+        np.savez(fname, **kw)
+    else:
+        with open(fname, "wb") as f:
+            np.savez(f, **kw)
 
 
-def load(fname: str):
+def load(fname):
+    """`fname` may be a path or a readable binary file object."""
     with np.load(fname, allow_pickle=True) as zf:
         keys = list(zf["__keys__"]) if "__keys__" in zf else []
         names = [k for k in zf.files if k != "__keys__"]

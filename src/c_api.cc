@@ -969,4 +969,453 @@ int MXDataIterGetLabel(void* handle, void** out) {
   return iter_get("iter_label", handle, out);
 }
 
+
+/* ================= r5s3 widening tier =================================
+ * NDArray views/serialization, RecordIO, KVStore role/config queries,
+ * and engine/device misc — the next-most-used reference groups after
+ * the core tier above (reference include/mxnet/c_api.h).  Same
+ * embedded-CPython architecture; handles remain opaque PyObject*s. */
+
+/* ---- NDArray views ---------------------------------------------------- */
+
+static int nd_unary_to_handle(const char* fn, void* handle, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call(fn, args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res; /* caller frees with MXNDArrayFree */
+  return 0;
+}
+
+int MXNDArrayCreateNone(void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* res = embed_call("nd_create_none", nullptr);
+  if (!res) return fail();
+  *out = res;
+  return 0;
+}
+
+static int nd_reshape_impl(void* handle, int ndim, const int64_t* dims,
+                           void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* shp = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyList_SetItem(shp, i, PyLong_FromLongLong(dims[i]));
+  PyObject* args = Py_BuildValue("(OO)", static_cast<PyObject*>(handle),
+                                 shp);
+  Py_DECREF(shp);
+  PyObject* res = embed_call("nd_reshape", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res;
+  return 0;
+}
+
+int MXNDArrayReshape(void* handle, int ndim, int* dims, void** out) {
+  std::vector<int64_t> d(dims, dims + ndim);
+  return nd_reshape_impl(handle, ndim, d.data(), out);
+}
+
+int MXNDArrayReshape64(void* handle, int ndim, int64_t* dims,
+                       bool reverse, void** out) {
+  if (reverse) {
+    /* the reference's right-to-left wildcard inference; not carried
+     * over — reject loudly rather than mis-shape silently */
+    set_error("MXNDArrayReshape64: reverse=true is not supported");
+    return fail();
+  }
+  return nd_reshape_impl(handle, ndim, dims, out);
+}
+
+int MXNDArraySlice(void* handle, uint32_t begin, uint32_t end,
+                   void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(OII)", static_cast<PyObject*>(handle),
+                                 begin, end);
+  PyObject* res = embed_call("nd_slice", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res;
+  return 0;
+}
+
+int MXNDArrayAt(void* handle, uint32_t idx, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(OI)", static_cast<PyObject*>(handle),
+                                 idx);
+  PyObject* res = embed_call("nd_at", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res;
+  return 0;
+}
+
+int MXNDArrayDetach(void* handle, void** out) {
+  return nd_unary_to_handle("nd_detach", handle, out);
+}
+
+int MXNDArrayGetStorageType(void* handle, int* out_stype) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("nd_storage_type", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out_stype = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+static int nd_void_call(const char* fn, void* handle) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call(fn, args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(void* handle) {
+  return nd_void_call("nd_wait_to_read", handle);
+}
+
+int MXNDArrayWaitToWrite(void* handle) {
+  return nd_void_call("nd_wait_to_write", handle);
+}
+
+int MXNDArrayGetGradState(void* handle, int* out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("nd_grad_state", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySetGradState(void* handle, int state) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(handle),
+                                 state);
+  PyObject* res = embed_call("nd_set_grad_state", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromNDArray(void* dst, void* src, int i) {
+  if (i != -1) {
+    set_error("MXNDArraySyncCopyFromNDArray: aux-index copies (i>=0) "
+              "apply to the reference sparse aux layout; use the "
+              "sparse pull path instead");
+    return fail();
+  }
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(OO)", static_cast<PyObject*>(dst),
+                                 static_cast<PyObject*>(src));
+  PyObject* res = embed_call("nd_sync_copy_from_ndarray", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---- NDArray raw-bytes serialization ---------------------------------- */
+
+static std::string g_raw_store;  /* valid until next SaveRawBytes */
+
+int MXNDArraySaveRawBytes(void* handle, size_t* out_size,
+                          const char** out_buf) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("nd_save_raw_bytes", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &n) != 0) {
+    Py_DECREF(res);
+    set_error_from_python();
+    return fail();
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_buf_mu);
+    g_raw_store.assign(buf, static_cast<size_t>(n));
+    *out_size = g_raw_store.size();
+    *out_buf = g_raw_store.data();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void* buf, size_t size, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* payload = PyBytes_FromStringAndSize(
+      static_cast<const char*>(buf), static_cast<Py_ssize_t>(size));
+  PyObject* args = Py_BuildValue("(O)", payload);
+  Py_DECREF(payload);
+  PyObject* res = embed_call("nd_load_from_raw_bytes", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res;
+  return 0;
+}
+
+int MXNDArrayLoadFromBuffer(const void* buf, size_t size,
+                            uint32_t* out_size, void*** out_arr,
+                            uint32_t* out_name_size,
+                            const char*** out_names) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* payload = PyBytes_FromStringAndSize(
+      static_cast<const char*>(buf), static_cast<Py_ssize_t>(size));
+  PyObject* args = Py_BuildValue("(O)", payload);
+  Py_DECREF(payload);
+  PyObject* res = embed_call("nd_load_from_buffer", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  PyObject* arrays = PyTuple_GetItem(res, 0);
+  PyObject* names = PyTuple_GetItem(res, 1);
+  export_handles(arrays, &g_load_store, out_size, out_arr);
+  int rc = export_names(names, &g_load_names, out_name_size, out_names);
+  Py_DECREF(res);
+  return rc;
+}
+
+/* ---- RecordIO --------------------------------------------------------- */
+
+static std::string g_rec_store;  /* valid until next ReadRecord */
+
+static int rec_create(const char* fn, const char* uri, void** out) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(s)", uri);
+  PyObject* res = embed_call(fn, args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *out = res;
+  return 0;
+}
+
+static int rec_close_free(void* handle) {
+  if (!handle) return 0;
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("recordio_close", args);
+  Py_DECREF(args);
+  Py_XDECREF(res);
+  Py_DECREF(static_cast<PyObject*>(handle));
+  return res ? 0 : fail();
+}
+
+int MXRecordIOWriterCreate(const char* uri, void** out) {
+  return rec_create("recordio_writer_create", uri, out);
+}
+
+int MXRecordIOWriterFree(void* handle) { return rec_close_free(handle); }
+
+int MXRecordIOWriterWriteRecord(void* handle, const char* buf,
+                                size_t size) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* payload = PyBytes_FromStringAndSize(
+      buf, static_cast<Py_ssize_t>(size));
+  PyObject* args = Py_BuildValue("(OO)", static_cast<PyObject*>(handle),
+                                 payload);
+  Py_DECREF(payload);
+  PyObject* res = embed_call("recordio_write", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+static int rec_tell(void* handle, size_t* pos) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("recordio_tell", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *pos = static_cast<size_t>(PyLong_AsUnsignedLongLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOWriterTell(void* handle, size_t* pos) {
+  return rec_tell(handle, pos);
+}
+
+int MXRecordIOReaderCreate(const char* uri, void** out) {
+  return rec_create("recordio_reader_create", uri, out);
+}
+
+int MXRecordIOReaderFree(void* handle) { return rec_close_free(handle); }
+
+int MXRecordIOReaderReadRecord(void* handle, const char** out_buf,
+                               size_t* size) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("recordio_read", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  if (res == Py_None) {           /* EOF: reference convention */
+    Py_DECREF(res);
+    *out_buf = nullptr;
+    *size = 0;
+    return 0;
+  }
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &n) != 0) {
+    Py_DECREF(res);
+    set_error_from_python();
+    return fail();
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_buf_mu);
+    g_rec_store.assign(buf, static_cast<size_t>(n));
+    *out_buf = g_rec_store.data();
+    *size = g_rec_store.size();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOReaderSeek(void* handle, size_t pos) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(OK)", static_cast<PyObject*>(handle),
+                                 static_cast<unsigned long long>(pos));
+  PyObject* res = embed_call("recordio_seek", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOReaderTell(void* handle, size_t* pos) {
+  return rec_tell(handle, pos);
+}
+
+/* ---- KVStore role/config queries -------------------------------------- */
+
+static std::string g_kv_type_store;
+
+int MXKVStoreGetType(void* handle, const char** out_type) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("kv_type", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  const char* s = PyUnicode_AsUTF8(res);
+  {
+    std::lock_guard<std::mutex> lk(g_buf_mu);
+    g_kv_type_store = s ? s : "";
+    *out_type = g_kv_type_store.c_str();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreGetNumDeadNode(void* handle, int node_id, int* number) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(handle),
+                                 node_id);
+  PyObject* res = embed_call("kv_num_dead_node", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *number = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+static int kv_role_is(const char* role, int* ret) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* res = embed_call("kv_role", nullptr);
+  if (!res) return fail();
+  const char* s = PyUnicode_AsUTF8(res);
+  *ret = (s && std::string(s) == role) ? 1 : 0;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreIsWorkerNode(int* ret) { return kv_role_is("worker", ret); }
+int MXKVStoreIsServerNode(int* ret) { return kv_role_is("server", ret); }
+int MXKVStoreIsSchedulerNode(int* ret) {
+  return kv_role_is("scheduler", ret);
+}
+
+int MXKVStoreSetGradientCompression(void* handle, uint32_t num_params,
+                                    const char** keys,
+                                    const char** vals) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* k = str_list(keys, num_params);
+  PyObject* v = str_list(vals, num_params);
+  PyObject* args = Py_BuildValue("(OOO)", static_cast<PyObject*>(handle),
+                                 k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  PyObject* res = embed_call("kv_set_gradient_compression", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---- engine / device misc --------------------------------------------- */
+
+int MXGetGPUCount(int* out) {
+  /* reference counts CUDA devices; the accelerator here is the TPU */
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* res = embed_call("accelerator_count", nullptr);
+  if (!res) return fail();
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXEngineSetBulkSize(int bulk_size, int* prev_bulk_size) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(i)", bulk_size);
+  PyObject* res = embed_call("engine_set_bulk_size", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *prev_bulk_size = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRandomSeedContext(int seed, int dev_type, int dev_id) {
+  /* one counter-based PRNG stream per process: context scoping
+   * collapses to the global seed (dev args kept for ABI parity) */
+  (void)dev_type;
+  (void)dev_id;
+  return MXRandomSeed(seed);
+}
+
 }  // extern "C"
+

@@ -81,6 +81,42 @@ extern int MXSetProfilerState(int);
 extern int MXDumpProfile(int);
 extern int MXAggregateProfileStatsPrint(const char**, int);
 
+extern int MXNDArrayCreateNone(void**);
+extern int MXNDArrayReshape(void*, int, int*, void**);
+extern int MXNDArrayReshape64(void*, int, int64_t*, _Bool, void**);
+extern int MXNDArraySlice(void*, uint32_t, uint32_t, void**);
+extern int MXNDArrayAt(void*, uint32_t, void**);
+extern int MXNDArrayDetach(void*, void**);
+extern int MXNDArrayGetStorageType(void*, int*);
+extern int MXNDArrayWaitToRead(void*);
+extern int MXNDArrayWaitToWrite(void*);
+extern int MXNDArrayGetGradState(void*, int*);
+extern int MXNDArraySetGradState(void*, int);
+extern int MXNDArraySyncCopyFromNDArray(void*, void*, int);
+extern int MXNDArraySaveRawBytes(void*, size_t*, const char**);
+extern int MXNDArrayLoadFromRawBytes(const void*, size_t, void**);
+extern int MXNDArrayLoadFromBuffer(const void*, size_t, uint32_t*, void***,
+                                   uint32_t*, const char***);
+extern int MXRecordIOWriterCreate(const char*, void**);
+extern int MXRecordIOWriterFree(void*);
+extern int MXRecordIOWriterWriteRecord(void*, const char*, size_t);
+extern int MXRecordIOWriterTell(void*, size_t*);
+extern int MXRecordIOReaderCreate(const char*, void**);
+extern int MXRecordIOReaderFree(void*);
+extern int MXRecordIOReaderReadRecord(void*, const char**, size_t*);
+extern int MXRecordIOReaderSeek(void*, size_t);
+extern int MXRecordIOReaderTell(void*, size_t*);
+extern int MXKVStoreGetType(void*, const char**);
+extern int MXKVStoreGetNumDeadNode(void*, int, int*);
+extern int MXKVStoreIsWorkerNode(int*);
+extern int MXKVStoreIsServerNode(int*);
+extern int MXKVStoreIsSchedulerNode(int*);
+extern int MXKVStoreSetGradientCompression(void*, uint32_t, const char**,
+                                           const char**);
+extern int MXGetGPUCount(int*);
+extern int MXEngineSetBulkSize(int, int*);
+extern int MXRandomSeedContext(int, int, int);
+
 #define CHECK(cond)                                                   \
   do {                                                                \
     if (!(cond)) {                                                    \
@@ -370,6 +406,132 @@ int main(int argc, char** argv) {
     CHECK(strstr(stats, "elemwise_add") != NULL);
     CHECK(MXDumpProfile(1) == 0);
     printf("group:profiler ok\n");
+  }
+
+  /* -- r5s3 widening: NDArray views + raw-bytes serialization -- */
+  {
+    void* none = NULL;
+    CHECK(MXNDArrayCreateNone(&none) == 0 && none != NULL);
+    CHECK(MXNDArrayFree(none) == 0);
+
+    int dims[2] = {3, 2};
+    void* rsh = NULL;
+    CHECK(MXNDArrayReshape(a, 2, dims, &rsh) == 0);
+    uint32_t rn = 0; const uint32_t* rs = NULL;
+    CHECK(MXNDArrayGetShape(rsh, &rn, &rs) == 0);
+    CHECK(rn == 2 && rs[0] == 3 && rs[1] == 2);
+    int64_t dims64[1] = {-1};
+    void* flat = NULL;
+    CHECK(MXNDArrayReshape64(a, 1, dims64, 0, &flat) == 0);
+    CHECK(MXNDArrayGetShape(flat, &rn, &rs) == 0);
+    CHECK(rn == 1 && rs[0] == 6);
+    CHECK(MXNDArrayReshape64(a, 1, dims64, 1, &flat) != 0); /* reverse */
+
+    void* row = NULL;
+    CHECK(MXNDArraySlice(a, 1, 2, &row) == 0);
+    CHECK(MXNDArrayGetShape(row, &rn, &rs) == 0);
+    CHECK(rn == 2 && rs[0] == 1 && rs[1] == 3);
+    float rowv[3];
+    CHECK(MXNDArraySyncCopyToCPU(row, rowv, 3) == 0);
+    CHECK(rowv[0] == 4.0f && rowv[2] == 6.0f);
+
+    void* at1 = NULL;
+    CHECK(MXNDArrayAt(a, 0, &at1) == 0);
+    CHECK(MXNDArrayGetShape(at1, &rn, &rs) == 0);
+    CHECK(rn == 1 && rs[0] == 3);
+
+    void* det = NULL;
+    CHECK(MXNDArrayDetach(a, &det) == 0);
+    int stype = -2;
+    CHECK(MXNDArrayGetStorageType(det, &stype) == 0 && stype == 0);
+    CHECK(MXNDArrayWaitToRead(a) == 0);
+    CHECK(MXNDArrayWaitToWrite(a) == 0);
+    int gs = -1;
+    CHECK(MXNDArraySetGradState(a, 1) == 0);
+    CHECK(MXNDArrayGetGradState(a, &gs) == 0 && gs == 1);
+    CHECK(MXNDArraySetGradState(a, 0) == 0);
+
+    size_t raw_n = 0;
+    const char* raw = NULL;
+    CHECK(MXNDArraySaveRawBytes(a, &raw_n, &raw) == 0);
+    CHECK(raw_n > 0 && raw != NULL);
+    void* back_arr = NULL;
+    CHECK(MXNDArrayLoadFromRawBytes(raw, raw_n, &back_arr) == 0);
+    float rb[6];
+    CHECK(MXNDArraySyncCopyToCPU(back_arr, rb, 6) == 0);
+    for (int i = 0; i < 6; ++i) CHECK(rb[i] == data[i]);
+    uint32_t nb = 0, nn = 0;
+    void** barr = NULL;
+    const char** bnames = NULL;
+    CHECK(MXNDArrayLoadFromBuffer(raw, raw_n, &nb, &barr, &nn,
+                                  &bnames) == 0);
+    CHECK(nb == 1);
+    void* copy_dst = NULL;
+    CHECK(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &copy_dst) == 0);
+    CHECK(MXNDArraySyncCopyFromNDArray(copy_dst, back_arr, -1) == 0);
+    CHECK(MXNDArraySyncCopyToCPU(copy_dst, rb, 6) == 0);
+    CHECK(rb[5] == 6.0f);
+    CHECK(MXNDArraySyncCopyFromNDArray(copy_dst, back_arr, 0) != 0);
+    MXNDArrayFree(barr[0]);
+    MXNDArrayFree(copy_dst); MXNDArrayFree(back_arr);
+    MXNDArrayFree(det); MXNDArrayFree(at1); MXNDArrayFree(row);
+    MXNDArrayFree(flat); MXNDArrayFree(rsh);
+    printf("group:ndarray-views ok\n");
+  }
+
+  /* -- r5s3 widening: RecordIO round trip -- */
+  {
+    char rec_path[512];
+    snprintf(rec_path, sizeof rec_path, "%s.rec", argv[2]);
+    void* wr = NULL;
+    CHECK(MXRecordIOWriterCreate(rec_path, &wr) == 0);
+    CHECK(MXRecordIOWriterWriteRecord(wr, "hello", 5) == 0);
+    size_t wpos = 0;
+    CHECK(MXRecordIOWriterTell(wr, &wpos) == 0);
+    CHECK(MXRecordIOWriterWriteRecord(wr, "worlds", 6) == 0);
+    CHECK(MXRecordIOWriterFree(wr) == 0);
+
+    void* rd = NULL;
+    CHECK(MXRecordIOReaderCreate(rec_path, &rd) == 0);
+    const char* rec = NULL;
+    size_t rec_n = 0;
+    CHECK(MXRecordIOReaderReadRecord(rd, &rec, &rec_n) == 0);
+    CHECK(rec_n == 5 && memcmp(rec, "hello", 5) == 0);
+    size_t rpos = 0;
+    CHECK(MXRecordIOReaderTell(rd, &rpos) == 0);
+    CHECK(rpos == wpos);
+    CHECK(MXRecordIOReaderReadRecord(rd, &rec, &rec_n) == 0);
+    CHECK(rec_n == 6 && memcmp(rec, "worlds", 6) == 0);
+    CHECK(MXRecordIOReaderReadRecord(rd, &rec, &rec_n) == 0);
+    CHECK(rec_n == 0); /* EOF */
+    CHECK(MXRecordIOReaderSeek(rd, wpos) == 0);
+    CHECK(MXRecordIOReaderReadRecord(rd, &rec, &rec_n) == 0);
+    CHECK(rec_n == 6 && memcmp(rec, "worlds", 6) == 0);
+    CHECK(MXRecordIOReaderFree(rd) == 0);
+    printf("group:recordio ok\n");
+  }
+
+  /* -- r5s3 widening: KVStore queries + misc -- */
+  {
+    const char* kvt = NULL;
+    CHECK(MXKVStoreGetType(kv, &kvt) == 0);
+    CHECK(strcmp(kvt, "local") == 0);
+    int dead = -1;
+    CHECK(MXKVStoreGetNumDeadNode(kv, 0, &dead) == 0 && dead == 0);
+    int is_w = 0, is_s = 1, is_c = 1;
+    CHECK(MXKVStoreIsWorkerNode(&is_w) == 0 && is_w == 1);
+    CHECK(MXKVStoreIsServerNode(&is_s) == 0 && is_s == 0);
+    CHECK(MXKVStoreIsSchedulerNode(&is_c) == 0 && is_c == 0);
+    const char* gck[2] = {"type", "threshold"};
+    const char* gcv[2] = {"2bit", "0.5"};
+    CHECK(MXKVStoreSetGradientCompression(kv, 2, gck, gcv) == 0);
+    int ngpu = -1;
+    CHECK(MXGetGPUCount(&ngpu) == 0 && ngpu >= 0);
+    int prev = -1;
+    CHECK(MXEngineSetBulkSize(16, &prev) == 0 && prev == 0);
+    CHECK(MXEngineSetBulkSize(0, &prev) == 0 && prev == 16);
+    CHECK(MXRandomSeedContext(11, 1, 0) == 0);
+    printf("group:widening-misc ok ngpu=%d\n", ngpu);
   }
 
   CHECK(MXNDArrayWaitAll() == 0);

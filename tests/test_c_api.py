@@ -59,7 +59,8 @@ def test_c_api_all_groups(tmp_path):
     assert res.returncode == 0, res.stdout + res.stderr
     for group in ("runtime", "oplist", "ndarray", "invoke", "saveload",
                   "kvstore", "dataiter", "autograd", "symexec",
-                  "profiler"):
+                  "profiler", "ndarray-views", "recordio",
+                  "widening-misc"):
         assert ("group:%s ok" % group) in res.stdout, res.stdout
     assert "ALL-GROUPS-OK" in res.stdout, res.stdout
     assert profile_json.exists()  # chrome trace landed at the argv path
