@@ -729,3 +729,58 @@ def engine_set_bulk_size(size: int) -> int:
     prev = globals().get("_BULK_SIZE", 0)
     _BULK_SIZE = int(size)
     return int(prev)
+
+
+# -- DataIter extras / autograd ex (r5s3 widening, second batch) -----------
+
+def list_data_iters():
+    """MXListDataIters: registered iterator names."""
+    from mxtpu.io.io import _ITER_REGISTRY
+
+    return sorted(_ITER_REGISTRY)
+
+
+def iter_pad_num(ci) -> int:
+    """MXDataIterGetPadNum: pad count of the CURRENT batch (0 when the
+    iterator fills batches exactly)."""
+    b = ci.batch
+    return int(getattr(b, "pad", 0) or 0) if b is not None else 0
+
+
+def iter_get_index(ci):
+    """MXDataIterGetIndex -> list of uint64 sample indices (empty when
+    the iterator does not track order)."""
+    b = ci.batch
+    idx = getattr(b, "index", None) if b is not None else None
+    if idx is None:
+        return []
+    return [int(i) for i in np.asarray(idx).ravel()]
+
+
+def autograd_backward_ex(outputs, out_grads, variables, retain_graph: int,
+                         create_graph: int, is_train: int):
+    """MXAutogradBackwardEx: with variables, computes and RETURNS the
+    per-variable gradients (the reference's grad() path, leaving .grad
+    buffers untouched); without, behaves like MXAutogradBackward."""
+    from mxtpu import autograd
+
+    if variables:
+        grads = autograd.grad(list(outputs), list(variables),
+                              head_grads=(list(out_grads)
+                                          if out_grads else None),
+                              retain_graph=bool(retain_graph),
+                              create_graph=bool(create_graph),
+                              train_mode=bool(is_train))
+        return list(grads)
+    if create_graph:
+        # backward() accumulates into .grad buffers, which are not
+        # taped — silently returning first-order grads would corrupt a
+        # higher-order caller; the taped path requires variables
+        raise ValueError("MXAutogradBackwardEx: create_graph=1 "
+                         "requires num_variables>0 (the grad() path); "
+                         ".grad accumulation is not taped")
+    autograd.backward(list(outputs),
+                      list(out_grads) if out_grads else None,
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(is_train))
+    return []

@@ -66,6 +66,19 @@ PyObject* handle_list(void* const* handles, uint32_t n) {
   return lst;
 }
 
+/* same, but a NULL entry becomes None (reference ABI: a NULL output
+ * gradient means 'use the default head gradient for that output') */
+PyObject* handle_list_nullable(void* const* handles, uint32_t n) {
+  PyObject* lst = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject* o = handles[i] ? static_cast<PyObject*>(handles[i])
+                             : Py_None;
+    Py_INCREF(o);
+    PyList_SetItem(lst, i, o);
+  }
+  return lst;
+}
+
 /* ---- stable out-buffer storage (reference: valid until next call) ---- */
 std::mutex g_buf_mu;
 /* separate name stores per function group (same rationale as the
@@ -77,6 +90,7 @@ struct NameStore {
 };
 NameStore g_op_names;
 NameStore g_load_names;
+NameStore g_iter_names;
 std::unordered_map<void*, std::vector<uint32_t>> g_shape_store;
 /* separate stores per function group so MXImperativeInvoke outputs stay
  * valid across an MXNDArrayLoad and vice versa (the documented
@@ -1417,5 +1431,100 @@ int MXRandomSeedContext(int seed, int dev_type, int dev_id) {
   return MXRandomSeed(seed);
 }
 
+
+/* ---- DataIter extras / autograd ex (r5s3 second batch) ---------------- */
+
+int MXListDataIters(uint32_t* out_size, const char*** out_array) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* res = embed_call("list_data_iters", nullptr);
+  if (!res) return fail();
+  int rc = export_names(res, &g_iter_names, out_size, out_array);
+  Py_DECREF(res);
+  return rc;
+}
+
+int MXDataIterGetPadNum(void* handle, int* pad) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("iter_pad_num", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  *pad = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+static std::vector<uint64_t> g_iter_index_store;
+
+int MXDataIterGetIndex(void* handle, uint64_t** out_index,
+                       uint64_t* out_size) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = embed_call("iter_get_index", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_ssize_t n = PyList_Size(res);
+  {
+    std::lock_guard<std::mutex> lk(g_buf_mu);
+    g_iter_index_store.clear();
+    for (Py_ssize_t i = 0; i < n; ++i)
+      g_iter_index_store.push_back(static_cast<uint64_t>(
+          PyLong_AsUnsignedLongLong(PyList_GetItem(res, i))));
+    *out_index = g_iter_index_store.data();
+    *out_size = static_cast<uint64_t>(n);
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+static std::vector<void*> g_gradex_store;
+static std::vector<int> g_gradex_stypes;
+
+int MXAutogradBackwardEx(uint32_t num_output, void** output_handles,
+                         void** ograd_handles, uint32_t num_variables,
+                         void** var_handles, int retain_graph,
+                         int create_graph, int is_train,
+                         void*** grad_handles, int** grad_stypes) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* outs = handle_list(output_handles, num_output);
+  PyObject* ogs;
+  if (ograd_handles) {
+    ogs = handle_list_nullable(ograd_handles, num_output);
+  } else {
+    ogs = PyList_New(0);
+  }
+  PyObject* vars = num_variables
+      ? handle_list(var_handles, num_variables) : PyList_New(0);
+  PyObject* args = Py_BuildValue("(OOOiii)", outs, ogs, vars,
+                                 retain_graph, create_graph, is_train);
+  Py_DECREF(outs);
+  Py_DECREF(ogs);
+  Py_DECREF(vars);
+  PyObject* res = embed_call("autograd_backward_ex", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  uint32_t n = 0;
+  if (grad_handles) {
+    export_handles(res, &g_gradex_store, &n, grad_handles);
+    if (grad_stypes) {
+      /* per-variable storage types: every gradient here is dense
+       * (kDefaultStorage == 0) */
+      std::lock_guard<std::mutex> lk(g_buf_mu);
+      g_gradex_stypes.assign(n, 0);
+      *grad_stypes = g_gradex_stypes.data();
+    }
+  } else if (grad_stypes) {
+    *grad_stypes = nullptr; /* nothing exported, say so explicitly */
+  }
+  Py_DECREF(res);
+  (void)n;
+  return 0;
+}
+
 }  // extern "C"
+
 

@@ -81,6 +81,11 @@ extern int MXSetProfilerState(int);
 extern int MXDumpProfile(int);
 extern int MXAggregateProfileStatsPrint(const char**, int);
 
+extern int MXListDataIters(uint32_t*, const char***);
+extern int MXDataIterGetPadNum(void*, int*);
+extern int MXDataIterGetIndex(void*, uint64_t**, uint64_t*);
+extern int MXAutogradBackwardEx(uint32_t, void**, void**, uint32_t, void**,
+                                int, int, int, void***, int**);
 extern int MXNDArrayCreateNone(void**);
 extern int MXNDArrayReshape(void*, int, int*, void**);
 extern int MXNDArrayReshape64(void*, int, int64_t*, _Bool, void**);
@@ -532,6 +537,63 @@ int main(int argc, char** argv) {
     CHECK(MXEngineSetBulkSize(0, &prev) == 0 && prev == 16);
     CHECK(MXRandomSeedContext(11, 1, 0) == 0);
     printf("group:widening-misc ok ngpu=%d\n", ngpu);
+  }
+
+  /* -- r5s3 widening 2: iter extras + BackwardEx -- */
+  {
+    uint32_t n_iters = 0;
+    const char** iter_names = NULL;
+    CHECK(MXListDataIters(&n_iters, &iter_names) == 0);
+    int seen_csv = 0;
+    for (uint32_t i = 0; i < n_iters; ++i)
+      if (strcmp(iter_names[i], "CSVIter") == 0) seen_csv = 1;
+    CHECK(n_iters >= 3 && seen_csv);
+
+    /* fresh CSV iter to inspect pad/index on a live batch */
+    const char* ik[3] = {"data_csv", "data_shape", "batch_size"};
+    const char* iv[3] = {argv[1], "(3,)", "2"};
+    void* it2 = NULL;
+    CHECK(MXDataIterCreateIter("CSVIter", 3, ik, iv, &it2) == 0);
+    int has = 0;
+    CHECK(MXDataIterNext(it2, &has) == 0 && has == 1);
+    int padn = -1;
+    CHECK(MXDataIterGetPadNum(it2, &padn) == 0 && padn >= 0);
+    uint64_t* idx = NULL;
+    uint64_t idx_n = 0;
+    CHECK(MXDataIterGetIndex(it2, &idx, &idx_n) == 0);
+    CHECK(MXDataIterFree(it2) == 0);
+
+    /* BackwardEx grad() path: d(x*v)/dv returned, .grad untouched */
+    void* v2 = NULL;
+    CHECK(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &v2) == 0);
+    float v2d[6] = {3, 3, 3, 3, 3, 3};
+    CHECK(MXNDArraySyncCopyFromCPU(v2, v2d, 6) == 0);
+    uint32_t req2[1] = {1};
+    void* mv2[1] = {v2};
+    void* gbuf2 = NULL;
+    CHECK(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &gbuf2) == 0);
+    void* mg2[1] = {gbuf2};
+    CHECK(MXAutogradMarkVariables(1, mv2, req2, mg2) == 0);
+    int prev2 = -1;
+    CHECK(MXAutogradSetIsRecording(1, &prev2) == 0);
+    void* mul2 = NULL;
+    CHECK(MXGetOpHandle("elemwise_mul", &mul2) == 0);
+    void* mi2[2] = {a, v2};
+    int no2 = 0;
+    void** o2 = NULL;
+    CHECK(MXImperativeInvoke(mul2, 2, mi2, &no2, &o2, 0, NULL, NULL) == 0);
+    void* y2 = o2[0];
+    CHECK(MXAutogradSetIsRecording(0, &prev2) == 0);
+    void** gh = NULL;
+    int* gst = NULL;
+    CHECK(MXAutogradBackwardEx(1, &y2, NULL, 1, mv2, 0, 0, 1,
+                               &gh, &gst) == 0);
+    float gx[6];
+    CHECK(MXNDArraySyncCopyToCPU(gh[0], gx, 6) == 0);
+    for (int i = 0; i < 6; ++i) CHECK(gx[i] == data[i]); /* dy/dv = x */
+    MXNDArrayFree(gh[0]); MXNDArrayFree(y2);
+    MXNDArrayFree(v2); MXNDArrayFree(gbuf2);
+    printf("group:widening-iter-gradex ok n_iters=%u\n", n_iters);
   }
 
   CHECK(MXNDArrayWaitAll() == 0);
