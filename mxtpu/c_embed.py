@@ -784,3 +784,12 @@ def autograd_backward_ex(outputs, out_grads, variables, retain_graph: int,
                       retain_graph=bool(retain_graph),
                       train_mode=bool(is_train))
     return []
+
+
+def kv_set_updater(kv, fn) -> None:
+    """MXKVStoreSetUpdater: fn(key, recv_merged, stored) — the C
+    trampoline forwards to the caller's function pointer; ownership of
+    the two handles passes to the C callback (it frees them with
+    MXNDArrayFree, reference updater protocol).  fn=None clears the
+    updater (C side maps a NULL function pointer here)."""
+    kv.set_updater(fn)

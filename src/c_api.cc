@@ -1432,6 +1432,92 @@ int MXRandomSeedContext(int seed, int dev_type, int dev_id) {
 }
 
 
+
+/* ---- KVStore custom updater (reference MXKVStoreSetUpdater) ----------- */
+
+typedef void (*MXKVUpdater)(int key, void* recv, void* local,
+                                       void* handle);
+
+namespace {
+
+struct UpdaterCtx {
+  MXKVUpdater fn;
+  void* handle;
+};
+
+void updater_ctx_destructor(PyObject* capsule) {
+  delete static_cast<UpdaterCtx*>(
+      PyCapsule_GetPointer(capsule, "mxtpu.c_updater"));
+}
+
+/* python-callable bridging kv.set_updater(fn) -> the C callback.
+ * Handles are INCREF'd before the call: ownership passes to the C
+ * callback, which frees them with MXNDArrayFree (the reference's
+ * updater protocol — its python frontend wrapper likewise takes
+ * ownership of the handles it receives). */
+PyObject* updater_trampoline(PyObject* self, PyObject* args) {
+  PyObject* key_obj;
+  PyObject* recv;
+  PyObject* local;
+  if (!PyArg_ParseTuple(args, "OOO", &key_obj, &recv, &local))
+    return nullptr;
+  long key = PyLong_Check(key_obj) ? PyLong_AsLong(key_obj) : -1;
+  auto* ctx = static_cast<UpdaterCtx*>(
+      PyCapsule_GetPointer(self, "mxtpu.c_updater"));
+  if (!ctx) return nullptr;
+  Py_INCREF(recv);
+  Py_INCREF(local);
+  /* the callback re-enters MX* functions, which PyGILState_Ensure —
+   * re-entrant while we hold the GIL, so no release needed */
+  ctx->fn(static_cast<int>(key), recv, local, ctx->handle);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_updater_def = {"mxtpu_c_updater", updater_trampoline,
+                             METH_VARARGS, nullptr};
+
+}  // namespace
+
+int MXKVStoreSetUpdater(void* handle, MXKVUpdater updater,
+                                   void* updater_handle) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  if (!updater) {
+    /* NULL clears the updater (otherwise the next push would call
+     * through a null pointer) */
+    PyObject* args = Py_BuildValue("(OO)",
+                                   static_cast<PyObject*>(handle),
+                                   Py_None);
+    PyObject* res = embed_call("kv_set_updater", args);
+    Py_DECREF(args);
+    if (!res) return fail();
+    Py_DECREF(res);
+    return 0;
+  }
+  auto* ctx = new UpdaterCtx{updater, updater_handle};
+  PyObject* capsule = PyCapsule_New(ctx, "mxtpu.c_updater",
+                                    updater_ctx_destructor);
+  if (!capsule) {
+    delete ctx;
+    set_error_from_python();
+    return fail();
+  }
+  PyObject* pyfn = PyCFunction_New(&g_updater_def, capsule);
+  Py_DECREF(capsule); /* pyfn keeps it alive */
+  if (!pyfn) {
+    set_error_from_python();
+    return fail();
+  }
+  PyObject* args = Py_BuildValue("(OO)", static_cast<PyObject*>(handle),
+                                 pyfn);
+  Py_DECREF(pyfn); /* kv holds its own reference via set_updater */
+  PyObject* res = embed_call("kv_set_updater", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
 /* ---- DataIter extras / autograd ex (r5s3 second batch) ---------------- */
 
 int MXListDataIters(uint32_t* out_size, const char*** out_array) {

@@ -82,6 +82,8 @@ extern int MXDumpProfile(int);
 extern int MXAggregateProfileStatsPrint(const char**, int);
 
 extern int MXListDataIters(uint32_t*, const char***);
+typedef void (*MXKVUpdater)(int, void*, void*, void*);
+extern int MXKVStoreSetUpdater(void*, MXKVUpdater, void*);
 extern int MXDataIterGetPadNum(void*, int*);
 extern int MXDataIterGetIndex(void*, uint64_t**, uint64_t*);
 extern int MXAutogradBackwardEx(uint32_t, void**, void**, uint32_t, void**,
@@ -130,6 +132,23 @@ extern int MXRandomSeedContext(int, int, int);
       return 1;                                                       \
     }                                                                 \
   } while (0)
+
+
+/* custom updater for the SetUpdater group: local -= 0.5*recv, counts
+ * invocations through the opaque handle; frees the handles it owns
+ * (reference updater protocol) */
+static void c_sgd_updater(int key, void* recv, void* local, void* handle) {
+  (void)key;
+  int* count = (int*)handle;
+  float r[6], l[6];
+  if (MXNDArraySyncCopyToCPU(recv, r, 6) != 0) return;
+  if (MXNDArraySyncCopyToCPU(local, l, 6) != 0) return;
+  for (int i = 0; i < 6; ++i) l[i] -= 0.5f * r[i];
+  if (MXNDArraySyncCopyFromCPU(local, l, 6) != 0) return;
+  (*count)++;
+  MXNDArrayFree(recv);
+  MXNDArrayFree(local);
+}
 
 int main(int argc, char** argv) {
   if (argc < 3) {
@@ -594,6 +613,50 @@ int main(int argc, char** argv) {
     MXNDArrayFree(gh[0]); MXNDArrayFree(y2);
     MXNDArrayFree(v2); MXNDArrayFree(gbuf2);
     printf("group:widening-iter-gradex ok n_iters=%u\n", n_iters);
+  }
+
+  /* -- r5s3 widening 3: custom C updater drives the kvstore merge --
+   * fresh store: the widening-misc group armed 2-bit gradient
+   * compression on `kv`, which would quantize the pushed gradient
+   * before the updater sees it */
+  {
+    void* ukv = NULL;
+    CHECK(MXKVStoreCreate("local", &ukv) == 0);
+    void* up_val = NULL;
+    CHECK(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &up_val) == 0);
+    float ones6[6] = {1, 1, 1, 1, 1, 1};
+    CHECK(MXNDArraySyncCopyFromCPU(up_val, ones6, 6) == 0);
+    int up_key[1] = {77};
+    void* up_vals[1] = {up_val};
+    CHECK(MXKVStoreInit(ukv, 1, up_key, up_vals) == 0);
+    int calls = 0;
+    CHECK(MXKVStoreSetUpdater(ukv, c_sgd_updater, &calls) == 0);
+    void* up_grad = NULL;
+    CHECK(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &up_grad) == 0);
+    float g6[6] = {2, 2, 2, 2, 2, 2};
+    CHECK(MXNDArraySyncCopyFromCPU(up_grad, g6, 6) == 0);
+    void* up_push[1] = {up_grad};
+    CHECK(MXKVStorePush(ukv, 1, up_key, up_push, 0) == 0);
+    void* up_out = NULL;
+    CHECK(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &up_out) == 0);
+    void* up_pull[1] = {up_out};
+    CHECK(MXKVStorePull(ukv, 1, up_key, up_pull, 0) == 0);
+    float got[6];
+    CHECK(MXNDArraySyncCopyToCPU(up_out, got, 6) == 0);
+    for (int i = 0; i < 6; ++i) CHECK(got[i] == 0.0f); /* 1 - 0.5*2 */
+    CHECK(calls == 1);
+    /* NULL clears the updater: the next push falls back to the
+     * default merge (local += merged) instead of segfaulting */
+    CHECK(MXKVStoreSetUpdater(ukv, NULL, NULL) == 0);
+    CHECK(MXNDArraySyncCopyFromCPU(up_grad, g6, 6) == 0);
+    CHECK(MXKVStorePush(ukv, 1, up_key, up_push, 0) == 0);
+    CHECK(MXKVStorePull(ukv, 1, up_key, up_pull, 0) == 0);
+    CHECK(MXNDArraySyncCopyToCPU(up_out, got, 6) == 0);
+    for (int i = 0; i < 6; ++i) CHECK(got[i] == 2.0f); /* 0 + 2 */
+    CHECK(calls == 1); /* updater really was cleared */
+    MXNDArrayFree(up_out); MXNDArrayFree(up_grad); MXNDArrayFree(up_val);
+    CHECK(MXKVStoreFree(ukv) == 0);
+    printf("group:kv-updater ok calls=%d\n", calls);
   }
 
   CHECK(MXNDArrayWaitAll() == 0);
