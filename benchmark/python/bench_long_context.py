@@ -29,7 +29,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def run_child(T, tokens, iters):
+def run_child(T, tokens, iters, remat):
     """One measured config in THIS process; prints one JSON line."""
     sys.path.insert(0, REPO)
     import time
@@ -48,7 +48,7 @@ def run_child(T, tokens, iters):
                         AXIS_EP: 1}, devices=jax.devices()[:1])
     cfg = tf.TransformerConfig(vocab=8192, d_model=1024, n_heads=8,
                                n_layers=8, d_ff=4096, max_len=T,
-                               dtype="bfloat16")
+                               dtype="bfloat16", remat=remat)
     params = tf.init_params(cfg, mesh, seed=0)
     opt = tf.init_opt_state(cfg, mesh)
     step, sh = tf.make_train_step(cfg, mesh, lr=1e-3, optimizer="adam")
@@ -75,7 +75,7 @@ def run_child(T, tokens, iters):
         raise RuntimeError("loss diverged: %r" % lv)
     print(json.dumps({"T": T, "B": B,
                       "tokens_per_sec": round(B * T * iters / dt, 1),
-                      "loss": round(lv, 4),
+                      "loss": round(lv, 4), "remat": remat,
                       "pallas": bool(_use_pallas())}))
 
 
@@ -85,6 +85,10 @@ def main():
     ap.add_argument("--tokens", type=int, default=8192,
                     help="tokens per batch (B = tokens // T)")
     ap.add_argument("--iters", type=int, default=12)
+    ap.add_argument("--remat", default="none",
+                    choices=("none", "dots", "full"),
+                    help="per-layer rematerialization; 'full' is what "
+                         "makes T>=8k fit on one chip")
     ap.add_argument("--timeout", type=float, default=900.0,
                     help="per-config child timeout (a wedged tunnel "
                          "must not hang the whole sweep)")
@@ -93,7 +97,7 @@ def main():
     args = ap.parse_args()
 
     if args.child is not None:
-        run_child(args.child, args.tokens, args.iters)
+        run_child(args.child, args.tokens, args.iters, args.remat)
         return
 
     for t in [int(s) for s in args.seqs.split(",") if s]:
@@ -105,7 +109,8 @@ def main():
                 r = subprocess.run(
                     [sys.executable, os.path.abspath(__file__),
                      "--child", str(t), "--tokens", str(args.tokens),
-                     "--iters", str(args.iters)],
+                     "--iters", str(args.iters),
+                     "--remat", args.remat],
                     capture_output=True, text=True, env=env,
                     timeout=args.timeout)
             except subprocess.TimeoutExpired:

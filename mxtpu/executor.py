@@ -50,6 +50,21 @@ _REMAT_POLICIES = {
 }
 
 
+def apply_remat(fn, policy_name):
+    """Wrap fn in `jax.checkpoint` under the named policy ('full' =
+    save nothing, 'dots' = save matmul outputs, 'dots_no_batch').
+    The ONE remat vocabulary — the symbolic executor's mirror pass and
+    the SPMD transformer's per-layer remat both route through here."""
+    import jax
+
+    if policy_name not in _REMAT_POLICIES:
+        raise MXNetError("remat policy must be one of %s (got %r)"
+                         % (sorted(_REMAT_POLICIES), policy_name))
+    attr = _REMAT_POLICIES[policy_name]
+    policy = getattr(jax.checkpoint_policies, attr) if attr else None
+    return jax.checkpoint(fn, policy=policy)
+
+
 def _maybe_remat(fn):
     """Gradient-checkpoint the whole-graph function when
     MXTPU_BACKWARD_DO_MIRROR / MXNET_BACKWARD_DO_MIRROR is set — the
@@ -64,15 +79,7 @@ def _maybe_remat(fn):
                           os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0"))
     if flag not in ("1", "true", "True"):
         return fn
-    import jax
-
-    policy_name = os.environ.get("MXTPU_REMAT_POLICY", "full")
-    if policy_name not in _REMAT_POLICIES:
-        raise MXNetError("MXTPU_REMAT_POLICY must be one of %s"
-                         % sorted(_REMAT_POLICIES))
-    attr = _REMAT_POLICIES[policy_name]
-    policy = getattr(jax.checkpoint_policies, attr) if attr else None
-    return jax.checkpoint(fn, policy=policy)
+    return apply_remat(fn, os.environ.get("MXTPU_REMAT_POLICY", "full"))
 
 
 def _build_graph_fn(symbol: Symbol, arg_names: List[str],

@@ -51,6 +51,24 @@ class TransformerConfig:
     capacity_factor: float = 2.0
     max_len: int = 128
     dtype: Any = "bfloat16"
+    remat: str = "none"        # "none" or an executor remat policy
+    # ("full" | "dots" | "dots_no_batch"): per-layer rematerialization
+    # in the backward pass.  "full" recomputes each layer's internals
+    # from its input (activation memory drops from O(layers * T *
+    # d_ff) to O(layers * T * d_model) — what makes T>=8k trainable
+    # on one chip); "dots" saves matmul outputs and recomputes
+    # elementwise only.  Analog of the reference's
+    # MXNET_BACKWARD_DO_MIRROR (docs/faq/env_var.md) which this
+    # repo's symbolic executor exposes as MXTPU_BACKWARD_DO_MIRROR;
+    # same policy vocabulary (`executor.apply_remat`).
+
+    def __post_init__(self):
+        from ..executor import _REMAT_POLICIES
+
+        if self.remat != "none" and self.remat not in _REMAT_POLICIES:
+            raise MXNetError(
+                "TransformerConfig.remat must be 'none' or one of %s "
+                "(got %r)" % (sorted(_REMAT_POLICIES), self.remat))
 
 
 # ---------------------------------------------------------------------------
@@ -377,6 +395,11 @@ def _stage_fn(cfg, params_stage, x, tp_size, ep_size):
         else:
             f = _dense_ffn(z, lw["w1"], lw["w2"])
         return h + f, None
+
+    if cfg.remat != "none":
+        from ..executor import apply_remat
+
+        layer = apply_remat(layer, cfg.remat)
 
     out, _ = jax.lax.scan(layer, x, params_stage)
     return out

@@ -294,3 +294,45 @@ def test_zero1_adam_matches_unsharded_and_shards_memory():
     assert any(not np.allclose(vals[0], v) for v in vals[1:])
     # tiny params (LayerNorm vectors) keep replicated state
     assert T._zero1_dims(cfg, mesh)["ln_f"] is None
+
+
+def test_remat_matches_none_and_rejects_unknown():
+    """remat='full'/'dots' must be numerically identical to 'none'
+    (same step math, only backward memory strategy differs)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from mxtpu import parallel
+    from mxtpu.base import MXNetError
+    from mxtpu.parallel import transformer as T
+
+    rng = np.random.RandomState(3)
+    tok_np = rng.randint(0, 64, (2, 32)).astype(np.int32)
+    lab_np = rng.randint(0, 64, (2, 32)).astype(np.int32)
+
+    def run(remat):
+        cfg = T.TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                                  n_layers=2, d_ff=64, max_len=32,
+                                  dtype="float32", remat=remat)
+        mesh = parallel.create_mesh({"dp": 1, "pp": 1, "tp": 1,
+                                     "sp": 1, "ep": 1},
+                                    devices=jax.devices()[:1])
+        params = T.init_params(cfg, mesh, seed=0)
+        opt = T.init_opt_state(cfg, mesh)
+        step, sh = T.make_train_step(cfg, mesh, lr=1e-2,
+                                     optimizer="adam")
+        tok = jax.device_put(jnp.asarray(tok_np), sh["data"])
+        lab = jax.device_put(jnp.asarray(lab_np), sh["data"])
+        losses = []
+        for _ in range(3):
+            params, opt, loss = step(params, opt, tok, lab)
+            losses.append(float(loss))
+        return losses
+
+    base = run("none")
+    np.testing.assert_allclose(run("full"), base, rtol=1e-5)
+    np.testing.assert_allclose(run("dots"), base, rtol=1e-5)
+    with pytest.raises(MXNetError):
+        run("mirror")
