@@ -50,11 +50,14 @@ _REMAT_POLICIES = {
 }
 
 
-def apply_remat(fn, policy_name):
+def apply_remat(fn, policy_name, prevent_cse=True):
     """Wrap fn in `jax.checkpoint` under the named policy ('full' =
     save nothing, 'dots' = save matmul outputs, 'dots_no_batch').
     The ONE remat vocabulary — the symbolic executor's mirror pass and
-    the SPMD transformer's per-layer remat both route through here."""
+    the SPMD transformer's per-layer remat both route through here.
+    Pass prevent_cse=False when fn is a `lax.scan` body: the CSE
+    barriers are unnecessary under scan (per the jax.checkpoint docs)
+    and only cost backward throughput."""
     import jax
 
     if policy_name not in _REMAT_POLICIES:
@@ -62,7 +65,7 @@ def apply_remat(fn, policy_name):
                          % (sorted(_REMAT_POLICIES), policy_name))
     attr = _REMAT_POLICIES[policy_name]
     policy = getattr(jax.checkpoint_policies, attr) if attr else None
-    return jax.checkpoint(fn, policy=policy)
+    return jax.checkpoint(fn, policy=policy, prevent_cse=prevent_cse)
 
 
 def _maybe_remat(fn):

@@ -399,7 +399,7 @@ def _stage_fn(cfg, params_stage, x, tp_size, ep_size):
     if cfg.remat != "none":
         from ..executor import apply_remat
 
-        layer = apply_remat(layer, cfg.remat)
+        layer = apply_remat(layer, cfg.remat, prevent_cse=False)
 
     out, _ = jax.lax.scan(layer, x, params_stage)
     return out

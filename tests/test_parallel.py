@@ -296,43 +296,66 @@ def test_zero1_adam_matches_unsharded_and_shards_memory():
     assert T._zero1_dims(cfg, mesh)["ln_f"] is None
 
 
-def test_remat_matches_none_and_rejects_unknown():
-    """remat='full'/'dots' must be numerically identical to 'none'
-    (same step math, only backward memory strategy differs)."""
+def _run_remat_losses(remat, axes=None, n_experts=0, T_len=64):
+    """Shared harness for the remat parity tests: 3 Adam steps of the
+    tiny TransformerLM under the given mesh axes, returns losses."""
     import jax
     import jax.numpy as jnp
     import numpy as np
-    import pytest
 
     from mxtpu import parallel
-    from mxtpu.base import MXNetError
     from mxtpu.parallel import transformer as T
 
+    axes = axes or {"dp": 1, "pp": 1, "tp": 1, "sp": 1, "ep": 1}
     rng = np.random.RandomState(3)
-    tok_np = rng.randint(0, 64, (2, 32)).astype(np.int32)
-    lab_np = rng.randint(0, 64, (2, 32)).astype(np.int32)
+    tok_np = rng.randint(0, 64, (4, T_len)).astype(np.int32)
+    lab_np = rng.randint(0, 64, (4, T_len)).astype(np.int32)
+    cfg = T.TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                              n_layers=2, d_ff=64, max_len=T_len,
+                              dtype="float32", n_experts=n_experts,
+                              remat=remat)
+    n = int(np.prod(list(axes.values())))
+    mesh = parallel.create_mesh(axes, devices=jax.devices()[:n])
+    params = T.init_params(cfg, mesh, seed=0)
+    opt = T.init_opt_state(cfg, mesh)
+    step, sh = T.make_train_step(cfg, mesh, lr=1e-2, optimizer="adam")
+    tok = jax.device_put(jnp.asarray(tok_np), sh["data"])
+    lab = jax.device_put(jnp.asarray(lab_np), sh["data"])
+    losses = []
+    for _ in range(3):
+        params, opt, loss = step(params, opt, tok, lab)
+        losses.append(float(loss))
+    return losses
 
-    def run(remat):
-        cfg = T.TransformerConfig(vocab=64, d_model=32, n_heads=2,
-                                  n_layers=2, d_ff=64, max_len=32,
-                                  dtype="float32", remat=remat)
-        mesh = parallel.create_mesh({"dp": 1, "pp": 1, "tp": 1,
-                                     "sp": 1, "ep": 1},
-                                    devices=jax.devices()[:1])
-        params = T.init_params(cfg, mesh, seed=0)
-        opt = T.init_opt_state(cfg, mesh)
-        step, sh = T.make_train_step(cfg, mesh, lr=1e-2,
-                                     optimizer="adam")
-        tok = jax.device_put(jnp.asarray(tok_np), sh["data"])
-        lab = jax.device_put(jnp.asarray(lab_np), sh["data"])
-        losses = []
-        for _ in range(3):
-            params, opt, loss = step(params, opt, tok, lab)
-            losses.append(float(loss))
-        return losses
 
-    base = run("none")
-    np.testing.assert_allclose(run("full"), base, rtol=1e-5)
-    np.testing.assert_allclose(run("dots"), base, rtol=1e-5)
+def test_remat_matches_none_and_rejects_unknown():
+    """remat='full'/'dots' must be numerically identical to 'none'
+    (same step math, only backward memory strategy differs)."""
+    import numpy as np
+    import pytest
+
+    from mxtpu.base import MXNetError
+
+    base = _run_remat_losses("none")
+    np.testing.assert_allclose(_run_remat_losses("full"), base,
+                               rtol=1e-5)
+    np.testing.assert_allclose(_run_remat_losses("dots"), base,
+                               rtol=1e-5)
     with pytest.raises(MXNetError):
-        run("mirror")
+        _run_remat_losses("mirror")
+
+
+def test_remat_sharded_and_moe_parity():
+    """remat must compose with shard_map collectives (tp psums, sp ring,
+    ep all_to_all) — jax.checkpoint wraps the scan body INSIDE the
+    per-device program, so the recompute replays collectives too."""
+    import numpy as np
+
+    axes = {"dp": 2, "pp": 1, "tp": 2, "sp": 2, "ep": 1}
+    np.testing.assert_allclose(_run_remat_losses("full", axes),
+                               _run_remat_losses("none", axes),
+                               rtol=1e-5)
+    moe = {"dp": 1, "pp": 1, "tp": 1, "sp": 1, "ep": 2}
+    np.testing.assert_allclose(
+        _run_remat_losses("full", moe, n_experts=2),
+        _run_remat_losses("none", moe, n_experts=2), rtol=1e-5)
