@@ -52,6 +52,12 @@ def main():
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--sp", type=int, default=2)
     ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--remat", default="none",
+                    choices=("none", "dots", "full"),
+                    help="per-layer gradient checkpointing; 'full' is "
+                         "what makes very long sequences (measured: "
+                         "T=32k on one chip) trainable — see "
+                         "benchmark/python/RESULTS_attention.md")
     ap.add_argument("--log-every", type=int, default=10)
     args = ap.parse_args()
 
@@ -70,7 +76,7 @@ def main():
 
     cfg = tf.TransformerConfig(vocab=32, d_model=64, n_heads=4,
                                n_layers=2, d_ff=128,
-                               max_len=args.seqlen)
+                               max_len=args.seqlen, remat=args.remat)
     # size-1 axes stay in the mesh so every PartitionSpec resolves;
     # XLA elides collectives over singletons (grow pp/ep the same way)
     mesh = create_mesh({AXIS_DP: args.dp, AXIS_PP: 1, AXIS_TP: args.tp,
