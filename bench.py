@@ -284,9 +284,13 @@ def run_transformer(iters=12, warmup=2, B=8, T=1024, d_model=1024,
         # instead of failing the same way and costing the whole row
         os.environ["MXTPU_NO_PALLAS"] = "1"
 
+    # remat="dots": measured on chip (r5s3) 22% FASTER than saving all
+    # activations at this size — the program is HBM-bound, so fewer
+    # saved intermediates beats fewer recomputed FLOPs (120.6k vs
+    # 98.7k tok/s; full remat lands between at 112k)
     cfg = tf.TransformerConfig(vocab=vocab, d_model=d_model, n_heads=8,
                                n_layers=n_layers, d_ff=d_ff, max_len=T,
-                               dtype="bfloat16")
+                               dtype="bfloat16", remat="dots")
     params = tf.init_params(cfg, mesh, seed=0)
     opt = tf.init_opt_state(cfg, mesh)
     step, sh = tf.make_train_step(cfg, mesh, lr=1e-3, optimizer="adam")

@@ -86,7 +86,7 @@ def main():
                     help="tokens per batch (B = tokens // T)")
     ap.add_argument("--iters", type=int, default=12)
     ap.add_argument("--remat", default="none",
-                    choices=("none", "dots", "full"),
+                    choices=("none", "dots", "dots_no_batch", "full"),
                     help="per-layer rematerialization; 'full' is what "
                          "makes T>=8k fit on one chip")
     ap.add_argument("--timeout", type=float, default=900.0,

@@ -53,7 +53,7 @@ def main():
     ap.add_argument("--sp", type=int, default=2)
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--remat", default="none",
-                    choices=("none", "dots", "full"),
+                    choices=("none", "dots", "dots_no_batch", "full"),
                     help="per-layer gradient checkpointing; 'full' is "
                          "what makes very long sequences (measured: "
                          "T=32k on one chip) trainable — see "
