@@ -31,8 +31,8 @@ from .mesh import create_mesh, AXIS_DP, AXIS_TP, AXIS_PP, AXIS_SP, AXIS_EP
 from .ring_attention import ring_attention, _match_vma
 
 __all__ = ["TransformerConfig", "init_params", "param_specs",
-           "make_train_step", "make_forward", "dryrun",
-           "init_opt_state", "param_shapes"]
+           "make_train_step", "make_fused_train_steps", "make_forward",
+           "dryrun", "init_opt_state", "param_shapes"]
 
 _NEG_INF = -1e30
 # params below this element count keep replicated optimizer state
@@ -625,6 +625,76 @@ def _build_adam_zero1_step(cfg: TransformerConfig, mesh, n_micro: int,
     return device_step
 
 
+def _make_step_common(cfg, mesh, n_micro, lr, optimizer, betas, eps,
+                      k_steps):
+    """Shared plumbing for make_train_step / make_fused_train_steps:
+    builds the per-device step (wrapped in a k_steps lax.scan when
+    k_steps is not None), shard_maps + jits it with donation, and
+    returns (step, shardings).  ONE copy of the spec/sharding layout so
+    the fused and per-step paths cannot drift."""
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P, NamedSharding
+
+    specs = param_specs(cfg)
+    pspecs = {k: specs[k] for k in specs}
+    data_spec = P(AXIS_DP, AXIS_SP) if k_steps is None \
+        else P(None, AXIS_DP, AXIS_SP)
+    shardings = {
+        "params": {k: NamedSharding(mesh, v) for k, v in specs.items()},
+        "data": NamedSharding(mesh, data_spec),
+    }
+    if optimizer not in ("sgd", "adam"):
+        raise MXNetError("optimizer must be 'sgd' or 'adam' (got %r)"
+                         % (optimizer,))
+    if optimizer == "sgd":
+        device_step = _build_device_step(cfg, mesh, n_micro, lr)
+        if k_steps is None:
+            device_fn = device_step
+        else:
+            def device_fn(params, toks_stack, labs_stack):
+                def body(p, batch):
+                    return device_step(p, batch[0], batch[1])
+
+                return lax.scan(body, params, (toks_stack, labs_stack),
+                                length=k_steps)
+
+        sm = jax.shard_map(device_fn, mesh=mesh,
+                           in_specs=(pspecs, data_spec, data_spec),
+                           out_specs=(pspecs, P()))
+        return jax.jit(sm, donate_argnums=(0,)), shardings
+
+    device_step = _build_adam_zero1_step(cfg, mesh, n_micro, lr,
+                                         betas=betas, eps=eps)
+    if k_steps is None:
+        device_fn = device_step
+    else:
+        def device_fn(params, opt_state, toks_stack, labs_stack):
+            def body(carry, batch):
+                p, o, loss = device_step(carry[0], carry[1],
+                                         batch[0], batch[1])
+                return (p, o), loss
+
+            (params, opt_state), losses = lax.scan(
+                body, (params, opt_state), (toks_stack, labs_stack),
+                length=k_steps)
+            return params, opt_state, losses
+
+    ospecs = _opt_state_specs(cfg, mesh)
+    ostate_specs = {"m": dict(ospecs), "v": dict(ospecs), "t": P()}
+    sm = jax.shard_map(device_fn, mesh=mesh,
+                       in_specs=(pspecs, ostate_specs, data_spec,
+                                 data_spec),
+                       out_specs=(pspecs, ostate_specs, P()))
+    step = jax.jit(sm, donate_argnums=(0, 1))
+    shardings["opt_state"] = {
+        "m": {k: NamedSharding(mesh, v) for k, v in ospecs.items()},
+        "v": {k: NamedSharding(mesh, v) for k, v in ospecs.items()},
+        "t": NamedSharding(mesh, P()),
+    }
+    return step, shardings
+
+
 def make_train_step(cfg: TransformerConfig, mesh, n_micro: int = 1,
                     lr: float = 1e-2, optimizer: str = "sgd",
                     betas=(0.9, 0.999), eps: float = 1e-8):
@@ -638,42 +708,32 @@ def make_train_step(cfg: TransformerConfig, mesh, n_micro: int = 1,
     (new_params, new_opt_state, loss), with `init_opt_state(cfg, mesh)`
     building the dp-sharded moments.  tokens/labels are globally
     [B, T], sharded (dp, sp) by the returned in-shardings."""
-    import jax
-    from jax.sharding import PartitionSpec as P, NamedSharding
+    return _make_step_common(cfg, mesh, n_micro, lr, optimizer, betas,
+                             eps, k_steps=None)
 
-    specs = param_specs(cfg)
-    pspecs = {k: specs[k] for k in specs}
-    data_spec = P(AXIS_DP, AXIS_SP)
-    shardings = {
-        "params": {k: NamedSharding(mesh, v) for k, v in specs.items()},
-        "data": NamedSharding(mesh, data_spec),
-    }
-    if optimizer == "sgd":
-        device_step = _build_device_step(cfg, mesh, n_micro, lr)
-        sm = jax.shard_map(
-            device_step, mesh=mesh,
-            in_specs=(pspecs, data_spec, data_spec),
-            out_specs=(pspecs, P()))
-        step = jax.jit(sm, donate_argnums=(0,))
-        return step, shardings
-    if optimizer != "adam":
-        raise MXNetError("optimizer must be 'sgd' or 'adam' (got %r)"
-                         % (optimizer,))
-    device_step = _build_adam_zero1_step(cfg, mesh, n_micro, lr,
-                                         betas=betas, eps=eps)
-    ospecs = _opt_state_specs(cfg, mesh)
-    ostate_specs = {"m": dict(ospecs), "v": dict(ospecs), "t": P()}
-    sm = jax.shard_map(
-        device_step, mesh=mesh,
-        in_specs=(pspecs, ostate_specs, data_spec, data_spec),
-        out_specs=(pspecs, ostate_specs, P()))
-    step = jax.jit(sm, donate_argnums=(0, 1))
-    shardings["opt_state"] = {
-        "m": {k: NamedSharding(mesh, v) for k, v in ospecs.items()},
-        "v": {k: NamedSharding(mesh, v) for k, v in ospecs.items()},
-        "t": NamedSharding(mesh, P()),
-    }
-    return step, shardings
+
+def make_fused_train_steps(cfg: TransformerConfig, mesh, k_steps: int,
+                           n_micro: int = 1, lr: float = 1e-2,
+                           optimizer: str = "adam", betas=(0.9, 0.999),
+                           eps: float = 1e-8):
+    """K train steps lax.scan-fused into ONE compiled program — the
+    transformer analog of `mxtpu.fused_train.FusedTrainLoop`
+    (dispatch-latency amortization; one launch per K steps instead of
+    K; measured +6% at the bench flagship config through the tunnel).
+    Data arrives stacked: tokens/labels are [K, B, T], sharded
+    (None, dp, sp).
+
+    adam: (params, opt_state, toks_stack, labs_stack) ->
+    (new_params, new_opt_state, losses[K]).
+    sgd:  (params, toks_stack, labs_stack) -> (new_params, losses[K]).
+    """
+    k_steps = int(k_steps)
+    if k_steps < 1:
+        raise MXNetError("make_fused_train_steps: k_steps must be >= 1 "
+                         "(got %d) — a zero-length scan would silently "
+                         "train nothing" % k_steps)
+    return _make_step_common(cfg, mesh, n_micro, lr, optimizer, betas,
+                             eps, k_steps=k_steps)
 
 
 def make_forward(cfg: TransformerConfig, mesh):
