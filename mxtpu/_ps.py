@@ -439,7 +439,12 @@ class Server(object):
     (`kvstore_dist_server.h:155`): sync pushes accumulate until all
     workers reported, then `ApplyUpdates` runs the updater once."""
 
-    def __init__(self):
+    def __init__(self, controller=None):
+        # optional app-level command hook (reference: the `controller`
+        # argument of MXKVStoreRunServer receives commands that are not
+        # built-ins); called as controller(head, body) for any head
+        # other than set_optimizer
+        self._controller = controller
         self._nw = _num_workers()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -643,6 +648,11 @@ class Server(object):
             optimizer = pickle.loads(body)
             with self._lock:
                 self._updater = opt_mod.get_updater(optimizer)
+        elif self._controller is not None:
+            try:
+                self._controller(head, body)
+            except Exception as e:  # a controller bug must not kill
+                return {"error": "controller failed: %s" % e}
         return {"ok": True}
 
 
@@ -843,5 +853,5 @@ def run_scheduler():
     Scheduler().run()
 
 
-def run_server():
-    Server().run()
+def run_server(controller=None):
+    Server(controller=controller).run()

@@ -793,3 +793,44 @@ def kv_set_updater(kv, fn) -> None:
     MXNDArrayFree, reference updater protocol).  fn=None clears the
     updater (C side maps a NULL function pointer here)."""
     kv.set_updater(fn)
+
+
+# -- PS env / server hosting (reference MXInitPSEnv, MXKVStoreRunServer,
+#    MXKVStoreSendCommmandToServers [sic - reference header spelling]) -----
+
+def kv_init_ps_env(keys, vals) -> None:
+    """MXInitPSEnv: install the DMLC_* cluster env vars (role, scheduler
+    address, counts) before kv_create of a dist store."""
+    import os
+
+    for k, v in zip(keys, vals):
+        os.environ[str(k)] = str(v)
+
+
+def kv_send_command(kv, head: int, body: bytes) -> None:
+    """MXKVStoreSendCommmandToServers: the reference wire format is an
+    int command id + opaque body; our PS command channel is
+    string-headed, so the id travels as str(head)."""
+    kv.send_command_to_servers(str(int(head)), bytes(body))
+
+
+def kv_run_server(kv, controller=None) -> None:
+    """MXKVStoreRunServer: blocks serving when DMLC_ROLE is server or
+    scheduler (raises for worker, matching KVStoreServer).  controller
+    receives (head, body) for non-builtin commands; the C trampoline
+    maps head back to the int id."""
+    from mxtpu.kvstore_server import KVStoreServer
+
+    if controller is None:
+        KVStoreServer().run()
+        return
+
+    def _ctl(head, body):
+        try:
+            h = int(head)
+        except (TypeError, ValueError):
+            h = -1
+        controller(h, body if isinstance(body, (bytes, bytearray))
+                   else str(body).encode())
+
+    KVStoreServer().run(controller=_ctl)

@@ -17,11 +17,15 @@ class KVStoreServer(object):
     def __init__(self):
         self._role = _ps.role_from_env()
 
-    def run(self):
+    def run(self, controller=None):
+        """controller: optional fn(head, body) receiving app-level
+        commands sent via send_command_to_servers (heads other than the
+        built-in set_optimizer) — the reference MXKVStoreRunServer
+        controller semantics."""
         if self._role == "scheduler":
             _ps.run_scheduler()
         elif self._role == "server":
-            _ps.run_server()
+            _ps.run_server(controller=controller)
         else:
             raise RuntimeError("KVStoreServer started with role %r"
                                % self._role)

@@ -18,6 +18,7 @@
  * build, this ABI carries a CPython runtime dependency — the price of
  * one engine instead of two.
  */
+#define PY_SSIZE_T_CLEAN  /* required for '#' formats on py>=3.10 */
 #include <Python.h>
 
 #include <cstdint>
@@ -1611,6 +1612,153 @@ int MXAutogradBackwardEx(uint32_t num_output, void** output_handles,
   return 0;
 }
 
+
+/* ---- PS env / server hosting (r5s3; reference MXInitPSEnv,
+ * MXKVStoreRunServer, MXKVStoreSendCommmandToServers [header spelling
+ * preserved for ABI parity, correctly-spelled alias provided]) -------- */
+
+int MXInitPSEnv(uint32_t num_vars, const char** keys,
+                const char** vals) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* k = str_list(keys, num_vars);
+  PyObject* v = str_list(vals, num_vars);
+  PyObject* args = Py_BuildValue("(OO)", k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  PyObject* res = embed_call("kv_init_ps_env", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreSendCommmandToServers(void* handle, int cmd_id,
+                                   const char* cmd_body) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* body = PyBytes_FromString(cmd_body ? cmd_body : "");
+  PyObject* args = Py_BuildValue("(OiO)", static_cast<PyObject*>(handle),
+                                 cmd_id, body);
+  Py_DECREF(body);
+  PyObject* res = embed_call("kv_send_command", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreSendCommandToServers(void* handle, int cmd_id,
+                                  const char* cmd_body) {
+  return MXKVStoreSendCommmandToServers(handle, cmd_id, cmd_body);
+}
+
+namespace {
+
+typedef void (*MXKVServerController)(int head, const char* body,
+                                     void* controller_handle);
+
+struct ControllerCtx {
+  MXKVServerController fn;
+  void* handle;
+};
+
+void controller_ctx_destructor(PyObject* capsule) {
+  delete static_cast<ControllerCtx*>(
+      PyCapsule_GetPointer(capsule, "mxtpu.c_controller"));
+}
+
+PyObject* controller_trampoline(PyObject* self, PyObject* args) {
+  int head = 0;
+  const char* body = nullptr;
+  Py_ssize_t blen = 0;
+  if (!PyArg_ParseTuple(args, "iy#", &head, &body, &blen))
+    return nullptr;
+  auto* ctx = static_cast<ControllerCtx*>(
+      PyCapsule_GetPointer(self, "mxtpu.c_controller"));
+  if (!ctx) return nullptr;
+  /* body is NUL-terminated by CPython for y# reads of bytes objects */
+  ctx->fn(head, body ? body : "", ctx->handle);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_controller_def = {"mxtpu_c_controller",
+                                controller_trampoline, METH_VARARGS,
+                                nullptr};
+
+}  // namespace
+
+/* TEST HOOK (not part of the reference ABI): build the SAME
+ * capsule+PyCFunction controller the server path registers and invoke
+ * it once through Python-level calling — exercises the trampoline's
+ * argument parsing end-to-end without standing up a PS cluster. */
+int MXTPUTestInvokeController(MXKVServerController controller,
+                              void* controller_handle, int head,
+                              const char* body) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  auto* ctx = new ControllerCtx{controller, controller_handle};
+  PyObject* capsule = PyCapsule_New(ctx, "mxtpu.c_controller",
+                                    controller_ctx_destructor);
+  if (!capsule) {
+    delete ctx;
+    set_error_from_python();
+    return fail();
+  }
+  PyObject* pyfn = PyCFunction_New(&g_controller_def, capsule);
+  Py_DECREF(capsule);
+  if (!pyfn) {
+    set_error_from_python();
+    return fail();
+  }
+  PyObject* res = PyObject_CallFunction(
+      pyfn, "iy#", head, body ? body : "",
+      static_cast<Py_ssize_t>(body ? strlen(body) : 0));
+  Py_DECREF(pyfn);
+  if (!res) {
+    set_error_from_python();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreRunServer(void* handle, MXKVServerController controller,
+                       void* controller_handle) {
+  Gil gil;
+  if (!gil.ok) return fail();
+  PyObject* pyctl = Py_None;
+  Py_INCREF(Py_None);
+  if (controller) {
+    auto* ctx = new ControllerCtx{controller, controller_handle};
+    PyObject* capsule = PyCapsule_New(ctx, "mxtpu.c_controller",
+                                      controller_ctx_destructor);
+    if (!capsule) {
+      delete ctx;
+      Py_DECREF(Py_None);
+      set_error_from_python();
+      return fail();
+    }
+    Py_DECREF(Py_None);
+    pyctl = PyCFunction_New(&g_controller_def, capsule);
+    Py_DECREF(capsule);
+    if (!pyctl) {
+      set_error_from_python();
+      return fail();
+    }
+  }
+  PyObject* args = Py_BuildValue("(OO)", static_cast<PyObject*>(handle),
+                                 pyctl);
+  Py_DECREF(pyctl);
+  /* BLOCKS until the worker group finishes (reference semantics) */
+  PyObject* res = embed_call("kv_run_server", args);
+  Py_DECREF(args);
+  if (!res) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
 }  // extern "C"
+
 
 

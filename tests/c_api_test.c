@@ -84,6 +84,12 @@ extern int MXAggregateProfileStatsPrint(const char**, int);
 extern int MXListDataIters(uint32_t*, const char***);
 typedef void (*MXKVUpdater)(int, void*, void*, void*);
 extern int MXKVStoreSetUpdater(void*, MXKVUpdater, void*);
+extern int MXInitPSEnv(uint32_t, const char**, const char**);
+extern int MXKVStoreSendCommmandToServers(void*, int, const char*);
+typedef void (*MXKVServerController)(int, const char*, void*);
+extern int MXKVStoreRunServer(void*, MXKVServerController, void*);
+extern int MXTPUTestInvokeController(MXKVServerController, void*, int,
+                                     const char*);
 extern int MXDataIterGetPadNum(void*, int*);
 extern int MXDataIterGetIndex(void*, uint64_t**, uint64_t*);
 extern int MXAutogradBackwardEx(uint32_t, void**, void**, uint32_t, void**,
@@ -148,6 +154,16 @@ static void c_sgd_updater(int key, void* recv, void* local, void* handle) {
   (*count)++;
   MXNDArrayFree(recv);
   MXNDArrayFree(local);
+}
+
+/* controller for the ps-env group: records what it was called with */
+static int g_ctl_head = -1;
+static char g_ctl_body[64];
+static void test_controller(int head, const char* body, void* handle) {
+  int* count = (int*)handle;
+  (*count)++;
+  g_ctl_head = head;
+  snprintf(g_ctl_body, sizeof g_ctl_body, "%s", body ? body : "");
 }
 
 int main(int argc, char** argv) {
@@ -657,6 +673,28 @@ int main(int argc, char** argv) {
     MXNDArrayFree(up_out); MXNDArrayFree(up_grad); MXNDArrayFree(up_val);
     CHECK(MXKVStoreFree(ukv) == 0);
     printf("group:kv-updater ok calls=%d\n", calls);
+  }
+
+  /* -- r5s3 widening 4: PS env + command + server-role guard -- */
+  {
+    const char* ek[2] = {"MXTPU_TEST_PS_ENV", "DMLC_ROLE"};
+    const char* ev[2] = {"from-c", "worker"};
+    CHECK(MXInitPSEnv(2, ek, ev) == 0);
+    CHECK(getenv("MXTPU_TEST_PS_ENV") != NULL);
+    CHECK(strcmp(getenv("MXTPU_TEST_PS_ENV"), "from-c") == 0);
+    /* local store: command channel is a documented no-op */
+    CHECK(MXKVStoreSendCommmandToServers(kv, 7, "noop-body") == 0);
+    /* role=worker must refuse to serve, with an error — not block */
+    CHECK(MXKVStoreRunServer(kv, NULL, NULL) != 0);
+    CHECK(strstr(MXGetLastError(), "role") != NULL);
+    /* the REAL trampoline path: C controller invoked through the same
+     * capsule+PyCFunction machinery RunServer registers */
+    int ctl_calls = 0;
+    CHECK(MXTPUTestInvokeController(test_controller, &ctl_calls, 42,
+                                    "cmd-body") == 0);
+    CHECK(ctl_calls == 1 && g_ctl_head == 42);
+    CHECK(strcmp(g_ctl_body, "cmd-body") == 0);
+    printf("group:ps-env ok\n");
   }
 
   CHECK(MXNDArrayWaitAll() == 0);

@@ -60,7 +60,7 @@ def test_c_api_all_groups(tmp_path):
     for group in ("runtime", "oplist", "ndarray", "invoke", "saveload",
                   "kvstore", "dataiter", "autograd", "symexec",
                   "profiler", "ndarray-views", "recordio",
-                  "widening-misc", "widening-iter-gradex", "kv-updater"):
+                  "widening-misc", "widening-iter-gradex", "kv-updater", "ps-env"):
         assert ("group:%s ok" % group) in res.stdout, res.stdout
     assert "ALL-GROUPS-OK" in res.stdout, res.stdout
     assert profile_json.exists()  # chrome trace landed at the argv path

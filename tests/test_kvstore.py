@@ -329,3 +329,32 @@ def test_dist_sync_kvstore_ssh_launcher(tmp_path):
         env=env, capture_output=True, text=True, timeout=300, cwd=repo)
     assert res.returncode == 0, res.stdout + res.stderr
     assert res.stdout.count("DIST_SYNC_OK") == 2, res.stdout + res.stderr
+
+
+def test_server_controller_dispatches_app_commands():
+    """The MXKVStoreRunServer controller hook: non-builtin command heads
+    reach the controller; a raising controller returns an error reply
+    instead of killing the server.  _command is exercised directly —
+    Server.__init__ registers with a live scheduler, which the
+    multi-process dist tests cover."""
+    import threading
+
+    from mxtpu import _ps
+
+    got = []
+    srv = _ps.Server.__new__(_ps.Server)
+    srv._controller = lambda h, b: got.append((h, b))
+    srv._local_only = True
+    srv._lock = threading.Lock()
+    srv._updater = None
+
+    rep = srv._command({"head": "42", "body": b"payload"})
+    assert rep == {"ok": True}
+    assert got == [("42", b"payload")]
+
+    def boom(h, b):
+        raise RuntimeError("app bug")
+
+    srv._controller = boom
+    rep = srv._command({"head": "7", "body": b"x"})
+    assert "error" in rep and "controller failed" in rep["error"]
