@@ -274,7 +274,8 @@ def run_transformer(iters=12, warmup=1, B=8, T=1024, d_model=1024,
             return jax.grad(loss)(x)
 
         x = jnp.ones((2, 128, 64), jnp.bfloat16)
-        sm = jax.jit(jax.shard_map(
+        from mxtpu.parallel.mesh import get_shard_map
+        sm = jax.jit(get_shard_map()(
             probe, mesh=mesh, in_specs=P(), out_specs=P()))
         jax.block_until_ready(sm(x))
         used_pallas = True

@@ -49,6 +49,14 @@ del _os, _user_platforms
 from .base import MXNetError, MXTPUError
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, cpu_shared,
                       current_context, num_tpus, num_gpus)
+from . import compile_cache
+from .compile_cache import (enable_persistent_cache, disable_persistent_cache,
+                            set_bucket_policy)
+
+# MXTPU_COMPILE_CACHE=<dir|1>: turn on the persistent XLA compile cache
+# before anything can trigger a first compilation (JAX latches the
+# cache decision at first compile)
+compile_cache._maybe_enable_from_env()
 from . import base
 from . import context
 from . import ndarray

@@ -16,18 +16,22 @@ aux NDArrays (reference: in-place aux mutation during forward).
 """
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .base import MXNetError
 from . import autograd as _ag
+from . import compile_cache as _cc
 from .context import current_context
 from .executor import _build_graph_fn
 from .ndarray.ndarray import NDArray
 from .symbol.symbol import Symbol
 
 __all__ = ["CachedOp"]
+
+_DATA_NAME_RE = re.compile(r"^data\d*$")
 
 
 class CachedOp(object):
@@ -61,6 +65,20 @@ class CachedOp(object):
 
         self._jit_infer = jax.jit(fwd_infer)
         self._jit_train = jax.jit(fwd_train)
+        # donated variant for the NON-recording training path: the aux
+        # buffers (BN running stats) are dead after the call — __call__
+        # writes the returned aux straight back over them — so XLA may
+        # update them in place.  The recording path keeps the
+        # non-donated jit: there the aux NDArrays also feed the tape,
+        # and under jax.vjp tracing donation cannot apply anyway.
+        n_args = len(self._arg_names)
+        n_aux = len(self._aux_names)
+        if n_aux and _cc.donation_enabled():
+            self._jit_train_donated = jax.jit(
+                fwd_train,
+                donate_argnums=tuple(range(1 + n_args, 1 + n_args + n_aux)))
+        else:
+            self._jit_train_donated = None
         self._infer_fn = infer_fn
         self._fused_jits: Dict[Tuple[int, ...], Any] = {}
         self._has_rng = any((not n.is_variable) and n.op.needs_rng
@@ -68,6 +86,20 @@ class CachedOp(object):
         # graphs without RNG ops get one fixed key (avoids a host-side
         # key build + transfer on every hot-path call)
         self._fixed_key = None if self._has_rng else jax.random.PRNGKey(0)
+        # compile lifecycle: warmed AOT executables by input signature,
+        # seen-signature set for the profiler retrace stats, and the
+        # arg slots carrying the (bucketable) batch dim — explicit via
+        # the "data_indices" flag (HybridBlock/SymbolBlock set it),
+        # else the gluon trace naming convention
+        self._aot_infer: Dict[Tuple, Any] = {}
+        self._pad_masks: Dict[Tuple, Any] = {}
+        self._seen_sigs: set = set()
+        flag_idx = self._flags.get("data_indices")
+        if flag_idx is not None:
+            self._data_idx = [int(i) for i in flag_idx]
+        else:
+            self._data_idx = [i for i, n in enumerate(self._arg_names)
+                              if _DATA_NAME_RE.match(n)]
 
     @property
     def symbol(self) -> Symbol:
@@ -96,6 +128,7 @@ class CachedOp(object):
         recording = _ag.is_recording()
 
         if recording:
+            self._track_sig("train" if training else "infer", flat)
             if training:
                 def tupled(*xs):
                     return self._jit_train(key, *xs)
@@ -107,9 +140,11 @@ class CachedOp(object):
             outs, node = _ag._record_fn("_CachedOp", tupled, all_nd, flat)
         else:
             if training:
-                outs = self._jit_train(key, *flat)
+                self._track_sig("train", flat)
+                jit_train = self._jit_train_donated or self._jit_train
+                outs = jit_train(key, *flat)
             else:
-                outs = self._jit_infer(key, *flat)
+                outs = self._infer_dispatch(key, flat)
             node = None
 
         n_out = self._n_outputs
@@ -125,6 +160,137 @@ class CachedOp(object):
                 # detach from tape: aux updates carry no gradient
                 aux_arr._set_jax(new_val)
         return results
+
+    # -- compile lifecycle -------------------------------------------------
+    def set_data_indices(self, indices: Sequence[int]) -> None:
+        """Declare which arg slots carry the batch dim (the slots the
+        shape-bucketed dispatch pads).  HybridBlock/SymbolBlock call
+        this from their arg mapping; direct users whose data variables
+        don't follow the ``data%d`` naming convention should too."""
+        self._data_idx = [int(i) for i in indices]
+
+    def _bucket_spec(self) -> Optional[str]:
+        """Per-op flag (`hybridize(shape_buckets=...)`) wins over the
+        global MXTPU_SHAPE_BUCKETS policy."""
+        spec = self._flags.get("shape_buckets")
+        if spec is None:
+            return _cc.get_bucket_policy()
+        if spec is True:
+            return "pow2"
+        if spec in (False, "0", "off", "none"):
+            return None
+        return spec
+
+    def _track_sig(self, kind: str, flat_or_sig):
+        from . import profiler as _prof
+
+        sig = flat_or_sig if isinstance(flat_or_sig, tuple) \
+            else _cc.sig_of(flat_or_sig)
+        keyed = (kind, sig)
+        if keyed in self._seen_sigs:
+            _prof.inc_stat("cachedop_%s_hit" % kind)
+        else:
+            self._seen_sigs.add(keyed)
+            _prof.inc_stat("cachedop_%s_trace" % kind)
+
+    def _infer_dispatch(self, key, flat: List[Any]):
+        """Inference hot path: bucket-pad ragged batch dims, then serve
+        from a warmed AOT executable when one matches, else the jit."""
+        from . import profiler as _prof
+
+        spec = self._bucket_spec()
+        if spec is not None and self._data_idx:
+            sizes = {flat[i].shape[0] for i in self._data_idx
+                     if flat[i].ndim > 0}
+            if len(sizes) == 1:
+                b = sizes.pop()
+                bp = _cc.bucket_batch(b, spec)
+                if bp != b:
+                    mask = self._pad_mask(flat, b, bp)
+                    if mask is None:
+                        # some output does not track the batch dim (a
+                        # reduction over batch would be polluted by pad
+                        # rows) — run this shape exact instead
+                        _prof.inc_stat("cachedop_bucket_fallback")
+                    else:
+                        flat = list(flat)
+                        for i in self._data_idx:
+                            flat[i] = _cc.pad_leading(flat[i], bp)
+                        _prof.inc_stat("cachedop_bucket_pad")
+                        outs = self._run_infer(key, flat)
+                        return tuple(o[:b] if m else o
+                                     for o, m in zip(outs, mask))
+        return self._run_infer(key, flat)
+
+    def _run_infer(self, key, flat):
+        from . import profiler as _prof
+
+        sig = _cc.sig_of(flat)
+        compiled = self._aot_infer.get(sig)
+        if compiled is not None:
+            _prof.inc_stat("cachedop_aot_hit")
+            return compiled(key, *flat)
+        self._track_sig("infer", sig)
+        return self._jit_infer(key, *flat)
+
+    def _pad_mask(self, flat, b: int, bp: int):
+        """Per-output slice mask for padding b -> bp, from shape
+        inference (cached).  None = padding unsafe for this graph/shape
+        (an output doesn't carry the batch dim)."""
+        shapes_u = tuple(tuple(v.shape) for v in flat[:len(self._arg_names)])
+        key = (b, bp, shapes_u)
+        if key in self._pad_masks:
+            return self._pad_masks[key]
+        data = set(self._data_idx)
+        shapes_p = tuple((bp,) + s[1:] if i in data else s
+                         for i, s in enumerate(shapes_u))
+        mask = _cc.batch_output_mask(self._symbol, self._arg_names,
+                                     shapes_u, shapes_p)
+        if mask is not None and not all(mask):
+            mask = None
+        self._pad_masks[key] = mask
+        return mask
+
+    @staticmethod
+    def _spec(item, default_dtype) -> Tuple[Tuple[int, ...], np.dtype]:
+        if hasattr(item, "shape") and hasattr(item, "dtype"):
+            return (tuple(item.shape), np.dtype(item.dtype))
+        if isinstance(item, (tuple, list)) and len(item) == 2 \
+                and isinstance(item[0], (tuple, list)):
+            return (tuple(item[0]), np.dtype(item[1]))
+        return (tuple(item), np.dtype(default_dtype))
+
+    def warmup(self, args: Sequence[Any], aux: Sequence[Any] = (),
+               dtype="float32"):
+        """AOT-compile the inference program for one input signature
+        via ``jit(...).lower().compile()`` — no execution, and calls
+        matching the signature dispatch straight to the stored
+        executable (zero further compiles).  ``args``/``aux`` entries
+        are arrays, shape tuples (``dtype`` fills in), or
+        ``(shape, dtype)`` pairs, in `symbol.list_arguments()` /
+        `list_auxiliary_states()` order.  Call once per serving bucket
+        to pre-build the whole bucket set.  Returns self."""
+        import jax
+
+        from . import profiler as _prof
+
+        specs = [self._spec(a, dtype) for a in args]
+        aux_specs = [self._spec(a, "float32") for a in aux]
+        if len(specs) != len(self._arg_names) or \
+                len(aux_specs) != len(self._aux_names):
+            raise MXNetError(
+                "warmup expects %d args + %d aux shapes, got %d + %d"
+                % (len(self._arg_names), len(self._aux_names),
+                   len(specs), len(aux_specs)))
+        sig = tuple((s, str(d)) for s, d in specs + aux_specs)
+        if sig in self._aot_infer:
+            return self
+        k = jax.random.PRNGKey(0)
+        structs = [jax.ShapeDtypeStruct(k.shape, k.dtype)] + \
+            [jax.ShapeDtypeStruct(s, d) for s, d in specs + aux_specs]
+        self._aot_infer[sig] = _cc.aot_compile(self._jit_infer, structs)
+        _prof.inc_stat("cachedop_warmup")
+        return self
 
     def call_fused(self, args: Sequence[NDArray],
                    aux_arrays: Sequence[NDArray] = (),

@@ -1,0 +1,279 @@
+"""Compilation lifecycle: persistent XLA cache, shape buckets, AOT warmup.
+
+The reference amortizes graph setup cost with the NNVM graph cache
+(`src/imperative/cached_op.cc`) but still pays full backend codegen on
+every process start, and a new input shape means a new engine plan.  On
+the XLA substrate both costs are explicit and much larger — a ResNet
+bind is seconds of HLO compilation — so this module owns the three
+levers that make "compile once, serve many" real:
+
+  * **Persistent compile cache** — wires JAX's on-disk compilation
+    cache (``jax_compilation_cache_dir``) behind one env knob
+    (``MXTPU_COMPILE_CACHE``) / API (:func:`enable_persistent_cache`),
+    with the thresholds dropped to zero so every program is eligible.
+    The second process start of the same model skips XLA entirely.
+
+  * **Shape-bucketed dispatch** — serving traffic with ragged leading
+    batch dims is padded up to a bounded bucket set (power-of-two by
+    default; ``MXTPU_SHAPE_BUCKETS`` picks the policy) so the hot path
+    runs a FIXED set of compiled programs instead of one per distinct
+    batch size.  Outputs are sliced back; per-sample inference math is
+    unaffected by pad rows.  Used by ``CachedOp.__call__`` and
+    ``Executor.forward(is_train=False)``.
+
+  * **AOT warmup** — ``Executor.warmup()`` / ``CachedOp.warmup()``
+    build executables ahead of time via ``jit(...).lower().compile()``
+    (the pattern proven by ``FusedTrainLoop.lower_stacked``) and the
+    call paths dispatch straight to the stored executable, so the
+    first request after warmup compiles NOTHING.
+
+Retrace/hit accounting for all three levers flows through
+``mxtpu.profiler`` stats (see ``profiler.stats()``), and
+``tools/check_retrace.py`` turns that into a CI guard.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .base import MXNetError, getenv
+
+__all__ = [
+    "enable_persistent_cache",
+    "disable_persistent_cache",
+    "persistent_cache_dir",
+    "set_bucket_policy",
+    "get_bucket_policy",
+    "bucket_batch",
+    "bucketing_enabled",
+    "donation_enabled",
+    "pad_leading",
+    "sig_of",
+    "aot_compile",
+]
+
+_DEFAULT_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "mxtpu", "xla_cache")
+
+_lock = threading.Lock()
+_cache_dir: Optional[str] = None
+_policy_override: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# Persistent on-disk compilation cache
+# ---------------------------------------------------------------------------
+
+def enable_persistent_cache(path: Optional[str] = None) -> str:
+    """Enable JAX's persistent compilation cache at ``path``.
+
+    ``path`` defaults to ``MXTPU_COMPILE_CACHE`` (a value of ``1`` means
+    the default ``~/.cache/mxtpu/xla_cache``).  Safe to call at any
+    point: JAX latches its cache-enabled decision at the first
+    compilation, so this resets that latch when needed.  Returns the
+    active cache directory.
+    """
+    global _cache_dir
+    if path is None:
+        env = getenv("MXTPU_COMPILE_CACHE")
+        path = _DEFAULT_CACHE_DIR if env in (None, "", "1", "true") else env
+    path = os.path.abspath(os.path.expanduser(path))
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    with _lock:
+        jax.config.update("jax_compilation_cache_dir", path)
+        # every executor/CachedOp program should be cache-eligible, not
+        # just the ones above JAX's default size/time thresholds — a
+        # serving fleet cold-starts hundreds of small bucket programs
+        for name, val in (("jax_persistent_cache_min_entry_size_bytes", -1),
+                          ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+            if hasattr(jax.config, name):
+                jax.config.update(name, val)
+        _reset_jax_cache_latch()
+        _cache_dir = path
+    from . import profiler as _prof
+
+    _prof.inc_stat("persistent_cache_enabled", 0)  # ensure key exists
+    return path
+
+
+def disable_persistent_cache() -> None:
+    global _cache_dir
+    import jax
+
+    with _lock:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset_jax_cache_latch()
+        _cache_dir = None
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The active on-disk cache directory, or None when disabled."""
+    return _cache_dir
+
+
+def _reset_jax_cache_latch() -> None:
+    """JAX decides once per process whether the cache is used; flipping
+    the config after the first compile is a silent no-op without this."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - internal API moved
+        pass
+
+
+def _maybe_enable_from_env() -> None:
+    """Import-time hook: honor MXTPU_COMPILE_CACHE before any compile."""
+    env = getenv("MXTPU_COMPILE_CACHE")
+    if env not in (None, "", "0", "false", "False"):
+        enable_persistent_cache()
+
+
+# ---------------------------------------------------------------------------
+# Shape buckets
+# ---------------------------------------------------------------------------
+
+def set_bucket_policy(spec: Optional[str]) -> None:
+    """Set the process-wide bucket policy, overriding the env knob.
+
+    Specs: ``"pow2"`` (pad the leading batch dim up to the next power of
+    two), ``"mult:N"`` (round up to a multiple of N), ``"fixed:a,b,c"``
+    (smallest listed bucket that fits; larger batches run exact), or
+    ``None``/``"off"`` to disable.
+    """
+    global _policy_override
+    if spec is not None and spec not in ("off", "none", "0", "false",
+                                         "False", "1", "true", "True"):
+        _parse_policy(spec)  # validate eagerly
+    _policy_override = spec
+
+
+def get_bucket_policy() -> Optional[str]:
+    """The active bucket policy spec, or None when bucketing is off.
+
+    Resolution order: :func:`set_bucket_policy` override, then the
+    ``MXTPU_SHAPE_BUCKETS`` env var (``1`` means ``pow2``).
+    """
+    spec = _policy_override
+    if spec is None:
+        spec = getenv("MXTPU_SHAPE_BUCKETS")
+    if spec in (None, "", "0", "off", "false", "False", "none"):
+        return None
+    return "pow2" if spec in ("1", "true", "True") else spec
+
+
+def bucketing_enabled() -> bool:
+    return get_bucket_policy() is not None
+
+
+@functools.lru_cache(maxsize=64)
+def _parse_policy(spec: str):
+    if spec == "pow2":
+        return ("pow2",)
+    if spec.startswith("mult:"):
+        n = int(spec[5:])
+        if n < 1:
+            raise MXNetError("mult bucket step must be >= 1, got %d" % n)
+        return ("mult", n)
+    if spec.startswith("fixed:"):
+        sizes = sorted(int(s) for s in spec[6:].split(",") if s)
+        if not sizes:
+            raise MXNetError("fixed bucket policy needs at least one size")
+        return ("fixed", sizes)
+    raise MXNetError(
+        "bucket policy must be 'pow2', 'mult:N' or 'fixed:a,b,...' "
+        "(got %r)" % (spec,))
+
+
+def bucket_batch(n: int, spec: Optional[str] = None) -> int:
+    """The padded leading dim for a ragged batch of ``n`` under the
+    active (or given) policy.  Always >= n; returns n when bucketing is
+    off or no bucket fits."""
+    if spec is None:
+        spec = get_bucket_policy()
+    if spec is None or n < 1:
+        return n
+    policy = _parse_policy(spec)
+    if policy[0] == "pow2":
+        b = 1
+        while b < n:
+            b <<= 1
+        return b
+    if policy[0] == "mult":
+        step = policy[1]
+        return ((n + step - 1) // step) * step
+    for size in policy[1]:
+        if size >= n:
+            return size
+    return n
+
+
+def pad_leading(val, target: int):
+    """Zero-pad a jax array's leading dim up to ``target`` rows."""
+    import jax.numpy as jnp
+
+    n = val.shape[0]
+    if n == target:
+        return val
+    return jnp.pad(val, [(0, target - n)] + [(0, 0)] * (val.ndim - 1))
+
+
+def batch_output_mask(symbol, arg_names: Sequence[str],
+                      unpadded_shapes: Sequence[Tuple[int, ...]],
+                      padded_shapes: Sequence[Tuple[int, ...]]):
+    """Which graph outputs carry the (padded) batch dim, decided by
+    shape inference rather than by guessing from the runtime shapes: an
+    output whose leading dim coincidentally equals the bucket size
+    (e.g. a transposed (features, B) head) must NOT be sliced.  Returns
+    a per-output bool list (True = slice the pad rows off), or None
+    when inference cannot decide (callers fall back to returning
+    unsliced outputs and the exact-shape dispatch)."""
+    try:
+        _, outs_u, _ = symbol.infer_shape_partial(
+            **dict(zip(arg_names, unpadded_shapes)))
+        _, outs_p, _ = symbol.infer_shape_partial(
+            **dict(zip(arg_names, padded_shapes)))
+    except Exception:
+        return None
+    if outs_u is None or outs_p is None:
+        return None
+    mask = []
+    for su, sp in zip(outs_u, outs_p):
+        if su is None or sp is None:
+            return None
+        # batch-major <=> the leading dim tracked the padding
+        mask.append(bool(su) and bool(sp) and su[0] != sp[0])
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# Donation + AOT helpers
+# ---------------------------------------------------------------------------
+
+def donation_enabled() -> bool:
+    """Buffer donation on the executor/CachedOp training hot paths
+    (``MXTPU_DONATE``, default on)."""
+    return getenv("MXTPU_DONATE", "1") not in ("0", "false", "False")
+
+
+def sig_of(vals: Sequence[Any]) -> Tuple:
+    """Hashable shape/dtype signature of a flat list of arrays."""
+    return tuple((tuple(v.shape), str(v.dtype)) for v in vals)
+
+
+def aot_compile(jitfn, example_args):
+    """``jit(...).lower(*args).compile()``: build the executable without
+    running it.  ``example_args`` may be arrays or ShapeDtypeStructs;
+    the returned Compiled object is called with matching concrete
+    arrays and NEVER touches the jit's trace/compile cache."""
+    return jitfn.lower(*example_args).compile()
+
+
+def shape_struct(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)

@@ -225,13 +225,27 @@ class Executor(object):
             (dgrads,) = vjp((list(ograds), zero_aux))
             return outs, dgrads, aux_new
 
+        from . import compile_cache as _cc
+
+        # donate the aux buffers (BN running stats) on the training hot
+        # paths: forward writes fresh aux back every step anyway, so the
+        # old buffers are dead the moment the program runs — donation
+        # lets XLA update them in place instead of allocating new HBM
+        # per step (fused_train.py and the optimizer kernels already do
+        # this).  ograds are NOT donated: the default ones head-gradients
+        # are a cached step-invariant buffer (see _forward_impl), and
+        # donating would delete it after the first step, forcing a fresh
+        # host->device ones transfer per step — strictly worse than the
+        # copy donation saves.  MXTPU_DONATE=0 opts out.
+        self._donate = _cc.donation_enabled()
+        aux_dn = (1,) if self._donate else ()
         self._jit_fwd_infer = jax.jit(fwd_infer)
-        self._jit_step = jax.jit(fused_step)
+        self._jit_step = jax.jit(fused_step, donate_argnums=aux_dn)
 
         def fwd_train_only(arg_vals, aux_vals, key):
             return train_fn(arg_vals, aux_vals, key)
 
-        self._jit_fwd_train = jax.jit(fwd_train_only)
+        self._jit_fwd_train = jax.jit(fwd_train_only, donate_argnums=aux_dn)
         self._cached_grads = None
 
         # explicit-ograd support: forward returns outputs PLUS the vjp
@@ -257,11 +271,19 @@ class Executor(object):
             (dgrads,) = vjp((list(ograds), zero_aux))
             return dgrads
 
-        self._jit_fwd_vjp = jax.jit(fwd_vjp)
+        self._jit_fwd_vjp = jax.jit(fwd_vjp, donate_argnums=aux_dn)
         self._jit_apply_vjp = jax.jit(apply_vjp)
         self._explicit_ograd_mode = False
         self._cached_vjp = None
         self._last_fwd_state = None
+
+        # compile-lifecycle bookkeeping: AOT executables from warmup()
+        # keyed by input signature, and the set of signatures this
+        # executor has dispatched (drives the profiler retrace stats)
+        self._aot_infer = None
+        self._aot_step = None
+        self._seen_sigs: set = set()
+        self._pad_masks: Dict = {}
 
     # -- binding entry points --------------------------------------------
     @staticmethod
@@ -365,12 +387,26 @@ class Executor(object):
         return self._forward_impl(is_train, **kwargs)
 
     def _forward_impl(self, is_train: bool = False, **kwargs):
+        from . import compile_cache as _cc
+        from . import profiler as _prof
+
+        # inference inputs whose leading batch dim differs from the
+        # bound shape: routed through the bucketed dispatch below
+        # instead of mutating the bound arrays (arg position -> value)
+        ragged: Dict[int, Any] = {}
         for name, val in kwargs.items():
             if name not in self.arg_dict:
                 raise MXNetError("unknown argument %r" % name)
             dst = self.arg_dict[name]
             src = val if isinstance(val, NDArray) else NDArray(val, ctx=self._ctx)
             if src.shape != dst.shape:
+                if not is_train and len(src.shape) == len(dst.shape) \
+                        and src.shape[1:] == dst.shape[1:] \
+                        and _cc.bucketing_enabled():
+                    ragged[self._arg_names.index(name)] = (
+                        src._data.astype(dst.dtype)
+                        if src.dtype != dst.dtype else src._data)
+                    continue
                 raise MXNetError("shape mismatch for %r: %s vs bound %s"
                                  % (name, src.shape, dst.shape))
             dst._set_jax(src._data.astype(dst.dtype)
@@ -378,10 +414,18 @@ class Executor(object):
         key = self._key()
         self._last_key = key  # reused by explicit-ograd backward so the
         # gradients see the SAME dropout/random masks as these outputs
+        # when donating, the pre-step aux buffers die inside the jit
+        # call, so _last_fwd_state must not capture them — the explicit-
+        # ograd fallback in backward() substitutes the (post-writeback)
+        # current aux instead, which leaves gradients unchanged: in
+        # train mode BatchNorm outputs use batch stats, so aux only
+        # feeds the momentum update whose cotangent is zeroed
+        saved_aux = None if self._donate else self._aux_vals()
         if is_train and self._diff_idx and self._explicit_ograd_mode:
             # split path: outputs + residual-closing vjp in one dispatch;
             # backward applies the cached pullback (no fwd recompute)
-            self._last_fwd_state = (self._arg_vals(), self._aux_vals(), key)
+            self._track_sig("train", self._arg_vals())
+            self._last_fwd_state = (self._arg_vals(), saved_aux, key)
             outs, aux_new, vjp = self._jit_fwd_vjp(
                 self._arg_vals(), self._aux_vals(), key)
             self._cached_vjp = (vjp, aux_new)
@@ -401,20 +445,137 @@ class Executor(object):
             # remembered so a FIRST explicit-ograd backward can build
             # the vjp for THIS step without semantic drift (jax arrays
             # are immutable; holding the refs is free)
-            self._last_fwd_state = (self._arg_vals(), self._aux_vals(), key)
-            outs, grads, aux_new = self._jit_step(
-                self._arg_vals(), self._aux_vals(), key, ograds)
+            self._last_fwd_state = (self._arg_vals(), saved_aux, key)
+            if self._aot_step is not None:
+                _prof.inc_stat("executor_aot_hit")
+                outs, grads, aux_new = self._aot_step(
+                    self._arg_vals(), self._aux_vals(), key, ograds)
+            else:
+                self._track_sig("train", self._arg_vals())
+                outs, grads, aux_new = self._jit_step(
+                    self._arg_vals(), self._aux_vals(), key, ograds)
             self._cached_grads = grads
             self._write_aux(aux_new)
         elif is_train:
+            self._track_sig("train", self._arg_vals())
             outs, aux_new = self._jit_fwd_train(
                 self._arg_vals(), self._aux_vals(), key)
             self._write_aux(aux_new)
+        elif ragged:
+            outs = self._forward_bucketed(ragged, key)
+        elif self._aot_infer is not None:
+            _prof.inc_stat("executor_aot_hit")
+            outs = self._aot_infer(self._arg_vals(), self._aux_vals(), key)
         else:
+            self._track_sig("infer", self._arg_vals())
             outs = self._jit_fwd_infer(self._arg_vals(), self._aux_vals(), key)
         self.outputs = [NDArray(o, ctx=self._ctx, _committed=True)
                         for o in outs]
         return self.outputs
+
+    def _forward_bucketed(self, ragged: Dict[int, Any], key):
+        """Inference dispatch for inputs whose leading batch dim differs
+        from the bound shape: pad up to the policy's bucket so a bounded
+        set of compiled programs serves ALL ragged sizes, then slice the
+        batch-carrying outputs back (which outputs those are comes from
+        shape inference, cached — see compile_cache.batch_output_mask).
+        Bound arg arrays are left untouched (only this dispatch sees the
+        padded values).  Shapes whose outputs don't all track the batch
+        dim run exact (unpadded) instead — correct, one compile per
+        size."""
+        from . import compile_cache as _cc
+        from . import profiler as _prof
+
+        sizes = {v.shape[0] for v in ragged.values()}
+        if len(sizes) != 1:
+            raise MXNetError("ragged inputs disagree on leading batch "
+                             "dim: %s" % sorted(sizes))
+        b = sizes.pop()
+        bp = _cc.bucket_batch(b)
+        mask = None
+        if bp != b:
+            mask = self._pad_mask(ragged, b, bp)
+        call_vals = self._arg_vals()
+        if mask is not None:
+            for i, v in ragged.items():
+                call_vals[i] = _cc.pad_leading(v, bp)
+            _prof.inc_stat("executor_bucket_pad")
+        else:
+            for i, v in ragged.items():
+                call_vals[i] = v
+            if bp != b:
+                _prof.inc_stat("executor_bucket_fallback")
+        self._track_sig("infer", call_vals)
+        outs = self._jit_fwd_infer(call_vals, self._aux_vals(), key)
+        if mask is not None:
+            outs = [o[:b] if m else o for o, m in zip(outs, mask)]
+        return outs
+
+    def _pad_mask(self, ragged: Dict[int, Any], b: int, bp: int):
+        """Per-output slice mask for padding b -> bp (cached); None when
+        padding is unsafe (some output does not carry the batch dim)."""
+        from . import compile_cache as _cc
+
+        shapes_u = tuple((b,) + tuple(a.shape[1:])
+                         if i in ragged else tuple(a.shape)
+                         for i, a in enumerate(self.arg_arrays))
+        key = (b, bp, shapes_u)
+        if key in self._pad_masks:
+            return self._pad_masks[key]
+        shapes_p = tuple((bp,) + s[1:] if i in ragged else s
+                         for i, s in enumerate(shapes_u))
+        mask = _cc.batch_output_mask(self._symbol, self._arg_names,
+                                     shapes_u, shapes_p)
+        if mask is not None and not all(mask):
+            mask = None
+        self._pad_masks[key] = mask
+        return mask
+
+    def _track_sig(self, kind: str, vals):
+        from . import compile_cache as _cc
+        from . import profiler as _prof
+
+        sig = (kind, _cc.sig_of(vals))
+        if sig in self._seen_sigs:
+            _prof.inc_stat("executor_%s_hit" % kind)
+        else:
+            self._seen_sigs.add(sig)
+            _prof.inc_stat("executor_%s_trace" % kind)
+
+    def warmup(self, for_training: Optional[bool] = None):
+        """AOT-compile this executor's programs via
+        ``jit(...).lower().compile()`` (no execution) and dispatch
+        subsequent calls straight to the stored executables, so the
+        first real request after warmup compiles nothing.  With the
+        persistent compile cache enabled the lower/compile here is a
+        disk hit on warm process starts — together they make the
+        serving cold-start a pure deserialization.  Compiles the
+        inference program always and the fused train step when this
+        executor has gradients (override with ``for_training``).
+        Returns self."""
+        import jax
+
+        from . import compile_cache as _cc
+        from . import profiler as _prof
+
+        if for_training is None:
+            for_training = bool(self._diff_idx)
+        args = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                for a in self.arg_arrays]
+        aux = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+               for a in self.aux_arrays]
+        k = jax.random.PRNGKey(0)
+        key = jax.ShapeDtypeStruct(k.shape, k.dtype)
+        self._aot_infer = _cc.aot_compile(self._jit_fwd_infer,
+                                          (args, aux, key))
+        _prof.inc_stat("executor_warmup")
+        if for_training and self._diff_idx:
+            ograds = [jax.ShapeDtypeStruct(s, d)
+                      for s, d in self._out_avals()]
+            self._aot_step = _cc.aot_compile(self._jit_step,
+                                             (args, aux, key, ograds))
+            _prof.inc_stat("executor_warmup")
+        return self
 
     def backward(self, out_grads=None):
         if not self._diff_idx:
@@ -452,7 +613,21 @@ class Executor(object):
                     arg_vals, aux_vals, key = self._last_fwd_state
                 else:
                     key = getattr(self, "_last_key", None) or self._key()
-                    arg_vals, aux_vals = self._arg_vals(), self._aux_vals()
+                    arg_vals, aux_vals = self._arg_vals(), None
+                if aux_vals is None:
+                    # donation mode never stores aux (the buffers were
+                    # donated into the forward); the current post-update
+                    # aux yields identical grads — see _forward_impl
+                    aux_vals = self._aux_vals()
+                if self._donate:
+                    import jax.numpy as jnp
+
+                    # _jit_fwd_vjp donates its aux argument, but here the
+                    # executor's live aux arrays fill that slot and the
+                    # recomputed aux_new is discarded (it was already
+                    # applied by the forward) — feed copies so the live
+                    # buffers survive this one-time mode switch
+                    aux_vals = [jnp.copy(a) for a in aux_vals]
                 _, aux_new, vjp = self._jit_fwd_vjp(arg_vals, aux_vals, key)
                 grads = self._jit_apply_vjp(vjp, ograds, aux_new)
         for j, i in enumerate(self._diff_idx):

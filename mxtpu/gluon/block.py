@@ -372,6 +372,10 @@ class HybridBlock(Block):
                                      "parameter %r" % name)
                 self._cached_arg_map.append(by_name[name])
         self._cached_aux = [by_name[name] for name in aux_names]
+        # the data slots are the bucketable (ragged-batch) args
+        self._cached_op.set_data_indices(
+            [pos for pos, slot in enumerate(self._cached_arg_map)
+             if isinstance(slot, int)])
 
     def _collect_all_reg_params(self):
         out = dict(self._reg_params)
@@ -519,6 +523,47 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
+    # -- AOT warmup --------------------------------------------------------
+    def warmup(self, input_shapes, dtype="float32"):
+        """AOT-compile the hybridized graph for the given data input
+        shapes WITHOUT running a batch (`CachedOp.warmup`, built on
+        ``jit(...).lower().compile()``).
+
+        ``input_shapes`` is one signature — a shape tuple per data
+        input, e.g. ``[(8, 3, 224, 224)]`` — or a list of signatures,
+        e.g. one per serving bucket.  Parameters must be initialized;
+        the cache is traced from dummy zeros of the first signature if
+        absent.  With `MXTPU_COMPILE_CACHE` enabled, warmup on a warm
+        process start deserializes from disk instead of compiling."""
+        if not self._active:
+            raise MXNetError("warmup requires hybridize()")
+        sigs = list(input_shapes)
+        if not sigs:
+            raise MXNetError("warmup needs at least one input shape")
+        if isinstance(sigs[0][0], int):
+            sigs = [sigs]  # a single signature was passed
+        if self._cached_op is None:
+            dummies = [nd_mod.zeros(tuple(s), dtype=dtype)
+                       for s in sigs[0]]
+            try:
+                for p in self._collect_all_reg_params().values():
+                    p.data()
+            except (DeferredInitializationError, MXNetError):
+                self._deferred_infer_shape(*dummies)
+                for p in self._collect_all_params():
+                    p._finish_deferred_init()
+            self._build_cache(*dummies)
+        aux_specs = [p.data() for p in self._cached_aux]
+        for sig in sigs:
+            arg_specs = []
+            for slot in self._cached_arg_map:
+                if isinstance(slot, int):
+                    arg_specs.append((tuple(sig[slot]), dtype))
+                else:
+                    arg_specs.append(slot.data())
+            self._cached_op.warmup(arg_specs, aux_specs, dtype=dtype)
+        return self
+
     # -- export -----------------------------------------------------------
     def export(self, path, epoch=0):
         """Save symbol + params like the reference `block.py:868`
@@ -601,6 +646,9 @@ class SymbolBlock(HybridBlock):
             else:
                 self._cached_arg_map.append(by_name[name])
         self._cached_aux = [by_name[n] for n in self._cached_op._aux_names]
+        self._cached_op.set_data_indices(
+            [pos for pos, slot in enumerate(self._cached_arg_map)
+             if isinstance(slot, int)])
         n_out = len(self._symbol.list_outputs())
         self._out_fmt = 0 if n_out == 1 else [0] * n_out
         self._in_fmt = [0] * n_inputs

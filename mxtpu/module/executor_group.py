@@ -191,6 +191,57 @@ class DataParallelExecutorGroup(object):
         for ex in self.execs:
             ex.forward(is_train=is_train)
 
+    def _ragged_slots(self, data_batch):
+        """(name, array) pairs the ragged dispatch would feed: the data
+        slots plus the label slots when the batch carries labels (so a
+        label-consuming graph sees THIS batch's labels, not the stale
+        bound ones)."""
+        pairs = list(zip(self.data_names, data_batch.data))
+        labels = getattr(data_batch, "label", None)
+        if labels:
+            pairs += list(zip(self.label_names, labels))
+        return pairs
+
+    def can_forward_ragged(self, data_batch) -> bool:
+        """Whether a batch whose leading dim differs from the bound
+        shapes can be served through the executor's shape-bucketed
+        inference dispatch instead of a full rebind: single executor,
+        bucketing on, and every data/label slot sharing ONE leading
+        batch dim with trailing dims matching the bound shapes."""
+        from .. import compile_cache as _cc
+
+        if len(self.execs) != 1 or not _cc.bucketing_enabled():
+            return False
+        ex = self.execs[0]
+        leading = set()
+        for name, arr in self._ragged_slots(data_batch):
+            if name not in ex.arg_dict:
+                return False
+            bound = ex.arg_dict[name].shape
+            if len(arr.shape) != len(bound) or \
+                    tuple(arr.shape[1:]) != tuple(bound[1:]) or \
+                    len(arr.shape) == 0:
+                return False
+            leading.add(arr.shape[0])
+        return len(leading) == 1
+
+    def forward_ragged(self, data_batch):
+        """Single-executor inference over a ragged batch: the executor
+        pads the leading dim up to the active bucket and slices the
+        outputs back — no rebind, no per-shape compile (see
+        `mxtpu/compile_cache.py`)."""
+        ex = self.execs[0]
+        kwargs = {}
+        for name, arr in self._ragged_slots(data_batch):
+            kwargs[name] = arr if isinstance(arr, NDArray) \
+                else NDArray(arr, ctx=ex._ctx)
+        ex.forward(is_train=False, **kwargs)
+
+    def warmup(self):
+        """AOT-compile every executor's programs (Executor.warmup)."""
+        for ex in self.execs:
+            ex.warmup()
+
     def backward(self, out_grads=None):
         if not self.for_training:
             raise MXNetError("re-bind with for_training=True to backward")

@@ -291,6 +291,21 @@ class Module(BaseModule):
         # a rebind, or labels would silently never be copied in
         needs_label_rebind = (has_label and self.for_training
                               and not self._exec_group.label_shapes)
+        effective_train = self.for_training if is_train is None else is_train
+        if curr_shapes != new_shapes and not effective_train \
+                and self._exec_group.can_forward_ragged(data_batch):
+            # serving path: a ragged inference batch rides the
+            # executor's shape-bucketed dispatch — the rebind below
+            # would rebuild the executor and recompile per batch size.
+            # A graph the bucketed dispatch can't serve (e.g. one that
+            # combines a ragged input with a bound-shape arg the batch
+            # didn't provide) falls through to the rebind path.
+            try:
+                self._exec_group.forward_ragged(data_batch)
+                return
+            except Exception:
+                self.logger.debug("bucketed dispatch failed; rebinding",
+                                  exc_info=True)
         if curr_shapes != new_shapes or needs_label_rebind:
             new_dshapes = [DataDesc(d.name, s) for d, s in
                            zip(self._exec_group.data_shapes, new_shapes)]
@@ -331,6 +346,16 @@ class Module(BaseModule):
             self._monitor.exes = [e for e in self._monitor.exes
                                   if id(e) not in old_execs]
             self._exec_group.install_monitor(self._monitor)
+
+    def warmup(self):
+        """AOT-compile the bound executors' programs
+        (`Executor.warmup`): with the persistent compile cache enabled
+        this turns the serving cold-start into cache deserialization,
+        and the first real batch compiles nothing."""
+        if not self.binded:
+            raise MXNetError("bind() first")
+        self._exec_group.warmup()
+        return self
 
     def backward(self, out_grads=None):
         if not (self.binded and self.params_initialized):

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 from typing import Optional
+from .mesh import axis_size as _axis_size
 
 __all__ = ["ring_attention", "blockwise_attention", "ring_self_attention"]
 
@@ -174,7 +175,7 @@ def _ring_forward(q, k, v, axis_name, causal, scale):
     import jax
     import jax.numpy as jnp
 
-    sp_size = jax.lax.axis_size(axis_name)
+    sp_size = _axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, H, T, D = q.shape
     perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
@@ -217,7 +218,7 @@ def _ring_backward(q, k, v, out, lse, g, axis_name, causal, scale):
     import jax
     import jax.numpy as jnp
 
-    sp_size = jax.lax.axis_size(axis_name)
+    sp_size = _axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, H, T, D = q.shape
     perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
@@ -317,7 +318,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     # differentiated by JAX AD through its block loop, which stashes
     # O(T^2/block) probability residuals — exactly the memory blowup
     # this module's recompute backward exists to avoid.
-    if jax.lax.axis_size(axis_name) == 1 and _pallas_enabled() \
+    if _axis_size(axis_name) == 1 and _pallas_enabled() \
             and q.shape[2] == k.shape[2]:
         return blockwise_attention(q, k, v, causal=causal, scale=scale,
                                    use_pallas=True)

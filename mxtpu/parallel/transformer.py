@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
 from ..base import MXNetError
+from .mesh import get_shard_map as _shard_map
 from .mesh import create_mesh, AXIS_DP, AXIS_TP, AXIS_PP, AXIS_SP, AXIS_EP
 from .ring_attention import ring_attention, _match_vma
 
@@ -659,7 +660,7 @@ def _make_step_common(cfg, mesh, n_micro, lr, optimizer, betas, eps,
                 return lax.scan(body, params, (toks_stack, labs_stack),
                                 length=k_steps)
 
-        sm = jax.shard_map(device_fn, mesh=mesh,
+        sm = _shard_map()(device_fn, mesh=mesh,
                            in_specs=(pspecs, data_spec, data_spec),
                            out_specs=(pspecs, P()))
         return jax.jit(sm, donate_argnums=(0,)), shardings
@@ -682,7 +683,7 @@ def _make_step_common(cfg, mesh, n_micro, lr, optimizer, betas, eps,
 
     ospecs = _opt_state_specs(cfg, mesh)
     ostate_specs = {"m": dict(ospecs), "v": dict(ospecs), "t": P()}
-    sm = jax.shard_map(device_fn, mesh=mesh,
+    sm = _shard_map()(device_fn, mesh=mesh,
                        in_specs=(pspecs, ostate_specs, data_spec,
                                  data_spec),
                        out_specs=(pspecs, ostate_specs, P()))
@@ -789,7 +790,7 @@ def make_forward(cfg: TransformerConfig, mesh):
             (AXIS_PP, AXIS_EP))
         return logits
 
-    sm = jax.shard_map(fwd, mesh=mesh,
+    sm = _shard_map()(fwd, mesh=mesh,
                        in_specs=({k: v for k, v in specs.items()},
                                  P(AXIS_DP, AXIS_SP)),
                        out_specs=P(AXIS_DP, AXIS_SP, AXIS_TP))

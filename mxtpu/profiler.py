@@ -28,7 +28,8 @@ from .base import MXNetError
 
 __all__ = ["set_config", "set_state", "state", "pause", "resume", "dump",
            "dumps", "Domain", "Task", "Frame", "Counter", "Marker",
-           "start_xplane", "stop_xplane"]
+           "start_xplane", "stop_xplane",
+           "inc_stat", "get_stat", "stats", "reset_stats"]
 
 _lock = threading.Lock()
 _RUNNING = False
@@ -108,6 +109,42 @@ def record_span(name: str, cat: str, ts_us: float, dur_us: float,
                         "ts": ts_us, "dur": dur_us, "pid": 0, "tid": tid,
                         **({"args": args} if args else {})})
         _AGG.setdefault(name, []).append(dur_us)
+
+
+# -- compile-lifecycle stats ----------------------------------------------
+# Always-on counters (a dict bump, not gated on set_state) so retrace
+# regressions on the dispatch hot path are observable without turning
+# the event profiler on: `mxtpu/compile_cache.py` ticks *_trace on
+# every new shape signature, *_hit on reuse, *_aot_hit when a warmed
+# executable serves the call, *_bucket_pad when a ragged batch was
+# padded into an existing bucket.  tools/check_retrace.py gates CI on
+# them.
+
+_STATS: Dict[str, int] = {}
+
+
+def inc_stat(name: str, delta: int = 1) -> int:
+    with _lock:
+        val = _STATS.get(name, 0) + delta
+        _STATS[name] = val
+    if _RUNNING and delta:
+        record_counter("stat::" + name, float(val))
+    return val
+
+
+def get_stat(name: str) -> int:
+    return _STATS.get(name, 0)
+
+
+def stats() -> Dict[str, int]:
+    """Snapshot of the compile-lifecycle counters."""
+    with _lock:
+        return dict(_STATS)
+
+
+def reset_stats() -> None:
+    with _lock:
+        _STATS.clear()
 
 
 def record_counter(name: str, value: float, ts_us: Optional[float] = None):

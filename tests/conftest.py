@@ -8,6 +8,11 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# dogfood the persistent compile cache (mxtpu/compile_cache.py): repeat
+# suite runs — and the many tests that spawn subprocesses re-compiling
+# the same tiny programs — hit the on-disk XLA cache instead of
+# recompiling.  Inherited by child processes via the environment.
+os.environ.setdefault("MXTPU_COMPILE_CACHE", "/tmp/mxtpu_test_xla_cache")
 # CPU-only test subprocesses (kvstore launcher, example scripts) must not
 # dial the TPU tunnel at interpreter start — the pool sitecustomize keys
 # on this var, and a busy/cold tunnel turns every child's startup into
@@ -24,12 +29,33 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# jax_num_cpu_devices only exists in newer JAX releases; older ones take the
+# device count from XLA_FLAGS (set above, which only works when it landed in
+# the environment before the backend initialized).
+if hasattr(jax.config, "jax_num_cpu_devices"):
+    jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    """When the virtual 8-device mesh could not be materialized (e.g. a
+    JAX build that honors neither jax_num_cpu_devices nor the late
+    XLA_FLAGS), skip the tests that hard-require multiple devices
+    instead of failing the whole suite."""
+    if jax.device_count() > 1:
+        return
+    skip = pytest.mark.skip(
+        reason="requires >1 JAX device; this environment exposes only 1")
+    multi_device_files = {"test_parallel.py", "test_multichip_scale.py"}
+    for item in items:
+        if item.fspath.basename in multi_device_files \
+                or "multi_device" in item.name \
+                or "over_mesh" in item.name:
+            item.add_marker(skip)
 
 
 @pytest.fixture(autouse=True)

@@ -15,6 +15,7 @@ import mxtpu.parallel as par
 from mxtpu.parallel import transformer as tfm
 from mxtpu.parallel.mesh import (AXIS_DP, AXIS_PP, AXIS_TP, AXIS_SP,
                                  AXIS_EP)
+from mxtpu.parallel.mesh import get_shard_map
 
 
 def _mesh(dp=1, pp=1, tp=1, sp=1, ep=1):
@@ -140,7 +141,7 @@ class TestRingAttention:
                                       causal=causal)
 
         spec = P(None, None, AXIS_SP, None)
-        sm = jax.jit(jax.shard_map(
+        sm = jax.jit(get_shard_map()(
             f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
         out = np.asarray(jax.device_get(sm(q, k, v)))
         np.testing.assert_allclose(out, self._naive(q, k, v, causal),
@@ -164,7 +165,7 @@ class TestRingAttention:
             return (jnp.sin(o) * o).sum()  # non-uniform cotangent
 
         spec = P(None, None, AXIS_SP, None)
-        grads_ring = jax.jit(jax.shard_map(
+        grads_ring = jax.jit(get_shard_map()(
             lambda q, k, v: jax.grad(ring_loss, argnums=(0, 1, 2))(
                 q, k, v),
             mesh=mesh, in_specs=(spec, spec, spec),

@@ -46,6 +46,14 @@ def test_im2rec_list_pack_consume(tmp_path):
     assert labels == {0.0, 1.0}
 
 
+def test_check_retrace_guard():
+    """tools/check_retrace.py: the hot path must not retrace after
+    step 1 — this is the CI guard for dispatch-overhead regressions
+    (see mxtpu/compile_cache.py)."""
+    out = _run(["tools/check_retrace.py", "--steps", "3"])
+    assert out.startswith("OK")
+
+
 def test_parse_log(tmp_path):
     log = tmp_path / "train.log"
     log.write_text(

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Retrace regression guard for the dispatch hot path.
+
+Runs a tiny hybridized Gluon model for N inference steps and N training
+steps at a FIXED input shape and fails (rc=1) if the profiler's
+compile-lifecycle trace counters (`mxtpu.profiler.stats()`, keys
+`*_trace`) tick after the first step of each mode — i.e. if the hot
+path started re-tracing/recompiling per step.  Wired as a fast test in
+`tests/test_tools.py` so a retrace regression can't land silently.
+
+Usage: python tools/check_retrace.py [--steps N]
+"""
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=4)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import autograd, profiler
+    from mxtpu.gluon import nn
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"),
+                nn.BatchNorm(),
+                nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0).rand(8, 10).astype("float32"))
+
+    failures = []
+    for mode in ("infer", "train"):
+        def step():
+            if mode == "infer":
+                net(x).wait_to_read()
+            else:
+                with autograd.record():
+                    out = net(x)
+                out.backward()
+
+        step()  # first step may trace — that's the one allowed compile
+        baseline = {k: v for k, v in profiler.stats().items()
+                    if k.endswith("_trace")}
+        for i in range(args.steps - 1):
+            step()
+        after = {k: v for k, v in profiler.stats().items()
+                 if k.endswith("_trace")}
+        grew = {k: (baseline.get(k, 0), v) for k, v in after.items()
+                if v > baseline.get(k, 0)}
+        if grew:
+            failures.append((mode, grew))
+
+    if failures:
+        for mode, grew in failures:
+            print("FAIL: %s hot path retraced after step 1: %s"
+                  % (mode, grew), file=sys.stderr)
+        return 1
+    print("OK: no retrace after step 1 (stats: %s)"
+          % {k: v for k, v in profiler.stats().items() if "_trace" in k})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
