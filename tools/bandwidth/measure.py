@@ -31,10 +31,20 @@ def main():
     args = ap.parse_args()
 
     if args.cpu_mesh:
+        import os
+
+        xla_flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xla_flags:
+            # only effective if the backend is not initialized yet;
+            # jax_num_cpu_devices below (newer JAX) covers the rest
+            os.environ["XLA_FLAGS"] = (
+                xla_flags + " --xla_force_host_platform_device_count=%d"
+                % args.cpu_mesh).strip()
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_mesh)
+        if hasattr(jax.config, "jax_num_cpu_devices"):
+            jax.config.update("jax_num_cpu_devices", args.cpu_mesh)
     import jax
 
     import mxtpu as mx
