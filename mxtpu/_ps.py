@@ -48,6 +48,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .base import KVStoreTimeoutError
+
 __all__ = ["Scheduler", "Server", "Worker", "role_from_env",
            "run_scheduler", "run_server"]
 
@@ -222,28 +224,73 @@ class _Client(object):
     """Persistent request/response connection (thread-safe)."""
 
     def __init__(self, addr: Tuple[str, int], retries: int = 100):
+        self._addr = tuple(addr)
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._connect(retries)
+
+    def _connect(self, retries: int = 100):
         last = None
         for _ in range(retries):
             try:
-                self._sock = socket.create_connection(addr, timeout=None)
+                self._sock = socket.create_connection(self._addr,
+                                                      timeout=None)
                 self._sock.setsockopt(socket.IPPROTO_TCP,
                                       socket.TCP_NODELAY, 1)
-                break
+                return
             except OSError as e:
                 last = e
                 time.sleep(0.1)
-        else:
-            raise ConnectionError("cannot reach %s: %s" % (addr, last))
-        self._lock = threading.Lock()
+        self._sock = None
+        raise ConnectionError("cannot reach %s: %s" % (self._addr, last))
 
-    def request(self, obj):
+    def request(self, obj, timeout: Optional[float] = None):
+        """One request/response exchange.  ``timeout`` bounds the WHOLE
+        exchange (send + wait for the reply); on expiry the socket is
+        left with pending bytes, so the connection is closed and a
+        typed :class:`KVStoreTimeoutError` raised — the explicit
+        alternative to hanging forever on a wedged server."""
         with self._lock:
-            _send_msg(self._sock, obj)
-            return _recv_msg(self._sock)
+            if self._sock is None:  # reconnect after an earlier timeout
+                self._connect(retries=20)
+            try:
+                self._sock.settimeout(timeout)
+                _send_msg(self._sock, obj)
+                return _recv_msg(self._sock)
+            except socket.timeout as e:
+                # a late reply would desync the stream: kill the socket
+                # (the next request reconnects)
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                raise KVStoreTimeoutError(
+                    "no server response within %.1fs for op %r (set "
+                    "MXTPU_KVSTORE_TIMEOUT to adjust; <=0 disables)"
+                    % (timeout, obj.get("op") if isinstance(obj, dict)
+                       else "?")) from e
+            except OSError:
+                # connection died mid-exchange (reset/pipe): drop the
+                # socket so a retry reconnects instead of re-sending on
+                # the corpse
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                raise
+            finally:
+                if self._sock is not None:
+                    try:
+                        self._sock.settimeout(None)
+                    except OSError:
+                        pass
 
     def close(self):
         try:
-            self._sock.close()
+            if self._sock is not None:
+                self._sock.close()
         except OSError:
             pass
 
@@ -723,19 +770,21 @@ class Worker(object):
             self._servers[sidx].request({"op": "init", "key": subkey,
                                          "value": flat[lo:hi]})
 
-    def push(self, key, value: np.ndarray, sync: bool = True):
+    def push(self, key, value: np.ndarray, sync: bool = True,
+             timeout: Optional[float] = None):
         flat = np.ascontiguousarray(value).reshape(-1)
         self._meta_shape.setdefault(key, (value.shape, value.dtype))
         for sidx, subkey, lo, hi in self._chunks(key, flat.size):
             rep = self._servers[sidx].request(
                 {"op": "push", "key": subkey, "value": flat[lo:hi],
-                 "sync": sync})
+                 "sync": sync}, timeout=timeout)
             if rep.get("error"):
                 raise ConnectionError("push of %r failed: %s"
                                       % (key, rep["error"]))
             self._last_version[subkey] = rep["version"]
 
-    def pull(self, key, sync: bool = True) -> np.ndarray:
+    def pull(self, key, sync: bool = True,
+             timeout: Optional[float] = None) -> np.ndarray:
         shape, dtype = self._meta_shape[key]
         size = int(np.prod(shape, dtype=np.int64)) if shape else 1
         flat = np.empty(size, dtype=dtype)
@@ -743,7 +792,7 @@ class Worker(object):
             rep = self._servers[sidx].request(
                 {"op": "pull", "key": subkey,
                  "min_version": self._last_version.get(subkey, 0)
-                 if sync else 0})
+                 if sync else 0}, timeout=timeout)
             if rep.get("value") is None:
                 raise ConnectionError(
                     "pull of %r failed: %s" % (key, rep.get(
@@ -751,7 +800,8 @@ class Worker(object):
             flat[lo:hi] = rep["value"]
         return flat.reshape(shape)
 
-    def pull_rows(self, key, row_ids, sync: bool = True) -> np.ndarray:
+    def pull_rows(self, key, row_ids, sync: bool = True,
+                  timeout: Optional[float] = None) -> np.ndarray:
         """Pull only `row_ids` rows of `key` (reference PullRowSparse,
         `src/kvstore/kvstore_dist.h`): each server ships just the flat
         spans of its chunk that requested rows overlap — wire traffic is
@@ -780,7 +830,7 @@ class Worker(object):
                 {"op": "pull_rows", "key": subkey,
                  "spans": np.asarray(spans, np.int64),
                  "min_version": self._last_version.get(subkey, 0)
-                 if sync else 0})
+                 if sync else 0}, timeout=timeout)
             if rep.get("value") is None:
                 raise ConnectionError(
                     "pull_rows of %r failed: %s" % (key, rep.get(
@@ -794,7 +844,7 @@ class Worker(object):
             (len(rows),) + tuple(shape[1:]))
 
     def push_rows(self, key, rows: np.ndarray, data: np.ndarray,
-                  sync: bool = True):
+                  sync: bool = True, timeout: Optional[float] = None):
         """Push only `rows` of `key`: wire traffic O(rows * width)."""
         shape, dtype = self._meta_shape[key]
         width = int(np.prod(shape[1:], dtype=np.int64)) if len(shape) > 1 \
@@ -817,7 +867,7 @@ class Worker(object):
             rep = self._servers[sidx].request(
                 {"op": "push_rows", "key": subkey,
                  "spans": np.asarray(spans, np.int64).reshape(-1, 2),
-                 "value": value, "sync": sync})
+                 "value": value, "sync": sync}, timeout=timeout)
             if rep.get("error"):
                 raise ConnectionError("push_rows of %r failed: %s"
                                       % (key, rep["error"]))

@@ -17,6 +17,7 @@ import numpy as np
 __all__ = [
     "MXNetError",
     "MXTPUError",
+    "KVStoreTimeoutError",
     "string_types",
     "numeric_types",
     "integer_types",
@@ -39,6 +40,12 @@ class MXNetError(RuntimeError):
 
 # Alias under the new name; both are exported.
 MXTPUError = MXNetError
+
+
+class KVStoreTimeoutError(MXNetError, TimeoutError):
+    """A kvstore push/pull got no server response within
+    MXTPU_KVSTORE_TIMEOUT.  Subclasses TimeoutError so the resilience
+    retry layer treats it as transient."""
 
 string_types = (str,)
 numeric_types = (float, int, np.generic)

@@ -190,6 +190,9 @@ class CachedOp(object):
         if keyed in self._seen_sigs:
             _prof.inc_stat("cachedop_%s_hit" % kind)
         else:
+            from . import resilience as _res
+
+            _res.fault_barrier("compile", "cachedop:%s" % kind)
             self._seen_sigs.add(keyed)
             _prof.inc_stat("cachedop_%s_trace" % kind)
 

@@ -269,8 +269,17 @@ def aot_compile(jitfn, example_args):
     """``jit(...).lower(*args).compile()``: build the executable without
     running it.  ``example_args`` may be arrays or ShapeDtypeStructs;
     the returned Compiled object is called with matching concrete
-    arrays and NEVER touches the jit's trace/compile cache."""
-    return jitfn.lower(*example_args).compile()
+    arrays and NEVER touches the jit's trace/compile cache.
+
+    Runs under the ``compile`` fault-injection site + retry policy
+    (mxtpu/resilience.py): a transient XLA/compile-cache failure is
+    retried with backoff instead of killing the run."""
+    from . import resilience as _res
+
+    def body():
+        _res.maybe_fault("compile", "aot_compile")
+        return jitfn.lower(*example_args).compile()
+    return _res.run_with_retry("compile", body)
 
 
 def shape_struct(shape, dtype):

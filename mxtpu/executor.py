@@ -539,6 +539,12 @@ class Executor(object):
         if sig in self._seen_sigs:
             _prof.inc_stat("executor_%s_hit" % kind)
         else:
+            # a NEW signature is about to trigger an XLA build: this is
+            # the `compile` fault-injection chokepoint (flaky-compile
+            # recovery rides the retry policy)
+            from . import resilience as _res
+
+            _res.fault_barrier("compile", "executor:%s" % kind)
             self._seen_sigs.add(sig)
             _prof.inc_stat("executor_%s_trace" % kind)
 

@@ -40,6 +40,7 @@ import numpy as np
 from .base import MXNetError
 from .executor import _build_graph_fn
 from .ndarray.ndarray import NDArray
+from . import resilience as _res
 
 __all__ = ["FusedTrainLoop"]
 
@@ -140,6 +141,16 @@ class FusedTrainLoop(object):
                 unroll = self._K if jax.default_backend() == "cpu" else 1
         self._unroll = min(self._K, max(1, int(unroll)))
 
+        # graceful degradation (MXTPU_MAX_BAD_STEPS > 0): each scanned
+        # step checks its gradients for NaN/Inf INSIDE the program and
+        # keeps the previous params/opt-state/aux when they are not
+        # finite; the per-step bad flags come back to the host, which
+        # aborts after that many CONSECUTIVE skips.  Note the
+        # optimizer's num_update still advances for skipped steps (the
+        # lr schedule stays aligned with wall steps).
+        self._guard = _res.BadStepGuard(site="fused_train") \
+            if _res.max_bad_steps() > 0 else None
+
         self._jit_program = jax.jit(self._make_program(),
                                     donate_argnums=(0, 1, 2))
 
@@ -159,6 +170,7 @@ class FusedTrainLoop(object):
                                        ex._aux_names, is_train=True)
         step = self._scan_step.step
         collect = self._collect
+        guard_on = self._guard is not None
 
         def program(p_vals, s_tree, aux_vals, fixed_vals, base_key, t0,
                     data_stack, lr_rows):
@@ -182,7 +194,22 @@ class FusedTrainLoop(object):
                 zaux = [jnp.zeros_like(a) for a in aux_new]
                 (grads,) = vjp((ones, zaux))
                 new_p, new_s = step(p, s, grads, lr_row)
-                ys = tuple(outs) if collect else ()
+                if guard_on:
+                    ok = jnp.bool_(True)
+                    for g in grads:
+                        ok = ok & jnp.isfinite(g).all()
+                    # non-finite step: keep params, opt state AND aux
+                    # (a blown-up forward poisons BN stats too)
+                    new_p = [jnp.where(ok, a, b)
+                             for a, b in zip(new_p, p)]
+                    new_s = jax.tree_util.tree_map(
+                        lambda a, b: jnp.where(ok, a, b), new_s, s)
+                    aux_new = [jnp.where(ok, a, b)
+                               for a, b in zip(aux_new, aux)]
+                    ys = {"outs": tuple(outs) if collect else (),
+                          "bad": ~ok}
+                else:
+                    ys = tuple(outs) if collect else ()
                 return (new_p, new_s, aux_new, t + 1), ys
 
             (p, s, aux, _), outs = lax.scan(
@@ -256,10 +283,20 @@ class FusedTrainLoop(object):
             else jax.random.PRNGKey(0)
         p, s, aux, outs = self._jit_program(
             *self._program_args(data_stack, base_key))
+        bad_flags = None
+        if self._guard is not None:
+            bad_flags = np.asarray(outs["bad"])
+            outs = outs["outs"]
         self._p_vals, self._s_tree, self._aux_vals = p, s, aux
         self._t += K
         self._optimizer.commit_scan_steps(self._opt_indices, K)
         self._publish()
+        if bad_flags is not None:
+            # state is already published (skipped steps kept the old
+            # buffers in-program); now account per-step health and
+            # abort on too many CONSECUTIVE skips
+            for bad in bad_flags:
+                self._guard.record(not bool(bad))
         if self._collect:
             ctx = self._exec._ctx
             return [NDArray(o, ctx=ctx, _committed=True) for o in outs]

@@ -22,11 +22,14 @@ from __future__ import annotations
 import multiprocessing
 import queue
 import threading
+import time
+import traceback
 from typing import Any, Callable, List, Optional
 
 import numpy as np
 
 from ...base import MXNetError
+from ... import resilience as _res
 from ...ndarray.ndarray import NDArray, array as nd_array
 from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
@@ -75,10 +78,60 @@ def _worker_init(dataset):
     _WORKER_DATASET = dataset
 
 
+#: Sentinel tag a forked worker returns instead of raising: exceptions
+#: must cross the pickle boundary with their ORIGINAL traceback intact
+#: (pickling arbitrary exception objects can itself fail, which the
+#: reference dataloader turns into a deadlocked iterator).
+_ERR_TAG = "__mxtpu_worker_error__"
+
+
+class _WorkerLost(Exception):
+    """A pool worker died (SIGKILL/segfault) while holding a batch —
+    its result will never arrive."""
+
+
 def _worker_fn(args):
     idx_batch, batchify = args
-    samples = [_WORKER_DATASET[i] for i in idx_batch]
-    return batchify(samples)
+    try:
+        _res.maybe_fault("dataloader")
+        samples = [_WORKER_DATASET[i] for i in idx_batch]
+        return batchify(samples)
+    except Exception as e:
+        return (_ERR_TAG, type(e).__name__, str(e),
+                traceback.format_exc())
+
+
+def _pool_pids(pool):
+    return {p.pid for p in getattr(pool, "_pool", [])}
+
+
+def _await_async(pool, res, submit_pids, poll: float = 0.2,
+                 grace: float = 2.0):
+    """``res.get()`` that cannot hang forever: a worker that dies
+    (SIGKILL/segfault) is silently replaced by the pool's maintenance
+    thread and the task it held is dropped — the naive ``.get()`` then
+    blocks for good.  A death is detected by comparing the pool's pid
+    SET against ``submit_pids``, the set captured when this batch was
+    SUBMITTED (replacement swaps a pid, observable even if the death
+    happened while the parent was off yielding earlier batches); if
+    the result is still pending ``grace`` seconds after a death is
+    seen, it is declared lost (:class:`_WorkerLost`) so the caller
+    resubmits."""
+    death_seen = None
+    while True:
+        try:
+            return res.get(poll)
+        except multiprocessing.TimeoutError:
+            procs = list(getattr(pool, "_pool", []))
+            cur = {p.pid for p in procs}
+            if cur != submit_pids or any(not p.is_alive() for p in procs):
+                if death_seen is None:
+                    death_seen = time.monotonic()
+            if death_seen is not None and \
+                    time.monotonic() - death_seen >= grace:
+                if res.ready():  # arrived at the last moment
+                    return res.get(0)
+                raise _WorkerLost()
 
 
 def _to_nd(batch):
@@ -118,12 +171,15 @@ class DataLoader(object):
                              else 2 * self._num_workers)
 
     def _make_batch(self, indices):
+        _res.maybe_fault("dataloader")
         return self._batchify_fn([self._dataset[i] for i in indices])
 
     def __iter__(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
-                yield self._make_batch(indices)
+                # inline path: full retry policy on transient faults
+                yield _res.run_with_retry(
+                    "dataloader", lambda idx=indices: self._make_batch(idx))
             return
         if self._thread_pool:
             yield from self._threaded_iter()
@@ -136,7 +192,16 @@ class DataLoader(object):
         batches back (pickle), the parent converts once per batch.
         Custom `batchify_fn` runs IN the worker and must be picklable
         and numpy-only; the default numpy batchify is swapped in for
-        the NDArray one automatically."""
+        the NDArray one automatically.
+
+        Resilience: a worker EXCEPTION comes back as a tagged tuple
+        carrying the original traceback (never a deadlock), the batch
+        is retried once in a fresh worker, and a second failure raises
+        with that traceback attached.  A worker DEATH (SIGKILL /
+        segfault — the pool silently loses the batch and the naive
+        ``.get()`` hangs forever) is detected by polling worker
+        liveness; the lost batch is resubmitted once to the
+        auto-replenished pool."""
         batchify = self._batchify_fn
         if batchify is default_batchify_fn:
             batchify = _np_batchify
@@ -149,23 +214,68 @@ class DataLoader(object):
         # threaded path — at most max(prefetch, num_workers) batches
         # decoded ahead of the consumer
         window = max(self._prefetch, self._num_workers)
+
+        def _submit(indices):
+            # the pid set at submit time anchors death detection for
+            # this batch (a worker may die while the parent is off
+            # yielding earlier batches)
+            return (indices,
+                    pool.apply_async(_worker_fn, ((indices, batchify),)),
+                    _pool_pids(pool))
+
         try:
-            pending = []
+            pending = []  # (indices, AsyncResult, submit-time pids)
             submit = 0
             while submit < len(batches) and len(pending) < window:
-                pending.append(pool.apply_async(
-                    _worker_fn, ((batches[submit], batchify),)))
+                pending.append(_submit(batches[submit]))
                 submit += 1
             while pending:
-                out = pending.pop(0).get()
+                indices, res, pids = pending.pop(0)
+                out = self._resolve_pooled(pool, batchify, indices, res,
+                                           pids)
                 if submit < len(batches):
-                    pending.append(pool.apply_async(
-                        _worker_fn, ((batches[submit], batchify),)))
+                    pending.append(_submit(batches[submit]))
                     submit += 1
                 yield _to_nd(out)
         finally:
             pool.terminate()
             pool.join()
+
+    def _resolve_pooled(self, pool, batchify, indices, res, pids,
+                        attempt=0):
+        from ... import profiler as _prof
+
+        try:
+            out = _await_async(pool, res, pids)
+        except _WorkerLost:
+            if attempt >= 1:
+                raise MXNetError(
+                    "DataLoader worker process died twice while decoding "
+                    "the same batch (indices %r) — giving up" % (indices,))
+            _prof.inc_stat("dataloader_worker_respawn")
+            retry = pool.apply_async(_worker_fn, ((indices, batchify),))
+            return self._resolve_pooled(pool, batchify, indices, retry,
+                                        _pool_pids(pool), attempt + 1)
+        if isinstance(out, tuple) and len(out) == 4 and out[0] == _ERR_TAG:
+            _, etype, emsg, tb = out
+            if attempt >= 1:
+                # fresh worker failed too: last resort is the parent
+                # computing the batch itself under the full retry
+                # policy; only then surface the ORIGINAL traceback
+                try:
+                    return _res.run_with_retry(
+                        "dataloader", lambda: self._make_batch(indices))
+                except Exception:
+                    raise MXNetError(
+                        "DataLoader worker raised %s: %s (retried in a "
+                        "fresh worker and in the parent)\n"
+                        "--- original worker traceback ---\n%s"
+                        % (etype, emsg, tb))
+            _prof.inc_stat("dataloader_worker_retry")
+            retry = pool.apply_async(_worker_fn, ((indices, batchify),))
+            return self._resolve_pooled(pool, batchify, indices, retry,
+                                        _pool_pids(pool), attempt + 1)
+        return out
 
     def _threaded_iter(self):
         """Thread-pool pipeline with bounded in-order prefetch."""
@@ -209,7 +319,21 @@ class DataLoader(object):
                     stash[i] = (out, err)
                 out, err = stash.pop(want)
                 if err is not None:
-                    raise err
+                    # retry the failed batch inline under the FULL
+                    # retry policy (a single bare retry would lose to a
+                    # second transient fault); persistent failure
+                    # surfaces with the original worker error chained
+                    from ... import profiler as _prof
+
+                    _prof.inc_stat("dataloader_worker_retry")
+                    try:
+                        out = _res.run_with_retry(
+                            "dataloader",
+                            lambda w=want: self._make_batch(batches[w]))
+                    except Exception:
+                        raise MXNetError(
+                            "DataLoader batch %d failed twice; original "
+                            "worker error: %r" % (want, err)) from err
                 yield out
                 budget.release()  # consumer consumed: allow another ahead
                 want += 1

@@ -12,6 +12,7 @@ from typing import Any, Dict, List, Optional
 
 from ..base import MXNetError
 from .. import optimizer as opt_mod
+from .. import resilience as _res
 from ..ndarray.ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
@@ -43,6 +44,7 @@ class Trainer(object):
         self._update_on_kvstore = update_on_kvstore
         self._params_to_init = []
         self._contexts = None
+        self._bad_step_guard = None  # built lazily from MXTPU_MAX_BAD_STEPS
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -108,12 +110,35 @@ class Trainer(object):
 
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce gradients then apply optimizer (reference
-        `trainer.py:258`)."""
+        `trainer.py:258`).
+
+        Graceful degradation: with ``MXTPU_MAX_BAD_STEPS`` > 0 a step
+        whose gradients contain NaN/Inf is SKIPPED (params and
+        optimizer state untouched, `bad_steps_skipped` ticks in
+        `profiler.stats()`), and only that many CONSECUTIVE bad steps
+        abort the run (mxtpu/resilience.py BadStepGuard).  Default 0:
+        no guard, no per-step device sync."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        if _res.max_bad_steps() > 0:
+            # check BEFORE the allreduce: with update_on_kvstore the
+            # push itself applies the update, so a post-allreduce check
+            # would come too late to skip anything (and a non-finite
+            # local grad makes the merged grad non-finite anyway)
+            if self._bad_step_guard is None:
+                self._bad_step_guard = _res.BadStepGuard(site="trainer")
+            if self._bad_step_guard.record(self._grads_finite()):
+                return  # skip allreduce + update entirely
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+
+    def _grads_finite(self):
+        grads = []
+        for param in self._params:
+            if param.grad_req != "null" and param._data is not None:
+                grads.extend(g._data for g in param.list_grad())
+        return _res.all_finite(grads)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -171,7 +196,7 @@ class Trainer(object):
     def save_states(self, fname):
         if not self._kv_initialized:
             self._init_kvstore()
-        with open(fname, "wb") as f:
+        with _res.atomic_write(fname) as f:
             f.write(self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):

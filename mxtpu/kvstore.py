@@ -36,10 +36,29 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from .base import MXNetError
+from .base import KVStoreTimeoutError, MXNetError, getenv
 from .ndarray.ndarray import NDArray, zeros
+from . import resilience as _res
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "KVStoreTimeoutError", "create"]
+
+
+def _kvstore_timeout() -> Optional[float]:
+    """MXTPU_KVSTORE_TIMEOUT: seconds a dist push/pull waits for the
+    server before raising KVStoreTimeoutError (default 600; <= 0 waits
+    forever — the pre-resilience behavior)."""
+    val = getenv("MXTPU_KVSTORE_TIMEOUT")
+    t = 600.0 if val in (None, "") else float(val)
+    return t if t > 0 else None
+
+
+def _wire_deadline() -> float:
+    """Retry budget for dist wire ops: a SINGLE attempt may legitimately
+    take MXTPU_KVSTORE_TIMEOUT, so the default MXTPU_RETRY_TIMEOUT (60 s)
+    would expire before the first retry ever ran — give the guarded call
+    room for at least two full waits plus backoff."""
+    t = _kvstore_timeout()
+    return 0.0 if t is None else max(2.5 * t, 60.0)
 
 
 def _key_list(key):
@@ -202,7 +221,9 @@ class KVStore(object):
                 else:
                     stored._set_jax(merged.todense()._data)
                 continue
-            merged = self._reduce(k, vals)
+            # resilience chokepoint sits BEFORE the updater mutates the
+            # stored weight, so a retried push never double-applies
+            merged = _res.guarded("kvstore_push", self._reduce, k, vals)
             if self._updater is not None:
                 self._updater(k, merged, stored)
             else:
@@ -221,7 +242,8 @@ class KVStore(object):
                     raise MXNetError(
                         "pull into %s output: use row_sparse_pull"
                         % d.stype)
-                src.copyto(d)
+                # pull is idempotent: the whole copy is retry-safe
+                _res.guarded("kvstore_pull", src.copyto, d)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority=priority)
@@ -286,7 +308,7 @@ class KVStore(object):
             raise MXNetError(
                 "load/save optimizer states is only supported when an "
                 "updater is set (update_on_kvstore)")
-        with open(fname, "wb") as f:
+        with _res.atomic_write(fname) as f:
             f.write(self._updater.get_states(dump_optimizer=dump_optimizer))
 
     def load_optimizer_states(self, fname):
@@ -453,11 +475,21 @@ class KVStoreDist(KVStoreDevice):
                 rows = np.asarray(merged.indices.asnumpy(), np.int64)
                 data = np.asarray(merged.data.asnumpy())
                 valid = rows < merged.shape[0]  # drop OOB grad padding
-                self._worker.push_rows(k, rows[valid], data[valid],
-                                       sync=sync)
+                _res.guarded("kvstore_push", self._worker.push_rows, k,
+                             rows[valid], data[valid], sync=sync,
+                             timeout=_kvstore_timeout(),
+                             _retry_deadline=_wire_deadline())
                 continue
             merged = self._reduce(k, vals)
-            self._worker.push(k, merged.asnumpy(), sync=sync)
+            # AT-LEAST-ONCE on retry: a reply lost after the server
+            # applied the push means the resend double-applies (the
+            # server dedups nothing yet — multi-host idempotency is
+            # future work).  Injected faults fire before the send, so
+            # injection replay stays exact.
+            _res.guarded("kvstore_push", self._worker.push, k,
+                         merged.asnumpy(), sync=sync,
+                         timeout=_kvstore_timeout(),
+                         _retry_deadline=_wire_deadline())
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if out is None:
@@ -466,7 +498,10 @@ class KVStoreDist(KVStoreDevice):
         for k, dsts in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % (k,))
-            arr = self._worker.pull(k, sync=self._type != "dist_async")
+            arr = _res.guarded("kvstore_pull", self._worker.pull, k,
+                               sync=self._type != "dist_async",
+                               timeout=_kvstore_timeout(),
+                               _retry_deadline=_wire_deadline())
             src = NDArray(np.asarray(arr), ctx=dsts[0].ctx)
             for d in dsts:
                 if d.stype != "default":
@@ -494,7 +529,10 @@ class KVStoreDist(KVStoreDevice):
                 rid_np = np.asarray(
                     rid.asnumpy() if isinstance(rid, NDArray) else rid
                 ).reshape(-1)
-                rows, data = self._worker.pull_rows(k, rid_np, sync=sync)
+                rows, data = _res.guarded(
+                    "kvstore_pull", self._worker.pull_rows, k, rid_np,
+                    sync=sync, timeout=_kvstore_timeout(),
+                    _retry_deadline=_wire_deadline())
                 _sp.set_rows_into(rows, data, d)
 
     def set_optimizer(self, optimizer):

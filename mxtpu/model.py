@@ -16,10 +16,11 @@ from .context import Context, cpu, current_context
 from .ndarray import ndarray as nd_mod
 from .ndarray.ndarray import NDArray
 from . import kvstore as kvs
+from . import resilience as _res
 from . import symbol as sym_mod
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
-           "FeedForward"]
+           "load_latest", "FeedForward"]
 
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
@@ -99,21 +100,46 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 
 def save_checkpoint(prefix: str, epoch: int, symbol, arg_params,
-                    aux_params, remove_amp_cast=True):
+                    aux_params, remove_amp_cast=True, states=None):
     """Write `prefix-symbol.json` + `prefix-%04d.params` (reference
-    `model.py:383`)."""
+    `model.py:383`) — ATOMICALLY: every member lands via
+    temp+fsync+rename and a CRC32 manifest
+    (`prefix-%04d.manifest.json`) is committed LAST, so a crash (even
+    SIGKILL) mid-save can never truncate the previous checkpoint and a
+    checkpoint without a valid manifest is recognizably partial
+    (`load_latest` skips it).  ``states`` optionally embeds serialized
+    optimizer state as `prefix-%04d.states`.  All IO runs under the
+    ``checkpoint`` fault-injection site + retry policy
+    (mxtpu/resilience.py)."""
+    writer = _res.CheckpointWriter(prefix, epoch)
+
+    def _member(path, write_fn):
+        def body():
+            with writer.file(path) as f:
+                write_fn(f)
+        _res.run_with_retry("checkpoint", body)
+
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        _member("%s-symbol.json" % prefix,
+                lambda f: f.write(symbol.tojson().encode()))
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-    param_name = "%s-%04d.params" % (prefix, epoch)
-    nd_mod.save(param_name, save_dict)
+    _member("%s-%04d.params" % (prefix, epoch),
+            lambda f: nd_mod.save(f, save_dict))
+    if states is not None:
+        _member("%s-%04d.states" % (prefix, epoch),
+                lambda f: f.write(states))
+    writer.commit()
 
 
 def load_checkpoint(prefix: str, epoch: int):
     """Load (symbol, arg_params, aux_params) (reference `model.py:413`)."""
-    symbol = sym_mod.load("%s-symbol.json" % prefix)
-    save_dict = nd_mod.load("%s-%04d.params" % (prefix, epoch))
+    def body():
+        _res.maybe_fault("checkpoint", prefix)
+        symbol = sym_mod.load("%s-symbol.json" % prefix)
+        save_dict = nd_mod.load("%s-%04d.params" % (prefix, epoch))
+        return symbol, save_dict
+    symbol, save_dict = _res.run_with_retry("checkpoint", body)
     arg_params, aux_params = {}, {}
     for k, v in save_dict.items():
         tp, _, name = k.partition(":")
@@ -122,6 +148,36 @@ def load_checkpoint(prefix: str, epoch: int):
         elif tp == "aux":
             aux_params[name] = v
     return symbol, arg_params, aux_params
+
+
+def load_latest(prefix: str):
+    """Auto-resume: load the NEWEST complete checkpoint for ``prefix``,
+    skipping corrupt/partial ones (CRC-validated manifests, newest
+    first).  Falls back to probing bare ``prefix-NNNN.params`` files for
+    pre-manifest checkpoints.  Returns ``(symbol, arg_params,
+    aux_params, epoch)`` or None when nothing restorable exists."""
+    epoch = _res.latest_valid_epoch(prefix)
+    if epoch is not None:
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        return sym, args, auxs, epoch
+    # legacy checkpoints (saved before the manifest format existed)
+    import glob
+
+    from . import profiler as _prof
+
+    covered = set(_res.list_manifest_epochs(prefix))
+    for path in sorted(
+            glob.glob("%s-[0-9][0-9][0-9][0-9].params" % prefix),
+            reverse=True):
+        ep = int(path[-len("0000.params"):-len(".params")])
+        if ep in covered:  # manifest said corrupt; don't resurrect it
+            continue
+        try:
+            sym, args, auxs = load_checkpoint(prefix, ep)
+            return sym, args, auxs, ep
+        except Exception:
+            _prof.inc_stat("checkpoint_skipped_corrupt")
+    return None
 
 
 class FeedForward(object):

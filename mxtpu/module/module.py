@@ -8,6 +8,7 @@ kvstore/update_on_kvstore via `model._create_kvstore`, `update`
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Dict, List, Optional
 
 from ..base import MXNetError
@@ -16,7 +17,9 @@ from ..initializer import InitDesc, Uniform
 from ..io.io import DataDesc
 from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
                      _update_params_on_kvstore, load_checkpoint,
+                     load_latest as _load_latest_checkpoint,
                      save_checkpoint)
+from .. import resilience as _res
 from ..ndarray.ndarray import NDArray, zeros
 from .. import optimizer as opt_mod
 from .base_module import BaseModule
@@ -73,12 +76,45 @@ class Module(BaseModule):
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
         return mod
 
+    @staticmethod
+    def load_latest(prefix, load_optimizer_states=False, **kwargs):
+        """Auto-resume: build a Module from the newest COMPLETE
+        checkpoint under ``prefix`` (corrupt/partial ones are skipped
+        via the CRC manifest — see `model.load_latest`).  Returns
+        ``(module, epoch)``, or None when no restorable checkpoint
+        exists (caller starts fresh)."""
+        found = _load_latest_checkpoint(prefix)
+        if found is None:
+            return None
+        sym, args, auxs, epoch = found
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        states = "%s-%04d.states" % (prefix, epoch)
+        if load_optimizer_states and os.path.exists(states):
+            mod._preload_opt_states = states
+        return mod, epoch
+
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Atomic checkpoint (see `model.save_checkpoint`): params,
+        symbol AND optimizer state land under one CRC manifest, so a
+        crash mid-save never leaves a half-checkpoint that
+        `load_latest` would trust."""
         self._sync_params_from_devices()
+        states = self._optimizer_state_bytes() if save_optimizer_states \
+            else None
         save_checkpoint(prefix, epoch, self.symbol, self._arg_params,
-                        self._aux_params)
-        if save_optimizer_states:
-            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+                        self._aux_params, states=states)
+
+    def _optimizer_state_bytes(self):
+        if not self.optimizer_initialized:
+            raise MXNetError("init_optimizer() first")
+        if self._update_on_kvstore:
+            if self._kvstore._updater is None:
+                raise MXNetError("kvstore has no updater to serialize")
+            return self._kvstore._updater.get_states(dump_optimizer=False)
+        return self._updater.get_states()
 
     # -- properties ---------------------------------------------------------
     @property
@@ -406,7 +442,7 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as f:
+            with _res.atomic_write(fname) as f:
                 f.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):

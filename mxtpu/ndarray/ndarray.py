@@ -817,7 +817,11 @@ def save(fname, data):
     if hasattr(fname, "write"):
         np.savez(fname, **kw)
     else:
-        with open(fname, "wb") as f:
+        # temp+fsync+rename: a crash mid-save never truncates an
+        # existing params file (mxtpu/resilience.py)
+        from ..resilience import atomic_write
+
+        with atomic_write(fname) as f:
             np.savez(f, **kw)
 
 

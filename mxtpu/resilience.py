@@ -1,0 +1,706 @@
+"""Resilience subsystem: fault injection, retries, atomic checkpoints.
+
+A production run on preemptible TPU pods dies in exactly four ways the
+framework can absorb instead of crashing: a transient failure at a
+known chokepoint (flaky XLA compile, kvstore push/pull, dataloader
+fetch, checkpoint IO), a hang (server never answers a pull), a numeric
+blow-up (non-finite grads), and a preemption (SIGTERM / SIGKILL).
+This module owns the shared machinery; the call sites live in
+``kvstore.py``, ``_ps.py``, ``gluon/data/dataloader.py``, ``model.py``,
+``module/module.py``, ``gluon/trainer.py``, ``fused_train.py``,
+``executor.py``/``cached_op.py`` and ``compile_cache.py``.
+
+Four layers:
+
+  * **Deterministic fault injection** — ``MXTPU_FAULT_INJECT=
+    site:prob:seed[,site:prob:seed...]`` or :func:`inject` arms a named
+    chokepoint (:data:`FAULT_SITES`) to raise :class:`InjectedFault`
+    with probability ``prob`` from a per-site seeded RNG, so a failure
+    schedule replays exactly.  Every fire ticks
+    ``fault_injected::<site>`` in :func:`mxtpu.profiler.stats`.
+
+  * **Retry** — :func:`run_with_retry` / :func:`guarded` wrap a
+    chokepoint in exponential backoff + full jitter + a wall-clock
+    deadline.  Knobs: ``MXTPU_RETRY_MAX`` (retries after the first
+    attempt, default 5), ``MXTPU_RETRY_TIMEOUT`` (deadline seconds,
+    default 60), ``MXTPU_RETRY_BASE`` (first backoff, default 0.05 s).
+    Per-site counters: ``retry_attempts::<site>``,
+    ``retry_recovered::<site>``, ``retry_failures::<site>``.
+
+  * **Atomic checkpoint IO** — :func:`atomic_write` (temp + fsync +
+    rename, so a crash mid-save never truncates the previous file) and
+    :class:`CheckpointWriter`, which records a CRC32 per written file
+    and commits a ``<prefix>-<epoch>.manifest.json`` LAST — a
+    checkpoint without a valid manifest is by definition partial and
+    :func:`latest_valid_epoch` skips it.  :func:`install_preemption_hook`
+    chains a SIGTERM handler that flushes an emergency checkpoint
+    before the process dies.
+
+  * **Graceful degradation** — :class:`BadStepGuard` counts non-finite
+    update steps (skipped by the trainer / fused loop when
+    ``MXTPU_MAX_BAD_STEPS`` > 0) and aborts only after that many
+    CONSECUTIVE bad steps; skips tick ``bad_steps_skipped``.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import random as _random
+import signal
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .base import MXNetError, getenv, getenv_int
+
+__all__ = [
+    "FAULT_SITES",
+    "InjectedFault",
+    "RetryExhausted",
+    "inject",
+    "clear_faults",
+    "arm_from_env",
+    "maybe_fault",
+    "site_armed",
+    "any_armed",
+    "run_with_retry",
+    "guarded",
+    "fault_barrier",
+    "retryable",
+    "atomic_write",
+    "crc32_file",
+    "CheckpointWriter",
+    "manifest_path",
+    "read_manifest",
+    "validate_manifest",
+    "list_manifest_epochs",
+    "latest_valid_epoch",
+    "install_preemption_hook",
+    "remove_preemption_hook",
+    "preempted",
+    "max_bad_steps",
+    "BadStepGuard",
+    "all_finite",
+]
+
+# ---------------------------------------------------------------------------
+# Fault injection
+# ---------------------------------------------------------------------------
+
+#: The named chokepoints.  ``compile`` fires where a new XLA program is
+#: about to be built (Executor/CachedOp new-signature dispatch,
+#: ``compile_cache.aot_compile``); ``kvstore_push``/``kvstore_pull``
+#: fire inside every KVStore backend's per-key push/pull;
+#: ``dataloader`` fires in the batch fetch (parent, thread and forked
+#: worker paths); ``checkpoint`` fires in checkpoint/optimizer-state IO.
+FAULT_SITES = ("compile", "kvstore_push", "kvstore_pull", "dataloader",
+               "checkpoint")
+
+_ALIASES = {
+    "compile_cache": "compile",
+    "xla_compile": "compile",
+    "kvstore-push": "kvstore_push",
+    "push": "kvstore_push",
+    "kvstore-pull": "kvstore_pull",
+    "pull": "kvstore_pull",
+    "dataloader_fetch": "dataloader",
+    "io": "dataloader",
+    "checkpoint_io": "checkpoint",
+    "checkpoint-io": "checkpoint",
+}
+
+
+class InjectedFault(MXNetError):
+    """A deterministic fault fired at a :data:`FAULT_SITES` chokepoint."""
+
+
+class RetryExhausted(MXNetError):
+    """A guarded chokepoint kept failing past MXTPU_RETRY_MAX /
+    MXTPU_RETRY_TIMEOUT; ``__cause__`` is the last underlying error."""
+
+
+class _Fault(object):
+    __slots__ = ("prob", "rng", "seed")
+
+    def __init__(self, prob: float, seed: int):
+        self.prob = float(prob)
+        self.seed = int(seed)
+        self.rng = _random.Random(seed)
+
+
+_fault_lock = threading.Lock()
+_FAULTS: Dict[str, _Fault] = {}
+_ANY_ARMED = False  # fast-path flag: chokepoints are on hot paths
+
+
+def _canon_site(site: str) -> str:
+    s = site.strip().lower().replace("-", "_")
+    s = _ALIASES.get(s, s)
+    if s not in FAULT_SITES:
+        raise MXNetError("unknown fault site %r (known: %s)"
+                         % (site, ", ".join(FAULT_SITES)))
+    return s
+
+
+def inject(site: str, prob: float, seed: int = 0) -> None:
+    """Arm ``site`` to raise :class:`InjectedFault` with probability
+    ``prob`` per :func:`maybe_fault` crossing, deterministically from
+    ``seed``.  ``prob <= 0`` disarms the site."""
+    global _ANY_ARMED
+    s = _canon_site(site)
+    with _fault_lock:
+        if prob <= 0:
+            _FAULTS.pop(s, None)
+        else:
+            _FAULTS[s] = _Fault(prob, seed)
+        _ANY_ARMED = bool(_FAULTS)
+
+
+def clear_faults(site: Optional[str] = None) -> None:
+    """Disarm one site, or every site when ``site`` is None."""
+    global _ANY_ARMED
+    with _fault_lock:
+        if site is None:
+            _FAULTS.clear()
+        else:
+            _FAULTS.pop(_canon_site(site), None)
+        _ANY_ARMED = bool(_FAULTS)
+
+
+def arm_from_env(spec: Optional[str] = None) -> List[str]:
+    """Parse ``MXTPU_FAULT_INJECT`` (or an explicit spec) —
+    ``site:prob[:seed]`` comma-separated — and arm those sites.
+    Returns the canonical site names armed."""
+    if spec is None:
+        spec = getenv("MXTPU_FAULT_INJECT")
+    armed = []
+    if not spec:
+        return armed
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) not in (2, 3):
+            raise MXNetError(
+                "MXTPU_FAULT_INJECT entries must be site:prob[:seed], "
+                "got %r" % part)
+        site = _canon_site(bits[0])
+        prob = float(bits[1])
+        seed = int(bits[2]) if len(bits) == 3 else 0
+        inject(site, prob, seed)
+        armed.append(site)
+    return armed
+
+
+def site_armed(site: str) -> bool:
+    return _ANY_ARMED and _canon_site(site) in _FAULTS
+
+
+def any_armed() -> bool:
+    return _ANY_ARMED
+
+
+def maybe_fault(site: str, detail: str = "") -> None:
+    """The chokepoint: raise :class:`InjectedFault` when ``site`` is
+    armed and the per-site RNG fires.  A no-op (one flag read) when
+    nothing is armed — safe on hot paths."""
+    if not _ANY_ARMED:
+        return
+    s = _canon_site(site)
+    with _fault_lock:
+        f = _FAULTS.get(s)
+        if f is None:
+            return
+        fire = f.rng.random() < f.prob
+    if fire:
+        from . import profiler as _prof
+
+        _prof.inc_stat("fault_injected::" + s)
+        raise InjectedFault("injected fault at %r%s"
+                            % (s, " (%s)" % detail if detail else ""))
+
+
+# ---------------------------------------------------------------------------
+# Retry with exponential backoff + jitter + deadline
+# ---------------------------------------------------------------------------
+
+#: Exceptions a retry treats as transient.  ``OSError`` covers
+#: ``ConnectionError``/``TimeoutError``/socket errors (and the typed
+#: ``KVStoreTimeoutError``, a ``TimeoutError`` subclass).
+TRANSIENT_ERRORS: Tuple[type, ...] = (InjectedFault, OSError)
+
+#: OSError subclasses no amount of retrying fixes — these propagate
+#: immediately and UNWRAPPED, preserving callers' exception contracts
+#: (e.g. probing a missing checkpoint must still see FileNotFoundError).
+PERMANENT_ERRORS: Tuple[type, ...] = (FileNotFoundError, IsADirectoryError,
+                                      NotADirectoryError, PermissionError)
+
+_BACKOFF_CAP = 2.0
+_retry_rng = _random.Random(0x5EED)
+
+
+def _retry_max() -> int:
+    return max(0, getenv_int("MXTPU_RETRY_MAX", 5))
+
+
+def _retry_timeout() -> float:
+    val = getenv("MXTPU_RETRY_TIMEOUT")
+    return 60.0 if val in (None, "") else float(val)
+
+
+def _retry_base() -> float:
+    val = getenv("MXTPU_RETRY_BASE")
+    return 0.05 if val in (None, "") else float(val)
+
+
+def run_with_retry(site: str, fn: Callable[[], Any],
+                   retry_on: Tuple[type, ...] = TRANSIENT_ERRORS,
+                   max_retries: Optional[int] = None,
+                   deadline: Optional[float] = None) -> Any:
+    """Run ``fn()`` retrying transient failures with exponential
+    backoff + full jitter, bounded by ``max_retries``
+    (MXTPU_RETRY_MAX) and a ``deadline`` wall-clock budget in seconds
+    (MXTPU_RETRY_TIMEOUT; <= 0 disables the deadline).  Raises
+    :class:`RetryExhausted` (cause = last error) when the budget runs
+    out; non-transient exceptions propagate immediately."""
+    from . import profiler as _prof
+
+    retries = _retry_max() if max_retries is None else max_retries
+    budget = _retry_timeout() if deadline is None else deadline
+    base = _retry_base()
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            out = fn()
+            if attempt:
+                _prof.inc_stat("retry_recovered::" + site)
+            return out
+        except retry_on as e:
+            if isinstance(e, PERMANENT_ERRORS):
+                raise
+            elapsed = time.monotonic() - t0
+            if attempt >= retries or (budget > 0 and elapsed >= budget):
+                _prof.inc_stat("retry_failures::" + site)
+                raise RetryExhausted(
+                    "%r failed %d time(s) over %.2fs (MXTPU_RETRY_MAX=%d,"
+                    " MXTPU_RETRY_TIMEOUT=%.1f): %s"
+                    % (site, attempt + 1, elapsed, retries, budget,
+                       e)) from e
+            _prof.inc_stat("retry_attempts::" + site)
+            sleep = min(_BACKOFF_CAP, base * (2 ** attempt))
+            sleep *= 0.5 + 0.5 * _retry_rng.random()  # jitter
+            if budget > 0:
+                sleep = min(sleep, max(0.0, budget - elapsed))
+            if sleep > 0:
+                time.sleep(sleep)
+            attempt += 1
+
+
+def guarded(site: str, fn: Callable, *args,
+            _retry_deadline: Optional[float] = None, **kwargs) -> Any:
+    """``maybe_fault(site)`` then ``fn(*args, **kwargs)``, the whole
+    body under :func:`run_with_retry`.  THE one-liner chokepoint
+    wrapper the call sites use; zero-overhead-ish when no fault is
+    armed and the call succeeds.  ``_retry_deadline`` overrides the
+    MXTPU_RETRY_TIMEOUT budget for call sites whose single attempt can
+    legitimately outlast it (e.g. a dist kvstore op bounded by
+    MXTPU_KVSTORE_TIMEOUT)."""
+    def body():
+        maybe_fault(site)
+        return fn(*args, **kwargs)
+    return run_with_retry(site, body, deadline=_retry_deadline)
+
+
+def fault_barrier(site: str, detail: str = "") -> None:
+    """A pure chokepoint for sites whose real work cannot be re-run
+    from here (e.g. the jit dispatch about to trigger an XLA compile):
+    when armed, rolls the fault RNG under the retry policy so a flaky
+    site recovers and the retry counters tick; no-op otherwise."""
+    if not _ANY_ARMED or not site_armed(site):
+        return
+    run_with_retry(site, lambda: maybe_fault(site, detail))
+
+
+def retryable(site: str, retry_on: Tuple[type, ...] = TRANSIENT_ERRORS):
+    """Decorator form of :func:`guarded`."""
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            def body():
+                maybe_fault(site)
+                return fn(*args, **kwargs)
+            return run_with_retry(site, body, retry_on=retry_on)
+        return wrapper
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Atomic file IO + CRC-checked checkpoint manifests
+# ---------------------------------------------------------------------------
+
+_tmp_counter = itertools.count()
+
+
+class _AtomicFile(object):
+    """Context manager: write to a unique ``<path>.tmp.<pid>.<n>``,
+    fsync, rename into place on success, unlink on failure.  The
+    destination is either fully the new contents or untouched — never
+    truncated.  The per-process counter keeps concurrent writers of
+    the SAME path (e.g. a signal handler's emergency flush interleaved
+    with a regular save) on separate temp files."""
+
+    def __init__(self, path: str, mode: str = "wb"):
+        if "r" in mode or "a" in mode or "+" in mode:
+            raise MXNetError("atomic_write is write-only (mode %r)" % mode)
+        self._path = path
+        self._tmp = "%s.tmp.%d.%d" % (path, os.getpid(),
+                                      next(_tmp_counter))
+        self._mode = mode
+        self._f = None
+
+    def __enter__(self):
+        self._f = open(self._tmp, self._mode)
+        return self._f
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            if exc_type is None:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            self._f.close()
+        finally:
+            if exc_type is None:
+                os.replace(self._tmp, self._path)
+                _fsync_dir(os.path.dirname(os.path.abspath(self._path)))
+            else:
+                try:
+                    os.unlink(self._tmp)
+                except OSError:
+                    pass
+        return False
+
+
+def _fsync_dir(dirpath: str) -> None:
+    """Durability of the rename itself (best effort — not all
+    filesystems allow opening a directory)."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, mode: str = "wb") -> _AtomicFile:
+    """``with atomic_write(p) as f: f.write(...)`` — temp + fsync +
+    rename.  Used by every checkpoint/params/optimizer-state writer."""
+    return _AtomicFile(path, mode)
+
+
+def crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return crc & 0xFFFFFFFF
+
+
+MANIFEST_FORMAT = 1
+
+
+def manifest_path(prefix: str, epoch: int) -> str:
+    return "%s-%04d.manifest.json" % (prefix, epoch)
+
+
+class CheckpointWriter(object):
+    """Atomic multi-file checkpoint: each file lands via
+    :func:`atomic_write` and is CRC'd; :meth:`commit` writes the
+    manifest LAST, so a manifest's existence certifies a complete
+    checkpoint.  All IO runs under the ``checkpoint`` fault site +
+    retry policy.
+
+    ::
+
+        w = CheckpointWriter(prefix, epoch)
+        with w.file(path) as f: f.write(...)   # any number of files
+        w.add_existing(path)                    # or CRC a file already
+        w.commit()                              # written elsewhere
+    """
+
+    def __init__(self, prefix: str, epoch: int):
+        self.prefix = prefix
+        self.epoch = int(epoch)
+        self._files: Dict[str, Dict[str, int]] = {}
+
+    class _Tracked(object):
+        def __init__(self, writer, path, mode):
+            self._writer = writer
+            self._path = path
+            self._atomic = _AtomicFile(path, mode)
+
+        def __enter__(self):
+            maybe_fault("checkpoint", self._path)
+            return self._atomic.__enter__()
+
+        def __exit__(self, exc_type, exc, tb):
+            out = self._atomic.__exit__(exc_type, exc, tb)
+            if exc_type is None:
+                self._writer.add_existing(self._path)
+            return out
+
+    def file(self, path: str, mode: str = "wb") -> "_Tracked":
+        """Atomic-write one checkpoint member and record its CRC."""
+        return CheckpointWriter._Tracked(self, path, mode)
+
+    def add_existing(self, path: str) -> None:
+        """Record a file already written (e.g. by ``nd.save``)."""
+        self._files[os.path.basename(path)] = {
+            "crc32": crc32_file(path),
+            "bytes": os.path.getsize(path),
+        }
+
+    def commit(self, extra: Optional[Dict[str, Any]] = None) -> str:
+        """Write the manifest (atomically, last).  Returns its path."""
+        from . import profiler as _prof
+
+        mpath = manifest_path(self.prefix, self.epoch)
+        payload = {"format": MANIFEST_FORMAT, "epoch": self.epoch,
+                   "files": self._files}
+        if extra:
+            payload.update(extra)
+
+        def _write():
+            maybe_fault("checkpoint", mpath)
+            with atomic_write(mpath, "w") as f:
+                json.dump(payload, f, indent=1, sort_keys=True)
+        run_with_retry("checkpoint", _write)
+        _prof.inc_stat("checkpoint_committed")
+        return mpath
+
+
+def read_manifest(prefix: str, epoch: int) -> Optional[Dict[str, Any]]:
+    mpath = manifest_path(prefix, epoch)
+    try:
+        with open(mpath) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(m, dict) or "files" not in m:
+        return None
+    return m
+
+
+def validate_manifest(prefix: str, epoch: int,
+                      required: Optional[List[str]] = None) -> bool:
+    """True iff the manifest exists, parses, and every listed file is
+    present with a matching CRC32 (i.e. the checkpoint is complete and
+    uncorrupted).  ``required`` file basenames must additionally be
+    listed."""
+    m = read_manifest(prefix, epoch)
+    if m is None:
+        return False
+    files = m.get("files", {})
+    if required and any(r not in files for r in required):
+        return False
+    dirname = os.path.dirname(os.path.abspath(prefix))
+    for name, meta in files.items():
+        path = os.path.join(dirname, name)
+        try:
+            if os.path.getsize(path) != meta.get("bytes", -1):
+                return False
+            if crc32_file(path) != meta.get("crc32", -1):
+                return False
+        except OSError:
+            return False
+    return True
+
+
+def list_manifest_epochs(prefix: str) -> List[int]:
+    """Epochs with a manifest file for ``prefix``, ascending (validity
+    not checked — see :func:`latest_valid_epoch`)."""
+    dirname = os.path.dirname(os.path.abspath(prefix)) or "."
+    base = os.path.basename(prefix)
+    out = []
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(base + "-")
+                and name.endswith(".manifest.json")):
+            continue
+        mid = name[len(base) + 1:-len(".manifest.json")]
+        if mid.isdigit():
+            out.append(int(mid))
+    return sorted(out)
+
+
+def latest_valid_epoch(prefix: str) -> Optional[int]:
+    """The newest epoch whose manifest validates; corrupt/partial
+    checkpoints are skipped (ticking ``checkpoint_skipped_corrupt``).
+    None when no valid checkpoint exists."""
+    from . import profiler as _prof
+
+    for epoch in reversed(list_manifest_epochs(prefix)):
+        if validate_manifest(prefix, epoch):
+            return epoch
+        _prof.inc_stat("checkpoint_skipped_corrupt")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Preemption (SIGTERM) hook
+# ---------------------------------------------------------------------------
+
+_preempt_lock = threading.Lock()
+_preempt_callbacks: List[Callable[[], None]] = []
+_preempt_prev: Dict[int, Any] = {}
+_preempted = threading.Event()
+
+
+def _preempt_handler(signum, frame):
+    from . import profiler as _prof
+
+    _preempted.set()
+    with _preempt_lock:
+        callbacks = list(_preempt_callbacks)
+        forward = _PREEMPT_FORWARD[0]
+        prev = _preempt_prev.get(signum)
+    for cb in callbacks:
+        try:
+            cb()
+            _prof.inc_stat("preempt_checkpoint_flushed")
+        except Exception:
+            _prof.inc_stat("preempt_checkpoint_failed")
+    if not forward:
+        return
+    # emergency state is on disk; now honor the prior disposition
+    if prev is signal.SIG_IGN:
+        return  # the signal was ignored before us: keep ignoring it
+    if callable(prev):
+        prev(signum, frame)
+    else:  # SIG_DFL / unknown: die the way we would have
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+_PREEMPT_FORWARD = [True]
+
+
+def install_preemption_hook(callback: Callable[[], None],
+                            signals: Tuple[int, ...] = (signal.SIGTERM,),
+                            forward: bool = True) -> Callable[[], None]:
+    """Flush an emergency checkpoint on preemption: ``callback`` runs
+    when any of ``signals`` (default SIGTERM — what the scheduler sends
+    before a SIGKILL) arrives, then the previous disposition runs (the
+    process still dies) unless ``forward=False``.  Main thread only
+    (signal module constraint).  Returns a zero-arg remover for this
+    callback."""
+    with _preempt_lock:
+        _PREEMPT_FORWARD[0] = forward
+        _preempt_callbacks.append(callback)
+        for sig in signals:
+            if sig not in _preempt_prev:
+                _preempt_prev[sig] = signal.signal(sig, _preempt_handler)
+
+    def remove():
+        with _preempt_lock:
+            if callback in _preempt_callbacks:
+                _preempt_callbacks.remove(callback)
+    return remove
+
+
+def remove_preemption_hook() -> None:
+    """Drop every callback and restore the original signal handlers."""
+    with _preempt_lock:
+        _preempt_callbacks.clear()
+        for sig, prev in _preempt_prev.items():
+            try:
+                signal.signal(sig, prev if prev is not None
+                              else signal.SIG_DFL)
+            except (ValueError, TypeError):
+                pass
+        _preempt_prev.clear()
+        _preempted.clear()
+
+
+def preempted() -> bool:
+    """True once a preemption signal has been observed."""
+    return _preempted.is_set()
+
+
+# ---------------------------------------------------------------------------
+# Non-finite step guard
+# ---------------------------------------------------------------------------
+
+def max_bad_steps() -> int:
+    """``MXTPU_MAX_BAD_STEPS``: > 0 enables the non-finite grad/loss
+    guard in ``gluon.Trainer.step`` and ``FusedTrainLoop`` — a bad step
+    is SKIPPED (params/optimizer state untouched) and only this many
+    CONSECUTIVE bad steps abort the run.  0 (default) disables the
+    guard entirely (no per-step finiteness sync)."""
+    return max(0, getenv_int("MXTPU_MAX_BAD_STEPS", 0))
+
+
+class BadStepGuard(object):
+    """Tracks consecutive skipped (non-finite) update steps."""
+
+    def __init__(self, limit: Optional[int] = None, site: str = "train"):
+        self.limit = max_bad_steps() if limit is None else int(limit)
+        self.site = site
+        self.consecutive = 0
+        self.total_skipped = 0
+
+    def record(self, ok: bool) -> bool:
+        """Record one step's health.  Returns True when the step must
+        be skipped; raises :class:`MXNetError` after ``limit``
+        consecutive bad steps."""
+        from . import profiler as _prof
+
+        if ok:
+            self.consecutive = 0
+            return False
+        self.consecutive += 1
+        self.total_skipped += 1
+        _prof.inc_stat("bad_steps_skipped")
+        _prof.inc_stat("bad_steps_skipped::" + self.site)
+        if self.limit and self.consecutive >= self.limit:
+            _prof.inc_stat("bad_steps_abort")
+            raise MXNetError(
+                "%d consecutive non-finite update steps at %r "
+                "(MXTPU_MAX_BAD_STEPS=%d): aborting — the model has "
+                "diverged beyond what skipping can absorb"
+                % (self.consecutive, self.site, self.limit))
+        return True
+
+
+def all_finite(jax_arrays) -> bool:
+    """Host-side check that every array is fully finite (blocks on the
+    device values — only call when the guard is enabled)."""
+    import jax.numpy as jnp
+
+    for a in jax_arrays:
+        if a is None:
+            continue
+        if not bool(jnp.isfinite(a).all()):
+            return False
+    return True
+
+
+# Arm fault sites from the environment at import, so subprocess-driven
+# tests/tools (`tools/check_resilience.py`) only need to set the env
+# var before python starts.
+arm_from_env()

@@ -452,7 +452,9 @@ class Symbol(object):
                           indent=2)
 
     def save(self, fname: str):
-        with open(fname, "w") as f:
+        from ..resilience import atomic_write
+
+        with atomic_write(fname, "w") as f:
             f.write(self.tojson())
 
     # -- binding (whole-graph XLA lowering) -------------------------------

@@ -1,0 +1,504 @@
+"""Resilience subsystem tests (mxtpu/resilience.py + wiring).
+
+Covers: deterministic fault injection, retry/backoff counters, atomic
+checkpoint IO + CRC manifests, kill-and-resume parity (train N steps,
+checkpoint, crash, `load_latest`, continue == uninterrupted run),
+KVStore timeouts, DataLoader worker failure surfacing/respawn, the
+non-finite bad-step guard, and the SIGTERM preemption hook.
+"""
+import os
+import signal
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import profiler, resilience as res
+from mxtpu.base import KVStoreTimeoutError, MXNetError
+from mxtpu.io.io import DataBatch
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Every test starts/ends with no faults armed and fast backoff."""
+    monkeypatch.setenv("MXTPU_RETRY_BASE", "0.001")
+    res.clear_faults()
+    yield
+    res.clear_faults()
+
+
+# ---------------------------------------------------------------------------
+# fault injection + retry
+# ---------------------------------------------------------------------------
+
+def _fire_pattern(site, n=24):
+    out = []
+    for _ in range(n):
+        try:
+            res.maybe_fault(site)
+            out.append(0)
+        except res.InjectedFault:
+            out.append(1)
+    return out
+
+
+def test_fault_injection_deterministic():
+    res.inject("kvstore_pull", 0.5, seed=42)
+    a = _fire_pattern("kvstore_pull")
+    res.inject("kvstore_pull", 0.5, seed=42)
+    b = _fire_pattern("kvstore_pull")
+    assert a == b
+    assert 0 < sum(a) < len(a)
+    res.inject("kvstore_pull", 0.5, seed=43)
+    c = _fire_pattern("kvstore_pull")
+    assert c != a  # different seed, different schedule
+
+
+def test_fault_site_aliases_and_unknown():
+    res.inject("compile_cache", 1.0, seed=0)  # alias of "compile"
+    assert res.site_armed("compile")
+    with pytest.raises(MXNetError):
+        res.inject("no_such_site", 1.0)
+
+
+def test_arm_from_env_spec():
+    armed = res.arm_from_env("compile:0.3:7, kvstore-pull:0.2:9")
+    assert armed == ["compile", "kvstore_pull"]
+    assert res.site_armed("compile") and res.site_armed("kvstore_pull")
+
+
+def test_retry_recovers_and_counts(monkeypatch):
+    monkeypatch.setenv("MXTPU_RETRY_MAX", "12")
+    profiler.reset_stats()
+    res.inject("dataloader", 0.6, seed=5)
+    for _ in range(10):
+        assert res.guarded("dataloader", lambda: "ok") == "ok"
+    st = profiler.stats()
+    assert st.get("retry_attempts::dataloader", 0) > 0
+    assert st.get("retry_recovered::dataloader", 0) > 0
+    assert st.get("retry_failures::dataloader", 0) == 0
+
+
+def test_retry_exhaustion_raises_typed():
+    profiler.reset_stats()
+    res.inject("checkpoint", 1.0, seed=1)
+    with pytest.raises(res.RetryExhausted) as ei:
+        res.run_with_retry("checkpoint",
+                           lambda: res.maybe_fault("checkpoint"),
+                           max_retries=3)
+    assert isinstance(ei.value.__cause__, res.InjectedFault)
+    assert profiler.get_stat("retry_failures::checkpoint") == 1
+
+
+def test_retry_nontransient_propagates_immediately():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("logic bug, not transient")
+    with pytest.raises(ValueError):
+        res.run_with_retry("compile", boom)
+    assert len(calls) == 1
+
+
+def test_retry_deadline():
+    t0 = time.monotonic()
+    with pytest.raises(res.RetryExhausted):
+        res.run_with_retry(
+            "compile", lambda: (_ for _ in ()).throw(OSError("flaky")),
+            max_retries=10_000, deadline=0.2)
+    assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# atomic IO + manifests
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_never_truncates(tmp_path):
+    p = str(tmp_path / "f.bin")
+    with res.atomic_write(p) as f:
+        f.write(b"generation-1")
+    with pytest.raises(RuntimeError):
+        with res.atomic_write(p) as f:
+            f.write(b"partial")
+            raise RuntimeError("crash mid-save")
+    with open(p, "rb") as f:
+        assert f.read() == b"generation-1"
+    assert not [n for n in os.listdir(str(tmp_path)) if ".tmp." in n]
+
+
+def _save_ck(prefix, epoch, scale=1.0):
+    x = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+    args = {"fc_weight": mx.nd.ones((4, 3)) * scale,
+            "fc_bias": mx.nd.zeros((4,))}
+    mx.model.save_checkpoint(prefix, epoch, net, args, {})
+    return args
+
+
+def test_checkpoint_manifest_and_load_latest(tmp_path):
+    prefix = str(tmp_path / "ck")
+    _save_ck(prefix, 1, scale=1.0)
+    args2 = _save_ck(prefix, 2, scale=2.0)
+    assert os.path.exists(res.manifest_path(prefix, 2))
+    assert res.validate_manifest(prefix, 2)
+    sym, args, auxs, epoch = mx.model.load_latest(prefix)
+    assert epoch == 2
+    np.testing.assert_array_equal(args["fc_weight"].asnumpy(),
+                                  args2["fc_weight"].asnumpy())
+
+
+def test_load_latest_skips_corrupt(tmp_path):
+    profiler.reset_stats()
+    prefix = str(tmp_path / "ck")
+    _save_ck(prefix, 1)
+    _save_ck(prefix, 2)
+    with open(prefix + "-0002.params", "r+b") as f:  # bitrot epoch 2
+        f.seek(8)
+        f.write(b"\xff" * 16)
+    assert not res.validate_manifest(prefix, 2)
+    _, _, _, epoch = mx.model.load_latest(prefix)
+    assert epoch == 1
+    assert profiler.get_stat("checkpoint_skipped_corrupt") >= 1
+
+
+def test_load_latest_skips_missing_manifest(tmp_path):
+    """A params file without a manifest (save killed mid-write) is not
+    trusted when manifests are in play."""
+    prefix = str(tmp_path / "ck")
+    _save_ck(prefix, 1)
+    _save_ck(prefix, 2)
+    os.unlink(res.manifest_path(prefix, 2))  # simulate kill pre-commit
+    _, _, _, epoch = mx.model.load_latest(prefix)
+    assert epoch == 1
+
+
+def test_load_latest_none_when_empty(tmp_path):
+    assert mx.model.load_latest(str(tmp_path / "nothing")) is None
+
+
+def test_checkpoint_io_survives_injected_faults(tmp_path):
+    res.inject("checkpoint", 0.4, seed=11)
+    prefix = str(tmp_path / "ck")
+    _save_ck(prefix, 3)
+    res.clear_faults()
+    assert res.validate_manifest(prefix, 3)
+    assert mx.model.load_latest(prefix)[3] == 3
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume parity
+# ---------------------------------------------------------------------------
+
+def _make_mod(lr=0.1, momentum=0.9):
+    mx.random.seed(7)
+    x = mx.sym.Variable("data")
+    y = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(x, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(h, label=y, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Uniform(0.1))
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params={"learning_rate": lr,
+                                         "momentum": momentum})
+    return mod
+
+
+def _step(mod, d, l):
+    b = DataBatch(data=[mx.nd.array(d)], label=[mx.nd.array(l)])
+    mod.forward(b, is_train=True)
+    mod.backward()
+    mod.update()
+
+
+def test_kill_and_resume_parity(tmp_path):
+    """Train 10 steps straight vs. 5 steps + checkpoint + simulated
+    crash + load_latest + 5 steps — with faults armed on every site
+    during the interrupted run.  Params (and thus optimizer momentum
+    effects) must match within 1e-6."""
+    rng = np.random.RandomState(0)
+    data = [(rng.rand(4, 10).astype("float32"),
+             rng.randint(0, 3, (4,)).astype("float32"))
+            for _ in range(10)]
+
+    mod_a = _make_mod()
+    for d, l in data:
+        _step(mod_a, d, l)
+    ref = {k: v.asnumpy() for k, v in mod_a.get_params()[0].items()}
+
+    prefix = str(tmp_path / "ck")
+    res.arm_from_env("compile:0.3:7,kvstore_pull:0.3:11,"
+                     "kvstore_push:0.3:12,checkpoint:0.3:13")
+    mod_b = _make_mod()
+    for d, l in data[:5]:
+        _step(mod_b, d, l)
+    mod_b.save_checkpoint(prefix, 5, save_optimizer_states=True)
+    del mod_b  # "crash"
+
+    got = mx.mod.Module.load_latest(prefix, load_optimizer_states=True,
+                                    context=mx.cpu())
+    assert got is not None
+    mod_c, epoch = got
+    assert epoch == 5
+    mod_c.bind(data_shapes=[("data", (4, 10))],
+               label_shapes=[("softmax_label", (4,))])
+    mod_c.init_optimizer(kvstore="tpu", optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9})
+    for d, l in data[5:]:
+        _step(mod_c, d, l)
+    res.clear_faults()
+    out = {k: v.asnumpy() for k, v in mod_c.get_params()[0].items()}
+    assert set(out) == set(ref)
+    for k in ref:
+        np.testing.assert_allclose(ref[k], out[k], atol=1e-6,
+                                   err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# kvstore timeout
+# ---------------------------------------------------------------------------
+
+def test_kvstore_timeout_typed():
+    """A server that accepts and never replies must raise
+    KVStoreTimeoutError, not hang."""
+    from mxtpu._ps import _Client, _send_msg
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    addr = srv.getsockname()
+    stop = threading.Event()
+
+    def silent_server():
+        conn, _ = srv.accept()
+        stop.wait(5)
+        conn.close()
+
+    t = threading.Thread(target=silent_server, daemon=True)
+    t.start()
+    try:
+        cli = _Client(addr, retries=5)
+        t0 = time.monotonic()
+        with pytest.raises(KVStoreTimeoutError):
+            cli.request({"op": "pull", "key": "w"}, timeout=0.3)
+        assert time.monotonic() - t0 < 3.0
+        assert isinstance(KVStoreTimeoutError("x"), TimeoutError)
+        cli.close()
+    finally:
+        stop.set()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# DataLoader worker failures
+# ---------------------------------------------------------------------------
+
+class _FlakyOnce(object):
+    """Raises on one index the FIRST time it is fetched (file-based
+    flag so forked workers share the state)."""
+
+    def __init__(self, flag_path, bad_idx=5, n=16):
+        self._flag = flag_path
+        self._bad = bad_idx
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, idx):
+        if idx == self._bad and not os.path.exists(self._flag):
+            with open(self._flag, "w") as f:
+                f.write("tripped")
+            raise RuntimeError("transient decode failure idx=%d" % idx)
+        return np.full((3,), idx, dtype="float32")
+
+
+class _AlwaysBroken(object):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, idx):
+        raise RuntimeError("permanently broken sample %d" % idx)
+
+
+@pytest.mark.parametrize("thread_pool", [True, False])
+def test_dataloader_respawns_after_transient_failure(tmp_path,
+                                                     thread_pool):
+    from mxtpu.gluon.data import DataLoader
+
+    ds = _FlakyOnce(str(tmp_path / ("flag.%s" % thread_pool)))
+    loader = DataLoader(ds, batch_size=4, num_workers=2,
+                        thread_pool=thread_pool)
+    batches = list(loader)
+    assert len(batches) == 4
+    got = np.concatenate([b.asnumpy() for b in batches])
+    np.testing.assert_array_equal(got[:, 0], np.arange(16))
+
+
+@pytest.mark.parametrize("thread_pool", [True, False])
+def test_dataloader_surfaces_original_traceback(thread_pool):
+    from mxtpu.gluon.data import DataLoader
+
+    loader = DataLoader(_AlwaysBroken(), batch_size=4, num_workers=2,
+                        thread_pool=thread_pool)
+    with pytest.raises(Exception) as ei:
+        list(loader)
+    text = "%s\n%s" % (ei.value, ei.getrepr(chain=True))
+    assert "permanently broken sample" in text
+
+
+def test_dataloader_worker_death_does_not_deadlock():
+    """A worker killed mid-batch (os._exit — the pool loses the task)
+    must not hang the iterator: the batch is resubmitted once."""
+    from mxtpu.gluon.data import DataLoader
+
+    class _Suicidal(object):
+        def __init__(self, flag):
+            self._flag = flag
+
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, idx):
+            if idx == 2 and not os.path.exists(self._flag):
+                with open(self._flag, "w") as f:
+                    f.write("x")
+                os._exit(17)  # simulate OOM-killer on the worker
+            return np.full((2,), idx, dtype="float32")
+
+    import tempfile
+
+    flag = os.path.join(tempfile.mkdtemp(), "died")
+    loader = DataLoader(_Suicidal(flag), batch_size=4, num_workers=2,
+                        thread_pool=False)
+    batches = list(loader)
+    got = np.concatenate([b.asnumpy() for b in batches])
+    np.testing.assert_array_equal(got[:, 0], np.arange(8))
+    assert profiler.get_stat("dataloader_worker_respawn") >= 1
+
+
+# ---------------------------------------------------------------------------
+# bad-step guard
+# ---------------------------------------------------------------------------
+
+def test_trainer_skips_nonfinite_steps(monkeypatch):
+    monkeypatch.setenv("MXTPU_MAX_BAD_STEPS", "3")
+    from mxtpu import gluon
+    from mxtpu.gluon import nn
+
+    mx.random.seed(3)
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    x = mx.nd.ones((2, 4))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(2)
+    w_good = net.weight.data().asnumpy().copy()
+
+    # poison the gradient: step must be SKIPPED (weight unchanged)
+    net.weight.grad()[:] = mx.nd.array(
+        np.full(net.weight.shape, np.nan, "float32"))
+    before = profiler.get_stat("bad_steps_skipped")
+    trainer.step(2)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w_good)
+    assert profiler.get_stat("bad_steps_skipped") == before + 1
+
+    # a consecutive run of bad steps aborts at the limit
+    with pytest.raises(MXNetError):
+        for _ in range(3):
+            net.weight.grad()[:] = mx.nd.array(
+                np.full(net.weight.shape, np.nan, "float32"))
+            trainer.step(2)
+
+
+def test_trainer_guard_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("MXTPU_MAX_BAD_STEPS", raising=False)
+    assert res.max_bad_steps() == 0
+
+
+def test_fused_train_skips_nonfinite_steps(monkeypatch):
+    """NaN data inside a fused K-step program: that step's update is
+    dropped in-program, healthy steps still apply."""
+    monkeypatch.setenv("MXTPU_MAX_BAD_STEPS", "8")
+    from mxtpu.fused_train import FusedTrainLoop
+
+    def build():
+        mx.random.seed(5)
+        x = mx.sym.Variable("data")
+        y = mx.sym.Variable("label")
+        out = mx.sym.LinearRegressionOutput(
+            mx.sym.FullyConnected(x, num_hidden=1, name="fc"), label=y)
+        mod = mx.mod.Module(out, data_names=("data",),
+                            label_names=("label",), context=mx.cpu())
+        mod.bind(data_shapes=[("data", (2, 3))],
+                 label_shapes=[("label", (2, 1))])
+        mod.init_params(mx.initializer.Uniform(0.1))
+        mod.init_optimizer(kvstore=None, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05})
+        return mod
+
+    rng = np.random.RandomState(1)
+    clean = [(rng.rand(2, 3).astype("float32"),
+              rng.rand(2, 1).astype("float32")) for _ in range(4)]
+
+    def batches(data):
+        return [DataBatch(data=[mx.nd.array(d)], label=[mx.nd.array(l)])
+                for d, l in data]
+
+    # reference: only the 3 clean steps applied (the NaN one skipped)
+    mod_ref = build()
+    loop_ref = FusedTrainLoop(mod_ref, steps_per_program=1,
+                              collect_outputs=False)
+    for i, b in enumerate(batches(clean)):
+        if i != 2:
+            loop_ref.run([b])
+    ref_w = mod_ref.get_params()[0]["fc_weight"].asnumpy()
+
+    poisoned = list(clean)
+    poisoned[2] = (np.full((2, 3), np.nan, "float32"), poisoned[2][1])
+    mod_g = build()
+    loop_g = FusedTrainLoop(mod_g, steps_per_program=4,
+                            collect_outputs=False)
+    before = profiler.get_stat("bad_steps_skipped")
+    loop_g.run(batches(poisoned))
+    assert profiler.get_stat("bad_steps_skipped") == before + 1
+    got_w = mod_g.get_params()[0]["fc_weight"].asnumpy()
+    assert np.isfinite(got_w).all()
+    np.testing.assert_allclose(got_w, ref_w, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# preemption hook
+# ---------------------------------------------------------------------------
+
+def test_preemption_hook_flushes_checkpoint(tmp_path):
+    prefix = str(tmp_path / "emergency")
+
+    def flush():
+        _save_ck(prefix, 0)
+
+    rm = res.install_preemption_hook(flush, forward=False)
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not res.preempted() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert res.preempted()
+        assert res.validate_manifest(prefix, 0)
+        assert profiler.get_stat("preempt_checkpoint_flushed") >= 1
+    finally:
+        rm()
+        res.remove_preemption_hook()

@@ -54,6 +54,17 @@ def test_check_retrace_guard():
     assert out.startswith("OK")
 
 
+def test_check_resilience_guard():
+    """tools/check_resilience.py: a short fault-injected training run
+    (compile-fail + kvstore-pull-fail + checkpoint-fail + SIGTERM +
+    SIGKILL-mid-save) must recover via retries and auto-resume with
+    zero lost checkpoints and fault-free-identical params (see
+    mxtpu/resilience.py)."""
+    out = _run(["tools/check_resilience.py", "--steps", "20"],
+               timeout=420)
+    assert "check_resilience OK" in out
+
+
 def test_parse_log(tmp_path):
     log = tmp_path / "train.log"
     log.write_text(

@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""Resilience guard: fault-injected training must survive and converge.
+
+Drives a short data-parallel training loop through the full failure
+gauntlet and fails (rc=1) unless recovery is bit-for-bit honest:
+
+  1. a CLEAN 50-step run records the reference parameters;
+  2. the SAME run repeats with ``MXTPU_FAULT_INJECT`` arming compile,
+     kvstore-pull/push and checkpoint-IO faults at 0.3 probability, a
+     checkpoint every 5 steps, and a SIGTERM delivered mid-run — the
+     child flushes a boundary checkpoint via the preemption hook's
+     flag and dies; a relaunch auto-resumes from ``load_latest`` and
+     finishes;
+  3. final params must match the clean run within 1e-6 and
+     ``profiler.stats()`` must show nonzero retry and skipped-step
+     counters (a NaN-grad guard demo runs in the child under
+     ``MXTPU_MAX_BAD_STEPS``);
+  4. a separate child saves checkpoints in a loop and is SIGKILLed
+     mid-save: every committed manifest must still validate and
+     ``load_latest`` must restore a previous valid checkpoint — zero
+     lost checkpoints.
+
+Wired as a fast test in `tests/test_tools.py`.
+
+Usage: python tools/check_resilience.py [--steps N]
+"""
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+CKPT_EVERY = 5
+
+
+# ---------------------------------------------------------------------------
+# child: the training loop (clean or faulted — decided by the env)
+# ---------------------------------------------------------------------------
+
+def _build_module(steps):
+    import mxtpu as mx
+
+    mx.random.seed(11)
+    x = mx.sym.Variable("data")
+    y = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(x, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(h, label=y, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    return net, mod
+
+
+def _bind_opt(mod):
+    import mxtpu as mx
+
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    if not mod.params_initialized:
+        mod.init_params(mx.initializer.Uniform(0.1))
+    # kvstore="tpu" keeps the kvstore in the loop on one device, so the
+    # kvstore_push/kvstore_pull chokepoints sit on the update path
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+
+
+def _batches(steps):
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    return [(rng.rand(4, 10).astype("float32"),
+             rng.randint(0, 3, (4,)).astype("float32"))
+            for _ in range(steps)]
+
+
+def _guard_demo():
+    """Tick bad_steps_skipped: two NaN-grad steps a gluon Trainer must
+    SKIP under MXTPU_MAX_BAD_STEPS (separate net; does not touch the
+    parity loop)."""
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import gluon
+    from mxtpu.gluon import nn
+
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.ones((2, 4))
+    for _ in range(2):
+        with mx.autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        net.weight.grad()[:] = mx.nd.array(
+            np.full(net.weight.shape, np.nan, "float32"))
+        trainer.step(2)
+
+
+def run_child(args):
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import profiler, resilience as res
+
+    steps = args.steps
+    # SIGTERM = preemption: the hook records the flag; the loop flushes
+    # at the NEXT STEP BOUNDARY (mid-step state is not a checkpoint)
+    res.install_preemption_hook(lambda: None, forward=False)
+
+    start = 0
+    found = mx.mod.Module.load_latest(args.prefix,
+                                      load_optimizer_states=True,
+                                      context=mx.cpu())
+    if found is not None:
+        mod, start = found
+    else:
+        _, mod = _build_module(steps)
+    _bind_opt(mod)
+
+    from mxtpu.io.io import DataBatch
+
+    data = _batches(steps)
+    for i in range(start, steps):
+        b = DataBatch(data=[mx.nd.array(data[i][0])],
+                      label=[mx.nd.array(data[i][1])])
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+        done = i + 1
+        if args.progress:
+            with open(args.progress, "w") as f:
+                f.write(str(done))
+        if res.preempted():
+            mod.save_checkpoint(args.prefix, done,
+                                save_optimizer_states=True)
+            sys.exit(3)  # parent relaunches to resume
+        if done % CKPT_EVERY == 0:
+            mod.save_checkpoint(args.prefix, done,
+                                save_optimizer_states=True)
+
+    if os.environ.get("MXTPU_MAX_BAD_STEPS"):
+        _guard_demo()
+    params = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    np.savez(args.out, **params)
+    if args.stats:
+        with open(args.stats, "w") as f:
+            json.dump(profiler.stats(), f)
+    return 0
+
+
+def run_killsave_child(args):
+    import mxtpu as mx
+
+    _, mod = _build_module(args.steps)
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Uniform(0.1))
+    for epoch in range(1, 10_000):
+        mod.save_checkpoint(args.prefix, epoch)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestration + assertions
+# ---------------------------------------------------------------------------
+
+def _spawn(extra, env_extra=None):
+    env = dict(os.environ)
+    env.pop("MXTPU_FAULT_INJECT", None)
+    env.pop("MXTPU_MAX_BAD_STEPS", None)
+    env.update(env_extra or {})
+    return subprocess.Popen([sys.executable, os.path.abspath(__file__)]
+                            + extra, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+
+
+def _wait(proc, what, timeout=420):
+    out, _ = proc.communicate(timeout=timeout)
+    text = out.decode(errors="replace")
+    return proc.returncode, text
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--child", choices=["train", "killsave"])
+    ap.add_argument("--prefix")
+    ap.add_argument("--out")
+    ap.add_argument("--progress")
+    ap.add_argument("--stats")
+    args = ap.parse_args()
+    if args.child == "train":
+        return run_child(args)
+    if args.child == "killsave":
+        return run_killsave_child(args)
+
+    import numpy as np
+
+    workdir = tempfile.mkdtemp(prefix="mxtpu_resilience_")
+    failures = []
+    fault_env = {
+        "MXTPU_FAULT_INJECT":
+            "compile:0.3:7,kvstore_pull:0.3:11,kvstore_push:0.3:12,"
+            "checkpoint:0.3:13",
+        "MXTPU_RETRY_BASE": "0.002",
+        "MXTPU_RETRY_MAX": "12",
+        "MXTPU_MAX_BAD_STEPS": "5",
+    }
+
+    # 1. clean reference run
+    clean_out = os.path.join(workdir, "clean.npz")
+    os.makedirs(os.path.join(workdir, "scratch"), exist_ok=True)
+    rc, text = _wait(_spawn(
+        ["--child", "train", "--steps", str(args.steps),
+         "--prefix", os.path.join(workdir, "scratch", "ck"),
+         "--out", clean_out]), "clean run")
+    if rc != 0:
+        print(text)
+        print("FAIL: clean run rc=%d" % rc)
+        return 1
+
+    # 2. faulted run, SIGTERM mid-run, auto-resume relaunch
+    prefix = os.path.join(workdir, "ck")
+    fault_out = os.path.join(workdir, "fault.npz")
+    progress = os.path.join(workdir, "progress")
+    stats_path = os.path.join(workdir, "stats.json")
+    child_args = ["--child", "train", "--steps", str(args.steps),
+                  "--prefix", prefix, "--out", fault_out,
+                  "--progress", progress, "--stats", stats_path]
+    proc = _spawn(child_args, fault_env)
+    target = max(CKPT_EVERY + 1, args.steps // 2)
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        try:
+            if os.path.exists(progress) and \
+                    int(open(progress).read() or 0) >= target:
+                break
+        except ValueError:
+            pass
+        if proc.poll() is not None:
+            break
+        time.sleep(0.05)
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+    rc, text = _wait(proc, "faulted run (preempted)")
+    if rc == 3:
+        print("preempted as planned; emergency checkpoint flushed")
+        from mxtpu import resilience as res
+
+        if res.latest_valid_epoch(prefix) is None:
+            failures.append("no valid checkpoint after SIGTERM flush")
+        rc, text = _wait(_spawn(child_args, fault_env), "resumed run")
+    if rc != 0:
+        print(text)
+        failures.append("faulted run rc=%d" % rc)
+    else:
+        # 3. parity + counters
+        a = np.load(clean_out)
+        b = np.load(fault_out)
+        for k in a.files:
+            if not np.allclose(a[k], b[k], atol=1e-6):
+                failures.append("param %r diverged (max |d|=%g)"
+                                % (k, float(abs(a[k] - b[k]).max())))
+        stats = json.load(open(stats_path))
+        if not any(v for k, v in stats.items()
+                   if k.startswith("retry_attempts::")):
+            failures.append("no retry_attempts ticked: %s" % stats)
+        if not any(v for k, v in stats.items()
+                   if k.startswith("fault_injected::")):
+            failures.append("no faults actually fired")
+        if not stats.get("bad_steps_skipped"):
+            failures.append("bad_steps_skipped never ticked")
+
+    # 4. SIGKILL mid-save: zero lost checkpoints
+    kprefix = os.path.join(workdir, "kill", "ck")
+    os.makedirs(os.path.dirname(kprefix), exist_ok=True)
+    kproc = _spawn(["--child", "killsave", "--steps", str(args.steps),
+                    "--prefix", kprefix])
+    from mxtpu import resilience as res
+
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if res.list_manifest_epochs(kprefix):
+            break
+        if kproc.poll() is not None:
+            break
+        time.sleep(0.02)
+    time.sleep(0.15)  # land inside a later save with high probability
+    if kproc.poll() is None:
+        kproc.kill()
+        kproc.wait()
+    epochs = res.list_manifest_epochs(kprefix)
+    if not epochs:
+        failures.append("killsave: no checkpoint was ever committed")
+    else:
+        bad = [e for e in epochs if not res.validate_manifest(kprefix, e)]
+        if bad:
+            failures.append("killsave: committed manifests %s do not "
+                            "validate — a checkpoint was lost" % bad)
+        import mxtpu as mx
+
+        if mx.model.load_latest(kprefix) is None:
+            failures.append("killsave: load_latest found nothing")
+        else:
+            print("killsave: %d checkpoints committed, all valid, "
+                  "SIGKILL lost none" % len(epochs))
+
+    if failures:
+        print("check_resilience FAILURES:")
+        for f in failures:
+            print("  -", f)
+        return 1
+    print("check_resilience OK: %d-step run matched the fault-free "
+          "reference through 0.3-probability faults, SIGTERM resume "
+          "and SIGKILL'd saves" % args.steps)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
