@@ -31,6 +31,33 @@ On real TPU pods the sync path should use the ``tpu`` kvstore (XLA
 collectives over ICI) instead; this PS exists for exact `dist_sync` /
 `dist_async` (updater-on-server) semantics over DCN and for the
 multi-process local tests (`tools/launch.py`).
+
+Elastic membership (see `docs/elastic.md`):
+  * **server shard replication** — with ``MXTPU_PS_REPLICATION=1`` each
+    server chain-replicates every applied (value, version, updater
+    state) to its ring successor, staleness bounded by
+    ``MXTPU_PS_REPL_LAG`` outstanding applies.  When the scheduler's
+    dead-node detector (``MXTPU_DEAD_TIMEOUT``) declares a server dead,
+    workers ``promote`` the replica on the successor and transparently
+    redirect that server's shards there, re-pushing any round the
+    mirror had not yet received.  With replication off a dead server
+    raises the typed :class:`~mxtpu.base.ServerDiedError` (never a
+    hang).
+  * **elastic workers** — a dead worker is removed from the group: the
+    scheduler re-ranks survivors (generation bump, visible at the next
+    barrier), in-flight sync rounds complete with the survivors, and
+    the server rescales short rounds by ``nw0/len(contributors)`` so
+    gradient averaging keeps exact `dist_sync` semantics.  A respawned
+    worker re-registers as a *rejoin*, pulls current weights, and
+    resumes (`tools/launch.py --restart-workers`).
+  * **scheduler recoverability** — heartbeat threads survive a
+    scheduler restart: they reconnect with exponential backoff
+    (``MXTPU_SCHED_RECONNECT`` budget) and re-register their saved
+    role/rank/address so a fresh scheduler rebuilds its membership
+    tables.
+  * sync pushes carry a (worker id, round) pair, making retried pushes
+    IDEMPOTENT: a resend of an already-counted or already-applied push
+    is acknowledged without double-accumulating.
 """
 from __future__ import annotations
 
@@ -48,7 +75,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .base import KVStoreTimeoutError
+from .base import (KVStoreTimeoutError, PSConnectError, ServerDiedError,
+                   getenv)
+from . import resilience as _res
 
 __all__ = ["Scheduler", "Server", "Worker", "role_from_env",
            "run_scheduler", "run_server"]
@@ -105,6 +134,49 @@ def _bind_host() -> str:
     if root in ("127.0.0.1", "localhost", "::1"):
         return "127.0.0.1"
     return "0.0.0.0"
+
+
+def _replication_on() -> bool:
+    """MXTPU_PS_REPLICATION=1: chain-replicate server shards to the
+    ring successor and fail workers over to the replica on server
+    death."""
+    return _env("MXTPU_PS_REPLICATION", "DMLC_PS_REPLICATION",
+                default="0") == "1"
+
+
+def _dead_timeout() -> float:
+    """MXTPU_DEAD_TIMEOUT: seconds of heartbeat silence after which the
+    scheduler DECLARES a node dead (triggering re-rank / failover), and
+    the default probe window for `dead_nodes` queries."""
+    return float(_env("MXTPU_DEAD_TIMEOUT", "DMLC_DEAD_TIMEOUT",
+                      default="60"))
+
+
+def _repl_lag() -> int:
+    """MXTPU_PS_REPL_LAG: max applies a primary may run ahead of its
+    replica (the bounded-staleness window).  1 keeps every key within
+    one round of the mirror — what the failover re-push protocol can
+    reconstruct exactly."""
+    return max(1, int(_env("MXTPU_PS_REPL_LAG", default="1")))
+
+
+def _sched_reconnect() -> float:
+    """MXTPU_SCHED_RECONNECT: seconds a heartbeat thread keeps retrying
+    (exponential backoff) to reach a restarted scheduler before
+    treating the job as shut down."""
+    return float(_env("MXTPU_SCHED_RECONNECT", default="60"))
+
+
+def _straggler_sec() -> float:
+    """MXTPU_STRAGGLER_SEC: a sync pull blocked longer than this ticks
+    ``elastic_straggler_waits`` in :func:`mxtpu.profiler.stats`."""
+    return float(_env("MXTPU_STRAGGLER_SEC", default="10"))
+
+
+def _inc_stat(name: str, delta: int = 1) -> None:
+    from . import profiler as _prof
+
+    _prof.inc_stat(name, delta)
 
 
 # ---------------------------------------------------------------------------
@@ -220,29 +292,56 @@ def _recv_msg(sock: socket.socket):
     return _decode(payload)
 
 
+def _sever_sockets(socks) -> None:
+    """Forcibly sever sockets: shutdown() BEFORE close() — close()
+    alone does not wake a thread blocked in accept()/recv() on Linux,
+    leaving the socket half-alive."""
+    for s in socks:
+        try:
+            s.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
 class _Client(object):
     """Persistent request/response connection (thread-safe)."""
 
-    def __init__(self, addr: Tuple[str, int], retries: int = 100):
+    def __init__(self, addr: Tuple[str, int], retries: int = 100,
+                 deadline: Optional[float] = None):
         self._addr = tuple(addr)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
-        self._connect(retries)
+        self._connect(retries, deadline=deadline)
 
-    def _connect(self, retries: int = 100):
-        last = None
-        for _ in range(retries):
-            try:
-                self._sock = socket.create_connection(self._addr,
-                                                      timeout=None)
-                self._sock.setsockopt(socket.IPPROTO_TCP,
-                                      socket.TCP_NODELAY, 1)
-                return
-            except OSError as e:
-                last = e
-                time.sleep(0.1)
-        self._sock = None
-        raise ConnectionError("cannot reach %s: %s" % (self._addr, last))
+    def _connect(self, retries: int = 100,
+                 deadline: Optional[float] = None):
+        """Connect under the shared resilience policy: exponential
+        backoff + full jitter, bounded by a wall-clock ``deadline``
+        (seconds; default approximates the legacy ``retries`` * 0.1 s
+        fixed-sleep budget).  Raises the typed
+        :class:`~mxtpu.base.PSConnectError` on exhaustion."""
+        budget = deadline if deadline is not None else max(0.1,
+                                                           retries * 0.1)
+
+        def attempt():
+            sock = socket.create_connection(self._addr, timeout=budget)
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return sock
+
+        try:
+            self._sock = _res.run_with_retry(
+                "ps_connect", attempt, retry_on=(OSError,),
+                max_retries=1_000_000, deadline=budget)
+        except _res.RetryExhausted as e:
+            self._sock = None
+            raise PSConnectError("cannot reach %s within %.1fs: %s"
+                                 % (self._addr, budget, e.__cause__)) \
+                from e
 
     def request(self, obj, timeout: Optional[float] = None):
         """One request/response exchange.  ``timeout`` bounds the WHOLE
@@ -300,8 +399,16 @@ class _Client(object):
 # ---------------------------------------------------------------------------
 
 class Scheduler(object):
-    """Rendezvous: assigns ranks, distributes the server list, services
-    barriers, coordinates shutdown (the dmlc-tracker role)."""
+    """Rendezvous + elastic membership: assigns ranks, distributes the
+    server list, services barriers, coordinates shutdown (the
+    dmlc-tracker role).  A monitor thread DECLARES nodes dead after
+    ``MXTPU_DEAD_TIMEOUT`` seconds of heartbeat silence: dead workers
+    are removed from the group (generation bump + survivor re-rank +
+    server ``reconfig`` so in-flight sync rounds complete), dead
+    servers are reported to workers via ``dead_nodes`` (failover is
+    worker-driven).  Late registrations after the group was once full
+    are *rejoins*; ``reregister`` rebuilds membership after a scheduler
+    restart."""
 
     def __init__(self, port: Optional[int] = None):
         host, root_port = _root_addr()
@@ -316,19 +423,61 @@ class Scheduler(object):
         self._stop = False
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._servers: List[Tuple[str, int]] = []
-        self._worker_ranks = 0
-        self._barrier_count = 0
-        self._barrier_gen = 0
-        self._done = 0
-        self._threads: List[threading.Thread] = []
-        # failure detection (reference `include/mxnet/kvstore.h:346-355`
-        # get_num_dead_node + ps-lite heartbeats): node id -> last beat.
         # Node ids follow the ps-lite convention: scheduler 1, server
-        # rank r -> 8 + 2r, worker rank r -> 9 + 2r.
+        # rank r -> 8 + 2r, worker registration slot r -> 9 + 2r.  A
+        # node id is assigned once and never reused; the worker RANK is
+        # the node's position in `_worker_order` and compacts when a
+        # member dies (re-rank).
+        self._servers: Dict[int, Tuple[str, int]] = {}
+        self._next_server_rank = 0
+        self._worker_order: List[int] = []   # live worker node ids
+        self._next_worker_reg = 0
+        self._rank_hint: Dict[int, int] = {}  # node id -> last known rank
+        self._dead: set = set()
+        self._gen = 0
+        self._ever_full = False
+        self._ever_any_worker = False
+        self._done_nodes: set = set()
+        self._barrier_waiters: set = set()
+        self._barrier_gen = 0
+        self._anon_barrier = 0
+        self._dead_timeout = _dead_timeout()
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
         self._last_beat: Dict[int, float] = {}
 
+    # -- liveness / membership (all called with self._cv held) --------------
+    def _live_workers(self) -> int:
+        return len(self._worker_order)
+
+    def _rank_of(self, node_id: int) -> Optional[int]:
+        try:
+            return self._worker_order.index(node_id)
+        except ValueError:
+            return self._rank_hint.get(node_id)
+
+    def _barrier_target(self) -> int:
+        # until the configured group has been seen once, barriers wait
+        # for the static group size (classic rendezvous); after that
+        # they track live membership (elastic)
+        return self._live_workers() if self._ever_full else self._nw
+
+    def _release_barrier_locked(self) -> bool:
+        # count only members (or legacy anonymous waiters) — a zombie
+        # straggler that was declared dead must not satisfy the barrier
+        # in a live worker's place
+        valid = set(w for w in self._barrier_waiters
+                    if not isinstance(w, int) or w in self._worker_order)
+        if valid and len(valid) >= max(1, self._barrier_target()):
+            self._barrier_waiters.clear()
+            self._barrier_gen += 1
+            self._cv.notify_all()
+            return True
+        return False
+
     def run(self):
+        monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+        monitor.start()
         while True:
             try:
                 conn, _ = self._sock.accept()
@@ -337,6 +486,7 @@ class Scheduler(object):
             if self._stop:
                 conn.close()
                 break
+            self._conns.append(conn)
             t = threading.Thread(target=self._handle, args=(conn,),
                                  daemon=True)
             t.start()
@@ -346,6 +496,16 @@ class Scheduler(object):
             t.join(timeout=5)
         self._sock.close()
 
+    def _die(self):
+        """Test hook simulating SIGKILL inside one process: stop
+        accepting, sever every live connection (so clients observe a
+        dead scheduler, not a half-alive one whose old handler threads
+        still answer)."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        _sever_sockets([self._sock] + list(self._conns))
+
     def _handle(self, conn: socket.socket):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
@@ -354,28 +514,49 @@ class Scheduler(object):
                 op = msg["op"]
                 if op == "register":
                     _send_msg(conn, self._register(msg))
+                elif op == "reregister":
+                    _send_msg(conn, self._reregister(msg))
                 elif op == "heartbeat":
                     with self._cv:
-                        self._last_beat[int(msg["node_id"])] = time.time()
-                    _send_msg(conn, {"ok": True})
+                        nid = int(msg["node_id"])
+                        # a beat from a declared-dead node means it was
+                        # a straggler, not a corpse: resurrect it only
+                        # via reregister (explicit), not silently — but
+                        # TELL it, so a healthy node that blipped past
+                        # the timeout can re-establish itself instead of
+                        # carrying a stale declaration forever
+                        declared = nid in self._dead
+                        if not declared:
+                            self._last_beat[nid] = time.time()
+                    _send_msg(conn, {"ok": True,
+                                     "declared_dead": declared})
                 elif op == "dead_nodes":
-                    timeout = float(msg.get("timeout", 60.0))
+                    timeout = float(msg.get("timeout",
+                                            self._dead_timeout))
                     now = time.time()
                     with self._cv:
-                        dead = sorted(nid for nid, ts in
-                                      self._last_beat.items()
-                                      if now - ts > timeout)
+                        stale = set(nid for nid, ts in
+                                    self._last_beat.items()
+                                    if now - ts > timeout)
+                        dead = sorted(stale | self._dead)
                     _send_msg(conn, {"dead": dead})
+                elif op == "group_info":
+                    with self._cv:
+                        _send_msg(conn, self._group_info_locked())
                 elif op == "barrier":
-                    self._barrier()
-                    _send_msg(conn, {"ok": True})
+                    _send_msg(conn, self._barrier(msg))
                 elif op == "done":
                     with self._cv:
-                        self._done += 1
+                        nid = int(msg.get("node_id", -1))
                         # a cleanly-exited node is not a DEAD node —
-                        # drop it from the failure detector
-                        self._last_beat.pop(int(msg.get("node_id", -1)),
-                                            None)
+                        # drop it from the failure detector and the
+                        # live group
+                        self._last_beat.pop(nid, None)
+                        if nid in self._worker_order:
+                            self._worker_order.remove(nid)
+                            self._done_nodes.add(nid)
+                        self._barrier_waiters.discard(nid)
+                        self._release_barrier_locked()
                         self._cv.notify_all()
                     _send_msg(conn, {"ok": True})
                     if self._maybe_shutdown():
@@ -386,52 +567,203 @@ class Scheduler(object):
             pass
         finally:
             conn.close()
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
+
+    def _group_info_locked(self):
+        return {"gen": self._gen,
+                "num_workers": self._live_workers(),
+                "ranks": [[nid, r] for r, nid in
+                          enumerate(self._worker_order)],
+                "dead": sorted(self._dead)}
 
     def _register(self, msg):
+        rejoin = False
         with self._cv:
             if msg["role"] == "server":
-                self._servers.append(tuple(msg["addr"]))
-                rank = len(self._servers) - 1
+                rank = self._next_server_rank
+                self._next_server_rank += 1
+                self._servers[rank] = tuple(msg["addr"])
                 node_id = 8 + 2 * rank
                 self._cv.notify_all()
             else:
-                rank = self._worker_ranks
-                self._worker_ranks += 1
-                node_id = 9 + 2 * rank
+                reg = self._next_worker_reg
+                self._next_worker_reg += 1
+                node_id = 9 + 2 * reg
+                rejoin = self._ever_full
+                self._worker_order.append(node_id)
+                self._ever_any_worker = True
+                if self._live_workers() >= self._nw:
+                    self._ever_full = True
+                rank = self._worker_order.index(node_id)
+                self._rank_hint[node_id] = rank
+                if rejoin:
+                    # the joiner announces itself to the SERVERS via
+                    # the `join` handshake (Worker._maybe_join) at an
+                    # explicit round boundary — growing the sync-round
+                    # size here, mid-round, would strand the survivors'
+                    # in-flight per-key pushes inconsistently
+                    self._gen += 1
             self._last_beat[node_id] = time.time()
             while len(self._servers) < self._ns:
                 self._cv.wait()
-            return {"rank": rank, "servers": list(self._servers),
-                    "num_workers": self._nw, "num_servers": self._ns,
-                    "node_id": node_id}
+            servers = [self._servers[i] for i in range(self._ns)]
+            live = self._live_workers()
+            gen = self._gen
+        return {"rank": rank, "servers": servers,
+                "num_workers": self._nw, "num_servers": self._ns,
+                "node_id": node_id, "gen": gen, "rejoin": rejoin,
+                "live_workers": live}
 
-    def _barrier(self):
+    def _reregister(self, msg):
+        """A node that outlived a scheduler restart reports its saved
+        identity; rebuild membership tables from it."""
+        nid = int(msg["node_id"])
         with self._cv:
-            gen = self._barrier_gen
-            self._barrier_count += 1
-            if self._barrier_count == self._nw:
-                self._barrier_count = 0
-                self._barrier_gen += 1
-                self._cv.notify_all()
+            self._dead.discard(nid)
+            self._last_beat[nid] = time.time()
+            if msg.get("role") == "server":
+                rank = int(msg.get("rank", (nid - 8) // 2))
+                if msg.get("addr"):
+                    self._servers[rank] = tuple(msg["addr"])
+                self._next_server_rank = max(self._next_server_rank,
+                                             rank + 1)
             else:
-                while gen == self._barrier_gen:
+                self._ever_any_worker = True
+                if nid not in self._worker_order:
+                    self._worker_order.append(nid)
+                    self._rank_hint[nid] = int(msg.get("rank", 10**6))
+                    # keep rank order stable across the restart: sort
+                    # by each survivor's last known rank
+                    self._worker_order.sort(
+                        key=lambda n: (self._rank_hint.get(n, 10**6), n))
+                self._next_worker_reg = max(self._next_worker_reg,
+                                            (nid - 9) // 2 + 1)
+                if self._live_workers() >= self._nw:
+                    self._ever_full = True
+            self._cv.notify_all()
+            return {"ok": True, "gen": self._gen,
+                    "num_workers": self._live_workers()}
+
+    def _barrier(self, msg):
+        with self._cv:
+            nid = msg.get("node_id")
+            if nid is not None and nid in self._dead:
+                # a declared-dead straggler must not rendezvous with a
+                # group that re-ranked around it — fail it loudly so it
+                # can exit (or re-register as a fresh member)
+                return {"error": "node %r was declared dead "
+                                 "(MXTPU_DEAD_TIMEOUT) and the group "
+                                 "re-ranked without it" % nid,
+                        "gen": self._gen,
+                        "num_workers": self._live_workers()}
+            if nid is None:
+                self._anon_barrier += 1
+                nid = ("anon", self._anon_barrier)
+            gen = self._barrier_gen
+            self._barrier_waiters.add(nid)
+            if not self._release_barrier_locked():
+                while gen == self._barrier_gen and not self._stop:
                     self._cv.wait()
+            return {"ok": True, "gen": self._gen,
+                    "num_workers": self._live_workers(),
+                    "rank": self._rank_of(nid) if isinstance(nid, int)
+                    else None}
+
+    # -- failure detection ---------------------------------------------------
+    def _monitor_loop(self):
+        """Declare silent nodes dead and reconfigure the group."""
+        interval = min(1.0, max(0.05, self._dead_timeout / 4.0))
+        while not self._stop:
+            time.sleep(interval)
+            now = time.time()
+            worker_died = False
+            with self._cv:
+                newly = [nid for nid, ts in self._last_beat.items()
+                         if now - ts > self._dead_timeout]
+                if newly:
+                    for nid in newly:
+                        self._last_beat.pop(nid, None)
+                        self._dead.add(nid)
+                        if nid in self._worker_order:
+                            self._worker_order.remove(nid)
+                            self._barrier_waiters.discard(nid)
+                            worker_died = True
+                    if worker_died:
+                        self._gen += 1
+                        for r, n in enumerate(self._worker_order):
+                            self._rank_hint[n] = r
+                        self._release_barrier_locked()
+                    self._cv.notify_all()
+                live = self._live_workers()
+                gen = self._gen
+            if worker_died:
+                self._reconfig_servers(live, gen)
+            if newly and self._maybe_shutdown():
+                return
+
+    def _reconfig_servers(self, live: int, gen: int):
+        """Tell every live server the new sync-round size and which
+        workers were declared dead (so a zombie straggler's pushes are
+        rejected instead of corrupting a round)."""
+        with self._cv:
+            targets = [(r, a) for r, a in sorted(self._servers.items())
+                       if 8 + 2 * r not in self._dead]
+            dead_workers = sorted(n for n in self._dead if n % 2 == 1)
+        for rank, addr in targets:
+            def deliver(addr=addr):
+                c = _Client(addr, deadline=2.0)
+                try:
+                    c.request({"op": "reconfig", "num_workers": live,
+                               "gen": gen,
+                               "dead_workers": dead_workers},
+                              timeout=10.0)
+                finally:
+                    c.close()
+            try:
+                # a server that misses this message keeps waiting for a
+                # dead worker's contribution FOREVER — retry hard, and
+                # shout if it still cannot be delivered
+                _res.run_with_retry(
+                    "ps_reconfig", deliver,
+                    retry_on=(ConnectionError, OSError,
+                              KVStoreTimeoutError),
+                    max_retries=6, deadline=30.0)
+            except (_res.RetryExhausted, ConnectionError, OSError):
+                import logging
+
+                logging.getLogger(__name__).error(
+                    "scheduler: could not deliver reconfig(live=%d) to "
+                    "server rank %d at %s — sync rounds on its shards "
+                    "may stall", live, rank, addr)
 
     def _maybe_shutdown(self) -> bool:
         with self._cv:
-            if self._done < self._nw:
+            if not self._ever_any_worker or self._worker_order:
                 return False
-            servers = list(self._servers)
+            # before the configured group ever fully formed, keep the
+            # classic rendezvous contract: wait for ALL nw workers to
+            # finish — a fast first worker must not tear the job down
+            # while a slow sibling is still starting up.  Once the
+            # group was full (and possibly shrank elastically),
+            # survivor-only completion is the correct signal.
+            if not self._ever_full and len(self._done_nodes) < self._nw:
+                return False
+            servers = [(r, a) for r, a in sorted(self._servers.items())]
             # servers are being shut down deliberately below: clear
             # their liveness entries too
-            for i in range(len(servers)):
-                self._last_beat.pop(8 + 2 * i, None)
-        for addr in servers:
+            for r, _ in servers:
+                self._last_beat.pop(8 + 2 * r, None)
+        for rank, addr in servers:
+            if 8 + 2 * rank in self._dead:
+                continue
             try:
                 c = _Client(addr, retries=3)
                 c.request({"op": "shutdown"})
                 c.close()
-            except ConnectionError:
+            except (ConnectionError, OSError):
                 pass
         self._stop = True
         # unblock our own accept() so run() can return
@@ -448,7 +780,12 @@ def _heartbeat_interval() -> float:
                       "DMLC_PS_HEARTBEAT_INTERVAL", default="1.0"))
 
 
-def _start_heartbeat(node_id: int, stopped):
+class _HeartbeatStop(Exception):
+    """Internal: the owner shut down while the heartbeat thread was
+    mid-backoff; never retried, never propagated."""
+
+
+def _start_heartbeat(node_id: int, stopped, reginfo=None):
     """Daemon thread beating the scheduler every interval (ps-lite
     heartbeat analog; feeds the scheduler's dead-node detector).
 
@@ -456,19 +793,78 @@ def _start_heartbeat(node_id: int, stopped):
     is held for the full duration of blocking ops (barrier), and a
     worker waiting at a barrier must keep heartbeating — otherwise the
     detector would flag exactly the healthy stragglers it exists to
-    distinguish from crashes."""
+    distinguish from crashes.
+
+    ``reginfo`` (a zero-arg callable returning this node's persisted
+    registration: role/rank/node_id[/addr]) arms scheduler
+    recoverability: when the scheduler connection dies, the thread does
+    NOT treat it as shutdown — it reconnects under the shared
+    resilience backoff policy (budget ``MXTPU_SCHED_RECONNECT``) and
+    ``reregister``s, so a restarted scheduler rebuilds its membership
+    tables.  Without ``reginfo`` the legacy behavior remains: scheduler
+    gone means shutdown in progress."""
     interval = _heartbeat_interval()
+
+    def connect():
+        if stopped():
+            raise _HeartbeatStop
+        client = _Client(_root_addr(), deadline=max(1.0, interval))
+        if reginfo is not None:
+            info = dict(reginfo())
+            info["op"] = "reregister"
+            client.request(info)
+        return client
 
     def loop():
         try:
-            client = _Client(_root_addr())
-        except ConnectionError:
+            if reginfo is not None:
+                # establish presence via the re-registering connect even
+                # the FIRST time: registration already happened on the
+                # main client, so this is idempotent on a healthy
+                # scheduler — and it closes the race where the scheduler
+                # restarts before this thread ever connected
+                client = _res.run_with_retry(
+                    "ps_sched_reconnect", connect,
+                    retry_on=(ConnectionError, OSError),
+                    max_retries=1_000_000, deadline=_sched_reconnect())
+            else:
+                client = _Client(_root_addr())
+        except (ConnectionError, _res.RetryExhausted, _HeartbeatStop):
             return
         while not stopped():
             try:
-                client.request({"op": "heartbeat", "node_id": node_id})
+                rep = client.request({"op": "heartbeat",
+                                      "node_id": node_id})
+                if isinstance(rep, dict) and rep.get("declared_dead") \
+                        and reginfo is not None:
+                    info = dict(reginfo())
+                    if info.get("role") == "server":
+                        # a healthy SERVER declared dead during a blip
+                        # re-establishes itself, so the stale
+                        # declaration cannot arm a replica promotion
+                        # against a living primary.  A declared-dead
+                        # WORKER stays out: the group re-ranked and its
+                        # pushes are fenced — resurrection would desync
+                        # its round alignment; it exits via the typed
+                        # fence error and may rejoin as a fresh member.
+                        info["op"] = "reregister"
+                        client.request(info)
+                        _inc_stat("elastic_sched_reregister")
             except (ConnectionError, EOFError, OSError):
-                break  # scheduler gone: shutdown in progress
+                client.close()
+                if reginfo is None:
+                    break  # scheduler gone: shutdown in progress
+                try:
+                    # scheduler may be restarting: re-register with
+                    # backoff instead of silently dying with it
+                    client = _res.run_with_retry(
+                        "ps_sched_reconnect", connect,
+                        retry_on=(ConnectionError, OSError),
+                        max_retries=1_000_000,
+                        deadline=_sched_reconnect())
+                    _inc_stat("elastic_sched_reregister")
+                except (_res.RetryExhausted, _HeartbeatStop):
+                    break  # genuinely gone (or we shut down): give up
             time.sleep(interval)
         client.close()
 
@@ -484,7 +880,16 @@ def _start_heartbeat(node_id: int, stopped):
 class Server(object):
     """Holds weights; reference `KVStoreDistServer`
     (`kvstore_dist_server.h:155`): sync pushes accumulate until all
-    workers reported, then `ApplyUpdates` runs the updater once."""
+    workers reported, then `ApplyUpdates` runs the updater once.
+
+    Elastic extensions: sync pushes are keyed by (worker id, round) so
+    retries never double-accumulate; ``reconfig`` (from the scheduler)
+    shrinks/grows the round size when membership changes, completing
+    stranded rounds with a ``nw0/len(contributors)`` rescale that keeps
+    gradient averaging exact; with ``MXTPU_PS_REPLICATION=1`` every
+    applied (value, version, updater state) is chain-replicated to the
+    ring successor, which ``promote``s the mirror into its primary
+    store when this server dies."""
 
     def __init__(self, controller=None):
         # optional app-level command hook (reference: the `controller`
@@ -492,7 +897,9 @@ class Server(object):
         # built-ins); called as controller(head, body) for any head
         # other than set_optimizer
         self._controller = controller
-        self._nw = _num_workers()
+        self._nw0 = _num_workers()   # configured group size (rescale base)
+        self._nw = self._nw0         # LIVE sync-round size (reconfig'd)
+        self._gen = 0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         bind_host = _bind_host()
@@ -506,17 +913,49 @@ class Server(object):
         self._cv = threading.Condition(self._lock)
         self._store: Dict[Any, np.ndarray] = {}
         self._versions: Dict[Any, int] = {}
-        self._pending: Dict[Any, Tuple[np.ndarray, int]] = {}
+        # key -> (accumulator, set of contributing worker ids)
+        self._pending: Dict[Any, Tuple[np.ndarray, set]] = {}
+        # late joiners: worker id -> the version V it joined at; the
+        # joiner is REQUIRED only for rounds with target > V, so a
+        # mid-step join can never strand rounds the survivors already
+        # own (the round-boundary contract of `docs/elastic.md`)
+        self._join_from: Dict[Any, int] = {}
+        self._dead_wids: set = set()  # declared-dead workers (fenced)
+        self._anon_push = 0
         self._errors: Dict[Any, str] = {}
         self._updater = None
         self._shutdown = False
+        self._conns: List[socket.socket] = []
+        # chain replication (see module docstring)
+        self._replica: Dict[Any, np.ndarray] = {}
+        self._replica_versions: Dict[Any, int] = {}
+        self._replica_state: Dict[Any, Any] = {}
+        self._replica_epoch: Dict[int, int] = {}  # predecessor -> epoch
+        self._promoted: Dict[int, List[Any]] = {}
+        self._repl_queue: List[Dict[str, Any]] = []
+        self._repl_inflight = 0
+        self._repl_epoch = 0
+        self._repl_down = False
+        self._repl_lag = _repl_lag()
         # register with scheduler
         self._sched = _Client(_root_addr())
         info = self._sched.request({"op": "register", "role": "server",
                                     "addr": self._addr})
         self.rank = info["rank"]
         self.node_id = info.get("node_id", 8 + 2 * self.rank)
-        _start_heartbeat(self.node_id, lambda: self._shutdown)
+        servers = [tuple(a) for a in info.get("servers", [])]
+        ns = len(servers)
+        self._repl_on = _replication_on() and ns > 1
+        self._succ_rank = (self.rank + 1) % ns if ns else self.rank
+        self._succ_addr = servers[self._succ_rank] if self._repl_on \
+            else None
+        if self._repl_on:
+            threading.Thread(target=self._repl_loop, daemon=True).start()
+        _start_heartbeat(self.node_id, lambda: self._shutdown,
+                         reginfo=lambda: {"role": "server",
+                                          "rank": self.rank,
+                                          "node_id": self.node_id,
+                                          "addr": self._addr})
 
     def run(self):
         threads = []
@@ -525,11 +964,21 @@ class Server(object):
                 conn, _ = self._sock.accept()
             except OSError:
                 break
+            self._conns.append(conn)
             t = threading.Thread(target=self._handle, args=(conn,),
                                  daemon=True)
             t.start()
             threads.append(t)
         self._sock.close()
+        self._sched.close()
+
+    def _die(self):
+        """Test hook simulating SIGKILL inside one process: stop
+        heartbeating, refuse new connections, sever live ones."""
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+        _sever_sockets([self._sock] + list(self._conns))
         self._sched.close()
 
     def _handle(self, conn: socket.socket):
@@ -539,9 +988,11 @@ class Server(object):
                 msg = _recv_msg(conn)
                 op = msg["op"]
                 if op == "init":
-                    with self._lock:
-                        self._store[msg["key"]] = np.array(msg["value"])
-                        self._versions[msg["key"]] = 0
+                    with self._cv:
+                        key = msg["key"]
+                        self._store[key] = np.array(msg["value"])
+                        self._versions[key] = 0
+                        self._enqueue_repl_locked(key)
                     _send_msg(conn, {"ok": True})
                 elif op == "push":
                     _send_msg(conn, self._push(msg))
@@ -551,6 +1002,19 @@ class Server(object):
                     _send_msg(conn, self._pull_rows(msg))
                 elif op == "push_rows":
                     _send_msg(conn, self._push_rows(msg))
+                elif op == "version":
+                    with self._lock:
+                        _send_msg(conn, {"version":
+                                         self._versions.get(msg["key"],
+                                                            0)})
+                elif op == "reconfig":
+                    _send_msg(conn, self._reconfig(msg))
+                elif op == "join":
+                    _send_msg(conn, self._join(msg))
+                elif op == "replicate":
+                    _send_msg(conn, self._replicate(msg))
+                elif op == "promote":
+                    _send_msg(conn, self._promote(msg))
                 elif op == "command":
                     _send_msg(conn, self._command(msg))
                 elif op == "shutdown":
@@ -571,6 +1035,10 @@ class Server(object):
             pass
         finally:
             conn.close()
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
 
     def _apply(self, key, merged: np.ndarray):
         """ApplyUpdates (`kvstore_dist_server.h:346-358`): updater if
@@ -590,34 +1058,260 @@ class Server(object):
     def _apply_safe(self, key, merged: np.ndarray):
         """Apply, but never leave waiters hung: on updater failure the
         version still advances and the error is recorded so every worker
-        sees it instead of deadlocking the round."""
+        sees it instead of deadlocking the round.  Called with self._cv
+        held; mirrors the applied state to the chain successor."""
         try:
             self._apply(key, merged)
         except Exception as e:
             self._errors[key] = "server updater failed for %r: %r" % (key, e)
             self._versions[key] = self._versions.get(key, 0) + 1
+        self._enqueue_repl_locked(key)
+
+    def _required_locked(self, target: int) -> int:
+        """Contributors required to complete the round with version
+        ``target``: the live group minus joiners whose join boundary
+        is at or past this round."""
+        late = sum(1 for v in self._join_from.values() if v >= target)
+        return max(1, self._nw - late)
+
+    def _flush_pending_locked(self):
+        for key in list(self._pending):
+            # re-fetch: _complete_round_locked replicates, and that
+            # wait RELEASES the lock — a concurrent push may have
+            # completed (popped) another snapshotted round meanwhile
+            entry = self._pending.get(key)
+            if entry is None:
+                continue
+            acc, contributors = entry
+            if len(contributors) >= self._required_locked(
+                    self._versions.get(key, 0) + 1):
+                self._complete_round_locked(key, acc, contributors)
+
+    def _complete_round_locked(self, key, acc, contributors):
+        """Apply one finished sync round.  A round completed by FEWER
+        contributors than the configured group (a worker died mid-round
+        and the scheduler shrank the group) is rescaled by
+        ``nw0/len(contributors)`` so the downstream ``1/nw0`` gradient
+        averaging (Module/Trainer rescale_grad) still averages over the
+        LIVE contributors — `dist_sync` semantics stay exact under
+        membership change."""
+        self._pending.pop(key, None)
+        n = len(contributors)
+        if n and n != self._nw0:
+            acc = acc * (float(self._nw0) / n)
+        self._apply_safe(key, acc)
+        self._cv.notify_all()
 
     def _push(self, msg):
         key, value, sync = msg["key"], np.array(msg["value"]), msg["sync"]
+        wid = msg.get("worker")
+        rnd = msg.get("round")
         with self._cv:
             if key not in self._store:
                 return {"error": "key %r not initialized on server" % (key,)}
+            if wid is not None and wid in self._dead_wids:
+                # zombie fence: a straggler the scheduler declared dead
+                # must not complete a round in a live worker's place —
+                # accepting it would make the live worker's later push a
+                # "duplicate" and silently drop its gradient
+                return {"error": "worker %r was declared dead "
+                                 "(MXTPU_DEAD_TIMEOUT); re-register to "
+                                 "rejoin the group" % (wid,),
+                        "fenced": True}
             if not sync:
                 self._apply_safe(key, value)
                 self._cv.notify_all()
                 return {"version": self._versions[key],
                         "error": self._errors.get(key)}
-            acc, count = self._pending.get(key, (None, 0))
+            version = self._versions.get(key, 0)
+            target = version + 1
+            # idempotency: a retried push of an already-applied round
+            # (reply lost after apply) or of an already-counted
+            # contribution (reply lost while pending) is acknowledged
+            # without accumulating again
+            if rnd is not None and rnd <= version:
+                return {"version": version, "duplicate": True,
+                        "error": self._errors.get(key)}
+            if rnd is not None and rnd > target:
+                # a push from the FUTURE relative to this store (e.g. a
+                # failover replay onto a replica more than one round
+                # behind): accumulating it into round `target` would
+                # apply the wrong gradients — reject typed instead
+                return {"error": "push of round %d arrived at version "
+                                 "%d (target %d): the replica is too "
+                                 "far behind to replay exactly"
+                                 % (rnd, version, target),
+                        "round_gap": True}
+            acc, contributors = self._pending.get(key, (None, None))
+            if contributors is None:
+                contributors = set()
+            if wid is None:
+                self._anon_push += 1
+                wid = ("anon", self._anon_push)
+            elif wid in contributors:
+                return {"version": target, "duplicate": True,
+                        "error": self._errors.get(key)}
             acc = value if acc is None else acc + value
-            count += 1
-            target = self._versions.get(key, 0) + 1
-            if count == self._nw:
-                self._pending.pop(key, None)
-                self._apply_safe(key, acc)
-                self._cv.notify_all()
+            contributors.add(wid)
+            if len(contributors) >= self._required_locked(target):
+                self._complete_round_locked(key, acc, contributors)
             else:
-                self._pending[key] = (acc, count)
+                self._pending[key] = (acc, contributors)
             return {"version": target, "error": self._errors.get(key)}
+
+    def _reconfig(self, msg):
+        """Membership change (from the scheduler): adopt the new live
+        round size and complete any round the departed worker(s) left
+        stranded."""
+        with self._cv:
+            self._nw = max(1, int(msg["num_workers"]))
+            self._gen = int(msg.get("gen", self._gen + 1))
+            self._dead_wids.update(msg.get("dead_workers", []))
+            for wid in msg.get("dead_workers", []):
+                self._join_from.pop(wid, None)
+            self._flush_pending_locked()
+            self._cv.notify_all()
+            return {"ok": True, "num_workers": self._nw}
+
+    def _join(self, msg):
+        """A late/respawned worker joins at round boundary
+        ``from_version``: it is counted into every round AFTER that
+        version, and rounds at or before it still complete with the
+        incumbents."""
+        wid = msg.get("worker")
+        with self._cv:
+            self._join_from[wid] = int(msg.get("from_version", 0))
+            self._nw = max(self._nw, int(msg.get("num_workers",
+                                                 self._nw)))
+            self._flush_pending_locked()
+            self._cv.notify_all()
+            return {"ok": True, "num_workers": self._nw}
+
+    # -- chain replication ---------------------------------------------------
+    def _state_to_wire(self, state):
+        """Updater state (None / NDArray / nested tuple) -> wire-safe
+        numpy; None when the state is not representable."""
+        if state is None:
+            return None
+        if isinstance(state, (list, tuple)):
+            parts = [self._state_to_wire(s) for s in state]
+            return tuple(parts)
+        if hasattr(state, "asnumpy"):
+            return state.asnumpy()
+        if isinstance(state, (np.ndarray, np.generic, int, float)):
+            return np.asarray(state)
+        return None
+
+    def _state_from_wire(self, state):
+        if state is None:
+            return None
+        if isinstance(state, tuple):
+            return tuple(self._state_from_wire(s) for s in state)
+        from .context import cpu
+        from .ndarray.ndarray import NDArray
+
+        return NDArray(np.array(state), ctx=cpu())
+
+    def _enqueue_repl_locked(self, key):
+        """Mirror the just-applied (value, version, updater state) to
+        the chain successor.  Runs with self._cv held; the wait
+        RELEASES the lock, bounding primary-ahead-of-replica staleness
+        to MXTPU_PS_REPL_LAG outstanding applies without stalling the
+        server when the successor itself is down."""
+        if not self._repl_on or self._repl_down:
+            return
+        state = None
+        if self._updater is not None:
+            try:
+                state = self._state_to_wire(
+                    self._updater.states.get(key))
+            except Exception:
+                state = None
+        self._repl_epoch += 1
+        self._repl_queue.append(
+            {"op": "replicate", "key": key,
+             "value": np.array(self._store[key]),
+             "version": self._versions.get(key, 0),
+             "state": state, "epoch": self._repl_epoch,
+             "from_rank": self.rank})
+        self._cv.notify_all()
+        self._cv.wait_for(
+            lambda: self._repl_down or self._shutdown or
+            len(self._repl_queue) + self._repl_inflight <= self._repl_lag,
+            timeout=10.0)
+
+    def _repl_loop(self):
+        """Replication sender: drains the queue to the successor."""
+        client = None
+        while True:
+            with self._cv:
+                while not self._repl_queue and not self._shutdown:
+                    self._cv.wait()
+                if self._shutdown:
+                    break
+                item = self._repl_queue.pop(0)
+                self._repl_inflight = 1
+            ok = False
+            try:
+                if client is None:
+                    client = _Client(self._succ_addr, deadline=5.0)
+                client.request(item, timeout=30.0)
+                ok = True
+            except (ConnectionError, OSError, KVStoreTimeoutError):
+                if client is not None:
+                    client.close()
+                client = None
+            with self._cv:
+                self._repl_inflight = 0
+                if not ok:
+                    # single-failure model: the successor is gone (it
+                    # died, or we are the last server standing) — stop
+                    # mirroring rather than stall every apply
+                    self._repl_down = True
+                    self._repl_queue[:] = []
+                self._cv.notify_all()
+            if not ok:
+                break
+        if client is not None:
+            client.close()
+
+    def _replicate(self, msg):
+        """Receiver side: store the predecessor's mirrored shard."""
+        key = msg["key"]
+        with self._cv:
+            self._replica[key] = np.array(msg["value"])
+            self._replica_versions[key] = int(msg["version"])
+            self._replica_state[key] = msg.get("state")
+            self._replica_epoch[int(msg["from_rank"])] = \
+                int(msg.get("epoch", 0))
+            return {"ok": True, "epoch": int(msg.get("epoch", 0))}
+
+    def _promote(self, msg):
+        """Adopt the mirrored shards of a dead predecessor into the
+        primary store (idempotent; worker-driven failover).  Returns
+        the adopted (key, version) pairs so each worker can re-push any
+        round the mirror had not received."""
+        frm = int(msg.get("from_rank", -1))
+        with self._cv:
+            if frm not in self._promoted:
+                taken = []
+                for key in sorted(self._replica, key=str):
+                    self._store[key] = self._replica.pop(key)
+                    self._versions[key] = self._replica_versions.pop(key)
+                    state = self._replica_state.pop(key, None)
+                    if state is not None and self._updater is not None:
+                        try:
+                            self._updater.states[key] = \
+                                self._state_from_wire(state)
+                            self._updater.states_synced[key] = True
+                        except Exception:
+                            pass
+                    taken.append(key)
+                self._promoted[frm] = taken
+                _inc_stat("elastic_promote")
+                self._cv.notify_all()
+            return {"taken": [[k, self._versions.get(k, 0)]
+                              for k in self._promoted[frm]]}
 
     def _pull(self, msg):
         key, min_version = msg["key"], msg.get("min_version", 0)
@@ -655,7 +1349,9 @@ class Server(object):
         for a, b in spans:
             dense[a:b] = buf[ofs:ofs + (b - a)]
             ofs += b - a
-        return self._push({"key": key, "value": dense, "sync": sync})
+        return self._push({"key": key, "value": dense, "sync": sync,
+                           "worker": msg.get("worker"),
+                           "round": msg.get("round")})
 
     def _pull_rows(self, msg):
         """Row-subset pull (reference `src/kvstore/kvstore_dist.h`
@@ -724,22 +1420,174 @@ class Worker(object):
         self._sched = _Client(_root_addr())
         info = self._sched.request({"op": "register", "role": "worker"})
         self.rank = info["rank"]
-        self.num_workers = info["num_workers"]
+        self.num_workers = info["num_workers"]  # CONFIGURED size (nw0)
+        self.live_workers = info.get("live_workers", self.num_workers)
+        self.gen = info.get("gen", 0)
+        self.rejoined = bool(info.get("rejoin", False))
         self._server_addrs = info["servers"]
-        self._servers = [_Client(tuple(a)) for a in self._server_addrs]
+        # LAZY connections: a rejoiner gets the scheduler's original
+        # server list, which may include an already-failed-over dead
+        # server — eagerly dialing it would burn the whole connect
+        # deadline (and the restart budget) before the failover map
+        # ever gets a say
+        self._servers: List[Optional[_Client]] = \
+            [None] * len(self._server_addrs)
+        # elastic failover state: original shard index -> the server
+        # currently holding it (identity until a failover re-partitions
+        # the ring), plus the last pushed payload per subkey so a round
+        # the replica missed can be re-pushed exactly
+        self._smap: List[int] = list(range(len(self._servers)))
+        self._dead_servers: set = set()
+        self._inflight: Dict[Any, Dict[str, Any]] = {}
+        self._repl_on = _replication_on()
+        self._needs_join = self.rejoined
+        self._join_version = 0
         self._last_version: Dict[Any, int] = {}
         self._meta_shape: Dict[Any, Tuple] = {}
         self._bigarray = _bigarray_bound()
         self.node_id = info.get("node_id", 9 + 2 * self.rank)
         self._closed = False
-        _start_heartbeat(self.node_id, lambda: self._closed)
+        if self.rejoined:
+            _inc_stat("elastic_rejoin")
+        _start_heartbeat(self.node_id, lambda: self._closed,
+                         reginfo=lambda: {"role": "worker",
+                                          "rank": self.rank,
+                                          "node_id": self.node_id})
 
-    def num_dead_nodes(self, timeout: float = 60.0):
+    def num_dead_nodes(self, timeout: Optional[float] = None):
         """Node ids with no heartbeat within `timeout` seconds
-        (reference `include/mxnet/kvstore.h:346-355` get_num_dead_node;
-        ps-lite Postoffice::GetDeadNodes)."""
-        rep = self._sched.request({"op": "dead_nodes", "timeout": timeout})
+        (default MXTPU_DEAD_TIMEOUT; reference
+        `include/mxnet/kvstore.h:346-355` get_num_dead_node; ps-lite
+        Postoffice::GetDeadNodes).  Includes nodes the scheduler has
+        DECLARED dead."""
+        rep = self._sched.request(
+            {"op": "dead_nodes",
+             "timeout": _dead_timeout() if timeout is None else timeout})
         return list(rep.get("dead", []))
+
+    def group_info(self):
+        """Current elastic membership: ``{"gen", "num_workers",
+        "ranks", "dead"}``.  Updates this worker's cached generation,
+        rank and live count."""
+        rep = self._sched.request({"op": "group_info"})
+        self._absorb_group(rep)
+        return rep
+
+    def _absorb_group(self, rep):
+        if not isinstance(rep, dict):
+            return
+        gen = rep.get("gen")
+        if gen is not None and gen != self.gen:
+            self.gen = gen
+            _inc_stat("elastic_rerank")
+        if rep.get("num_workers") is not None:
+            self.live_workers = int(rep["num_workers"])
+        for nid, rank in rep.get("ranks", []):
+            if nid == self.node_id and rank is not None:
+                self.rank = int(rank)
+        if rep.get("rank") is not None:
+            self.rank = int(rep["rank"])
+
+    def _server_client(self, phys: int) -> _Client:
+        """Connection to server ``phys``, dialed on first use."""
+        c = self._servers[phys]
+        if c is None:
+            c = self._servers[phys] = _Client(
+                tuple(self._server_addrs[phys]))
+        return c
+
+    # -- elastic failover ----------------------------------------------------
+    def _server_request(self, sidx: int, msg, timeout=None):
+        """Request to the server currently serving original shard index
+        ``sidx``; on connection failure, drive the dead-server protocol
+        (confirm death with the scheduler, promote the replica on the
+        chain successor, re-push what the mirror missed, re-route)."""
+        for _ in range(len(self._servers) + 1):
+            phys = self._smap[sidx]
+            try:
+                return self._server_client(phys).request(msg,
+                                                         timeout=timeout)
+            except KVStoreTimeoutError:
+                raise  # server alive but wedged: the retry layer's call
+            except (ConnectionError, OSError) as err:
+                self._failover(phys, err)
+        raise ServerDiedError("no live server left for shard %d" % sidx)
+
+    def _failover(self, phys: int, err: Exception):
+        """Confirm server ``phys`` is dead (scheduler verdict), then
+        fail its shards over to the chain successor's replica — or
+        raise the typed error instead of hanging."""
+        node = 8 + 2 * phys
+        dead_timeout = _dead_timeout()
+        deadline = time.monotonic() + 2.0 * dead_timeout + 5.0
+        declared = False
+        while time.monotonic() < deadline:
+            # the ALIVE probe comes FIRST: a stale dead declaration (a
+            # healthy server that once blipped past MXTPU_DEAD_TIMEOUT)
+            # must never trigger promotion of its replica while it is
+            # demonstrably serving — that would split the shard across
+            # two primaries
+            alive = False
+            try:
+                socket.create_connection(
+                    tuple(self._server_addrs[phys]), timeout=0.2).close()
+                alive = True
+            except OSError:
+                pass
+            if alive:
+                raise err  # transient: let the caller's retry reconnect
+            try:
+                if node in self.num_dead_nodes():
+                    declared = True
+                    break
+            except (ConnectionError, OSError):
+                pass
+            time.sleep(min(0.2, dead_timeout / 4.0))
+        if not declared:
+            raise err  # not (yet) dead: surface the transport error
+        self._dead_servers.add(phys)
+        if not self._repl_on:
+            raise ServerDiedError(
+                "server rank %d (node %d) is dead and MXTPU_PS_REPLICATION"
+                " is off — no replica to fail over to" % (phys, node))
+        ns = len(self._servers)
+        succ = (phys + 1) % ns
+        while succ in self._dead_servers:
+            if succ == phys:
+                raise ServerDiedError("every server in the ring is dead")
+            succ = (succ + 1) % ns
+        rep = self._server_client(succ).request({"op": "promote",
+                                                 "from_rank": phys})
+        taken = rep.get("taken") or []
+        _inc_stat("elastic_failover")
+        # re-push any round the mirror had not received: per subkey the
+        # replica can only be ONE round behind with the default
+        # MXTPU_PS_REPL_LAG=1, and we kept exactly that round's payload
+        # — a wider gap (lag raised past the single payload we retain)
+        # cannot be replayed exactly and aborts typed instead of
+        # corrupting the round
+        for pair in taken:
+            sub, ver = pair[0], int(pair[1])
+            sub = tuple(sub) if isinstance(sub, list) else sub
+            if self._last_version.get(sub, 0) > ver:
+                saved = self._inflight.get(sub)
+                if saved is None:
+                    raise ServerDiedError(
+                        "shard %r lost: replica is at round %d but this "
+                        "worker already completed round %d and has no "
+                        "payload to replay" %
+                        (sub, ver, self._last_version[sub]))
+                rep2 = self._server_client(succ).request(dict(saved))
+                if rep2.get("round_gap") or rep2.get("error"):
+                    raise ServerDiedError(
+                        "shard %r unrecoverable after failover: %s "
+                        "(replica staleness exceeded the retained "
+                        "replay window — keep MXTPU_PS_REPL_LAG=1 for "
+                        "exact failover)" % (sub, rep2.get("error")))
+                _inc_stat("elastic_repush")
+        for i, p in enumerate(self._smap):
+            if p == phys:
+                self._smap[i] = succ
 
     def register_meta(self, key, shape, dtype):
         """Record a key's shape/dtype without initializing it on the
@@ -762,22 +1610,72 @@ class Worker(object):
                 out.append(((home + i) % ns, (key, i), lo, hi))
         return out
 
+    def _maybe_join(self, key):
+        """First data op of a REJOINED worker: pick the join round
+        boundary (the current version of ``key`` — the first key the
+        training loop touches, which sync ordering keeps >= every
+        other key's version) and announce it to every server.  Rounds
+        at or before the boundary complete with the incumbents; this
+        worker is required from the next round on, and its sync pulls
+        wait for the boundary so its first forward never sees a
+        mixed-version parameter set."""
+        if not self._needs_join:
+            return
+        self._needs_join = False  # before the requests: they recurse here
+        self._join_version = self.key_version(key)
+        for phys in sorted(set(self._smap)):
+            self._server_client(phys).request(
+                {"op": "join", "worker": self.node_id,
+                 "from_version": self._join_version,
+                 "num_workers": self.live_workers})
+        _inc_stat("elastic_join_sync")
+
     # -- API ----------------------------------------------------------------
     def init(self, key, value: np.ndarray):
         flat = np.ascontiguousarray(value).reshape(-1)
         self._meta_shape[key] = (value.shape, value.dtype)
         for sidx, subkey, lo, hi in self._chunks(key, flat.size):
-            self._servers[sidx].request({"op": "init", "key": subkey,
-                                         "value": flat[lo:hi]})
+            self._server_request(sidx, {"op": "init", "key": subkey,
+                                        "value": flat[lo:hi]})
+
+    def key_version(self, key) -> int:
+        """Highest applied sync-round version of ``key`` on its
+        servers.  A rejoining worker uses this to resume at the group's
+        current step (each completed `dist_sync` round bumps the
+        version by one)."""
+        shape, _ = self._meta_shape[key]
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        vmax = 0
+        for sidx, subkey, lo, hi in self._chunks(key, size):
+            rep = self._server_request(sidx, {"op": "version",
+                                              "key": subkey})
+            vmax = max(vmax, int(rep.get("version", 0)))
+        return vmax
 
     def push(self, key, value: np.ndarray, sync: bool = True,
              timeout: Optional[float] = None):
         flat = np.ascontiguousarray(value).reshape(-1)
         self._meta_shape.setdefault(key, (value.shape, value.dtype))
+        if sync:
+            self._maybe_join(key)
         for sidx, subkey, lo, hi in self._chunks(key, flat.size):
-            rep = self._servers[sidx].request(
-                {"op": "push", "key": subkey, "value": flat[lo:hi],
-                 "sync": sync}, timeout=timeout)
+            msg = {"op": "push", "key": subkey, "value": flat[lo:hi],
+                   "sync": sync, "worker": self.node_id}
+            if sync:
+                msg["round"] = max(self._last_version.get(subkey, 0),
+                                   self._join_version) + 1
+            if self._repl_on and sync:
+                # retain this round's payload: the failover protocol
+                # replays it when the replica is one round behind
+                saved = dict(msg)
+                saved["value"] = np.array(flat[lo:hi])
+                self._inflight[subkey] = saved
+            rep = self._server_request(sidx, msg, timeout=timeout)
+            if rep.get("fenced"):
+                # non-retryable: we were declared dead and the group
+                # re-ranked; retrying can never be accepted
+                raise ServerDiedError("push of %r rejected: %s"
+                                      % (key, rep["error"]))
             if rep.get("error"):
                 raise ConnectionError("push of %r failed: %s"
                                       % (key, rep["error"]))
@@ -788,11 +1686,19 @@ class Worker(object):
         shape, dtype = self._meta_shape[key]
         size = int(np.prod(shape, dtype=np.int64)) if shape else 1
         flat = np.empty(size, dtype=dtype)
+        straggler = _straggler_sec()
+        if sync:
+            self._maybe_join(key)
         for sidx, subkey, lo, hi in self._chunks(key, size):
-            rep = self._servers[sidx].request(
-                {"op": "pull", "key": subkey,
-                 "min_version": self._last_version.get(subkey, 0)
-                 if sync else 0}, timeout=timeout)
+            t0 = time.monotonic()
+            rep = self._server_request(
+                sidx, {"op": "pull", "key": subkey,
+                       "min_version":
+                       max(self._last_version.get(subkey, 0),
+                           self._join_version) if sync else 0},
+                timeout=timeout)
+            if time.monotonic() - t0 > straggler:
+                _inc_stat("elastic_straggler_waits")
             if rep.get("value") is None:
                 raise ConnectionError(
                     "pull of %r failed: %s" % (key, rep.get(
@@ -826,11 +1732,13 @@ class Worker(object):
                     fills.append((j, ia - a, ib - a))
             if not spans:
                 continue
-            rep = self._servers[sidx].request(
-                {"op": "pull_rows", "key": subkey,
-                 "spans": np.asarray(spans, np.int64),
-                 "min_version": self._last_version.get(subkey, 0)
-                 if sync else 0}, timeout=timeout)
+            rep = self._server_request(
+                sidx, {"op": "pull_rows", "key": subkey,
+                       "spans": np.asarray(spans, np.int64),
+                       "min_version":
+                       max(self._last_version.get(subkey, 0),
+                           self._join_version) if sync else 0},
+                timeout=timeout)
             if rep.get("value") is None:
                 raise ConnectionError(
                     "pull_rows of %r failed: %s" % (key, rep.get(
@@ -864,21 +1772,36 @@ class Worker(object):
                     parts.append(flat[j, ia - a:ib - a])
             value = np.concatenate(parts) if parts \
                 else np.zeros((0,), dtype)
-            rep = self._servers[sidx].request(
-                {"op": "push_rows", "key": subkey,
-                 "spans": np.asarray(spans, np.int64).reshape(-1, 2),
-                 "value": value, "sync": sync}, timeout=timeout)
+            msg = {"op": "push_rows", "key": subkey,
+                   "spans": np.asarray(spans, np.int64).reshape(-1, 2),
+                   "value": value, "sync": sync, "worker": self.node_id}
+            if sync:
+                msg["round"] = max(self._last_version.get(subkey, 0),
+                                   self._join_version) + 1
+            if self._repl_on and sync:
+                self._inflight[subkey] = dict(msg)
+            rep = self._server_request(sidx, msg, timeout=timeout)
+            if rep.get("fenced"):
+                raise ServerDiedError("push_rows of %r rejected: %s"
+                                      % (key, rep["error"]))
             if rep.get("error"):
                 raise ConnectionError("push_rows of %r failed: %s"
                                       % (key, rep["error"]))
             self._last_version[subkey] = rep["version"]
 
     def barrier(self):
-        self._sched.request({"op": "barrier"})
+        rep = self._sched.request({"op": "barrier",
+                                   "node_id": self.node_id})
+        self._absorb_group(rep)
+        if isinstance(rep, dict) and rep.get("error"):
+            # we were declared dead and the group moved on: loud exit
+            # beats silently desynchronizing every future barrier
+            raise ServerDiedError(rep["error"])
 
     def send_command(self, head: str, body):
-        for s in self._servers:
-            rep = s.request({"op": "command", "head": head, "body": body})
+        for phys in sorted(set(self._smap)):
+            rep = self._server_client(phys).request(
+                {"op": "command", "head": head, "body": body})
             if rep.get("error"):
                 raise ConnectionError("command %r rejected: %s"
                                       % (head, rep["error"]))
@@ -890,7 +1813,8 @@ class Worker(object):
         except ConnectionError:
             pass
         for s in self._servers:
-            s.close()
+            if s is not None:
+                s.close()
         self._sched.close()
         Worker._singleton = None
 

@@ -18,6 +18,8 @@ __all__ = [
     "MXNetError",
     "MXTPUError",
     "KVStoreTimeoutError",
+    "PSConnectError",
+    "ServerDiedError",
     "string_types",
     "numeric_types",
     "integer_types",
@@ -46,6 +48,22 @@ class KVStoreTimeoutError(MXNetError, TimeoutError):
     """A kvstore push/pull got no server response within
     MXTPU_KVSTORE_TIMEOUT.  Subclasses TimeoutError so the resilience
     retry layer treats it as transient."""
+
+
+class PSConnectError(MXNetError, ConnectionError):
+    """The PS transport could not reach a peer within its
+    backoff+deadline budget (``mxtpu/_ps.py`` `_Client._connect`).
+    Subclasses ConnectionError so existing transient-failure handling
+    (retry/failover) still recognizes it."""
+
+
+class ServerDiedError(MXNetError):
+    """A parameter server was declared dead and no replica can take
+    over (``MXTPU_PS_REPLICATION=0``, the replica chain is exhausted,
+    or a shard was never mirrored).  Deliberately NOT an OSError
+    subclass: retrying cannot fix a dead server without a replica, so
+    the resilience layer propagates this immediately instead of
+    spinning until the retry deadline."""
 
 string_types = (str,)
 numeric_types = (float, int, np.generic)

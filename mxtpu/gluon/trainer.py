@@ -97,6 +97,20 @@ class Trainer(object):
         self._kv_initialized = True
 
     @property
+    def live_workers(self):
+        """Workers currently alive in the distributed group (elastic
+        membership, `docs/elastic.md`); 1 without a kvstore.  The
+        gradient-averaging contract needs NO adjustment when this
+        drops: `dist_sync` rounds completed by fewer workers are
+        rescaled server-side by ``nw0/live``, so the fixed
+        ``rescale_grad = 1/batch`` here keeps averaging exact over the
+        survivors."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        return self._kvstore.live_workers if self._kvstore is not None \
+            else 1
+
+    @property
     def learning_rate(self):
         return self._optimizer.lr if self._optimizer.lr_scheduler is None \
             else self._optimizer.lr_scheduler(self._optimizer.num_update)

@@ -294,6 +294,13 @@ class KVStore(object):
     def num_workers(self):
         return 1
 
+    @property
+    def live_workers(self):
+        """Workers currently alive in the group (elastic membership —
+        see `docs/elastic.md`).  Equals :attr:`num_workers` for
+        non-distributed stores."""
+        return self.num_workers
+
     def barrier(self):
         pass
 
@@ -444,20 +451,55 @@ class KVStoreDist(KVStoreDevice):
 
     @property
     def num_workers(self):
+        """CONFIGURED group size (nw0).  Deliberately static under
+        elastic membership: gradient averaging stays scaled by nw0
+        (Module/Trainer rescale_grad) while the server rescales short
+        rounds by ``nw0/live`` — so `dist_sync` means "average over the
+        live workers" at every group size.  See :attr:`live_workers`."""
         return self._worker.num_workers
+
+    @property
+    def live_workers(self):
+        """Workers currently alive per the scheduler's dead-node
+        detector (elastic membership, `docs/elastic.md`)."""
+        try:
+            return int(self._worker.group_info().get(
+                "num_workers", self._worker.live_workers))
+        except (ConnectionError, OSError):
+            return self._worker.live_workers
+
+    @property
+    def rejoined(self):
+        """True when this worker re-registered into a group that was
+        already running (a respawned/late-joining elastic worker): it
+        must pull current weights and resume at
+        :meth:`current_version` instead of training from step 0."""
+        return self._worker.rejoined
+
+    def current_version(self, key):
+        """Applied sync-round count of ``key`` on its servers — the
+        group's current training step for elastic resume."""
+        return self._worker.key_version(key)
 
     def init(self, key, value):
         keys, values = _group_kv(key, value)
+        rejoined = self._worker.rejoined
         for k, vals in zip(keys, values):
             if k in self._store:
                 raise MXNetError("key %r already initialized" % (k,))
             self._store[k] = vals[0].copy()
-            if self._worker.rank == 0:
+            if self._worker.rank == 0 and not rejoined:
                 self._worker.init(k, vals[0].asnumpy())
             else:
+                # non-root ranks AND rejoining workers must not reset
+                # server state — the weights (and their round versions)
+                # already live there
                 self._worker.register_meta(k, vals[0].shape,
                                            vals[0].dtype)
-        self._worker.barrier()
+        if not rejoined:
+            # a rejoiner must not barrier: the running group is not at
+            # a rendezvous point
+            self._worker.barrier()
 
     def push(self, key, value, priority=0):
         from .ndarray.sparse import RowSparseNDArray, add as _sp_add
@@ -539,6 +581,9 @@ class KVStoreDist(KVStoreDevice):
         # reference: optimizer is serialized to the servers and runs there
         # (`python/mxnet/kvstore.py set_optimizer` → SendCommandToServers)
         self._optimizer = optimizer
+        if self._worker.rejoined:
+            return  # servers already run the updater; group isn't at a
+            # rendezvous point, so neither command nor barrier
         if self._worker.rank == 0:
             self._worker.send_command("set_optimizer",
                                       pickle.dumps(optimizer))
@@ -550,12 +595,15 @@ class KVStoreDist(KVStoreDevice):
     def send_command_to_servers(self, head, body):
         self._worker.send_command(head, body)
 
-    def num_dead_node(self, node_id=6, timeout=60):
+    def num_dead_node(self, node_id=6, timeout=None):
         """Count nodes with no heartbeat within `timeout` seconds
-        (reference `include/mxnet/kvstore.h:346-355` get_num_dead_node).
+        (default ``MXTPU_DEAD_TIMEOUT``; reference
+        `include/mxnet/kvstore.h:346-355` get_num_dead_node).
         `node_id` is the ps-lite group mask: 2 servers | 4 workers
-        (default: both).  Scheduler liveness is not tracked — a dead
-        scheduler surfaces as a ConnectionError from this very query."""
+        (default: both).  Nodes the scheduler has DECLARED dead (and
+        re-ranked around) are always counted.  Scheduler liveness is
+        not tracked — a dead scheduler surfaces as a ConnectionError
+        from this very query."""
         count = 0
         for nid in self._worker.num_dead_nodes(timeout):
             group = 2 if nid % 2 == 0 else 4  # servers 8+2r, workers 9+2r

@@ -8,6 +8,8 @@ finishes.  Launched by `tools/launch.py`.
 """
 from __future__ import annotations
 
+import os
+
 from . import _ps
 
 __all__ = ["KVStoreServer", "init_module"]
@@ -37,4 +39,10 @@ def init_module():
     role = _ps.role_from_env()
     if role in ("server", "scheduler"):
         KVStoreServer().run()
-        raise SystemExit(0)
+        # hard exit, ps-lite style: the role's work is DONE when run()
+        # returns, but interpreter/native teardown with live daemon
+        # threads (XLA/PJRT pools used by the server-side updater) can
+        # abort ("terminate called without an active exception"),
+        # turning a clean shutdown into a nonzero exit that the
+        # failure-honest launcher would flag
+        os._exit(0)

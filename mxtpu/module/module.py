@@ -242,6 +242,17 @@ class Module(BaseModule):
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
+        """Install the optimizer, routing updates through the kvstore
+        when one is configured (reference `module.py` init_optimizer).
+
+        `dist_sync` scales ``rescale_grad`` by the CONFIGURED worker
+        count (``kvstore.num_workers``) and deliberately keeps it there
+        under elastic membership: when a worker dies, sync rounds
+        completed by the survivors are rescaled server-side by
+        ``nw0/live`` (`docs/elastic.md`), so gradient averaging stays
+        exact without rebinding or touching the optimizer — and a
+        rejoining worker (``kvstore.rejoined``) slots back in with the
+        identical rescale."""
         if not (self.binded and self.params_initialized):
             raise MXNetError("bind() and init_params() first")
         if self.optimizer_initialized and not force_init:

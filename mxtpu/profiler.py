@@ -118,7 +118,14 @@ def record_span(name: str, cat: str, ts_us: float, dur_us: float,
 # every new shape signature, *_hit on reuse, *_aot_hit when a warmed
 # executable serves the call, *_bucket_pad when a ragged batch was
 # padded into an existing bucket.  tools/check_retrace.py gates CI on
-# them.
+# them.  The resilience layer ticks retry_*/fault_injected::<site>
+# (mxtpu/resilience.py) and the elastic PS layer ticks elastic_*:
+# elastic_failover / elastic_repush / elastic_promote (server shard
+# failover), elastic_rerank (membership generation observed),
+# elastic_rejoin (this worker re-registered into a running group),
+# elastic_straggler_waits (a sync pull blocked > MXTPU_STRAGGLER_SEC),
+# elastic_sched_reregister (heartbeat survived a scheduler restart).
+# tools/check_elastic.py gates CI on the failover path.
 
 _STATS: Dict[str, int] = {}
 

@@ -8,7 +8,12 @@ blow-up (non-finite grads), and a preemption (SIGTERM / SIGKILL).
 This module owns the shared machinery; the call sites live in
 ``kvstore.py``, ``_ps.py``, ``gluon/data/dataloader.py``, ``model.py``,
 ``module/module.py``, ``gluon/trainer.py``, ``fused_train.py``,
-``executor.py``/``cached_op.py`` and ``compile_cache.py``.
+``executor.py``/``cached_op.py`` and ``compile_cache.py``.  The
+elastic PS layer (``_ps.py``, `docs/elastic.md`) reuses
+:func:`run_with_retry` for transport connects (``ps_connect`` —
+exponential backoff + deadline, typed ``PSConnectError`` on
+exhaustion) and for re-registering with a restarted scheduler
+(``ps_sched_reconnect`` under the ``MXTPU_SCHED_RECONNECT`` budget).
 
 Four layers:
 

@@ -41,6 +41,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long multi-process guards excluded from tier-1 "
+        "(-m 'not slow'), e.g. the full elastic chaos gauntlet")
+
+
 def pytest_collection_modifyitems(config, items):
     """When the virtual 8-device mesh could not be materialized (e.g. a
     JAX build that honors neither jax_num_cpu_devices nor the late

@@ -65,6 +65,73 @@ def test_check_resilience_guard():
     assert "check_resilience OK" in out
 
 
+def test_check_elastic_smoke_guard():
+    """tools/check_elastic.py --smoke: a real multi-process dist_sync
+    run survives a SIGKILLed worker — the scheduler re-ranks, the
+    stranded sync round completes with the nw0/live rescale, rank 0's
+    loss trajectory matches the fault-free run within 1e-5, and the
+    launcher honestly exits nonzero for the dead child (see
+    mxtpu/_ps.py, docs/elastic.md)."""
+    out = _run(["tools/check_elastic.py", "--smoke"], timeout=420)
+    assert "check_elastic OK" in out
+
+
+@pytest.mark.slow
+def test_check_elastic_full_guard():
+    """Full chaos gauntlet: SIGKILL one worker (respawned by
+    launch.py --restart-workers -> rejoins and resumes at the group's
+    round) AND one server (workers fail over to the chain replica)
+    with MXTPU_PS_REPLICATION=1 — trajectory must match the clean run;
+    with replication off the same kill must abort with the typed
+    ServerDiedError, never a hang."""
+    out = _run(["tools/check_elastic.py"], timeout=560)
+    assert "check_elastic OK" in out
+
+
+def test_launch_propagates_child_exit(tmp_path):
+    """Satellite: a nonzero worker exit must surface as a nonzero
+    launcher exit (silent child death looked like success before)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, "tools/launch.py", "-n", "1", "-s", "0",
+         sys.executable, "-c", "import sys; sys.exit(7)"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert r.returncode == 7, (r.returncode, r.stdout, r.stderr)
+
+
+def test_launch_restart_workers(tmp_path):
+    """Satellite: --restart-workers N respawns a dead worker; a worker
+    that fails once and succeeds on the respawn makes the whole launch
+    succeed."""
+    marker = tmp_path / "attempted"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        "p = %r\n"
+        "if os.path.exists(p):\n"
+        "    sys.exit(0)\n"
+        "open(p, 'w').close()\n"
+        "sys.exit(1)\n" % str(marker))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    base = [sys.executable, "tools/launch.py", "-n", "1", "-s", "0"]
+    r = subprocess.run(base + ["--restart-workers", "1",
+                               sys.executable, str(script)],
+                       capture_output=True, text=True, env=env, cwd=REPO,
+                       timeout=120)
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    assert "respawning" in r.stderr
+    # without the budget the same failure propagates
+    marker.unlink()
+    r = subprocess.run(base + [sys.executable, str(script)],
+                       capture_output=True, text=True, env=env, cwd=REPO,
+                       timeout=120)
+    assert r.returncode == 1
+
+
 def test_parse_log(tmp_path):
     log = tmp_path / "train.log"
     log.write_text(

@@ -1,0 +1,388 @@
+#!/usr/bin/env python
+"""Elastic-membership guard: dist_sync training must survive node death.
+
+Drives REAL multi-process `dist_sync` runs (tools/launch.py: 1
+scheduler + 2 servers + 2 workers) through the elastic failure
+gauntlet (`docs/elastic.md`) and fails (rc=1) unless recovery is
+trajectory-honest:
+
+  1. a CLEAN run records rank-0's per-step losses and final params;
+  2. the SAME run repeats with ``MXTPU_PS_REPLICATION=1`` while worker
+     rank 1 SIGKILLs itself mid-round (stranding a sync round) and —
+     full mode — the parent SIGKILLs one server mid-run.  The
+     survivors must finish with losses and params matching the clean
+     run within 1e-5: the scheduler's dead-node detector
+     (``MXTPU_DEAD_TIMEOUT``) re-ranks the group, the server completes
+     the stranded round with an ``nw0/live`` rescale, and workers fail
+     the dead server's shards over to the chain replica;
+  3. full mode: the killed worker is respawned by
+     ``launch.py --restart-workers`` and must REJOIN — re-register,
+     pull current weights, resume at the group's round
+     (``kv.current_version``) — before the final barrier;
+  4. rank-0's ``profiler.stats()`` must show the ``elastic_*``
+     counters ticking (re-rank observed; full mode: server failover);
+  5. full mode: with ``MXTPU_PS_REPLICATION=0`` the same server kill
+     must ABORT the run with the typed ``ServerDiedError`` — promptly,
+     never a hang.
+
+``--smoke`` (CI tier-1, non-slow): kill-one-worker only, 10 steps —
+the launcher must honestly exit nonzero for the killed worker while
+rank 0 still converges to the clean trajectory.
+
+Usage: python tools/check_elastic.py [--smoke] [--steps N]
+"""
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+# ---------------------------------------------------------------------------
+# child: one dist_sync training worker (run under tools/launch.py)
+# ---------------------------------------------------------------------------
+
+def run_worker(args):
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1)  # kill -USR1 <pid> = stacks
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import profiler
+    from mxtpu.io.io import DataBatch
+
+    kv = mx.kv.create("dist_sync")
+    orig_rank = kv.rank
+    rejoined = kv.rejoined
+    if rejoined and args.marker:
+        with open(args.marker, "w") as f:
+            f.write("rejoined rank=%d\n" % orig_rank)
+
+    mx.random.seed(11)
+    x = mx.sym.Variable("data")
+    y = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(x, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(h, label=y, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Uniform(0.1))
+    # updater-on-server with momentum: exercises replicated optimizer
+    # state, not just replicated weights
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+
+    start = 0
+    if rejoined:
+        # resume at the group's current round: each completed
+        # dist_sync round bumped the key version by one
+        start = kv.current_version(mod._exec_group.param_names[0])
+
+    # every worker computes the SAME per-step batch (shared seed), so
+    # gradient AVERAGING is invariant to how many workers contribute a
+    # round — that is what makes the chaos trajectory comparable to the
+    # clean one at 1e-5
+    rng = np.random.RandomState(0)
+    data = [(rng.rand(4, 10).astype("float32"),
+             rng.randint(0, 3, (4,)).astype("float32"))
+            for _ in range(args.steps)]
+
+    losses = []
+    for i in range(start, args.steps):
+        xb, yb = data[i]
+        mod.forward(DataBatch(data=[mx.nd.array(xb)],
+                              label=[mx.nd.array(yb)]), is_train=True)
+        prob = mod.get_outputs()[0].asnumpy()
+        loss = float(-np.log(np.clip(
+            prob[np.arange(len(yb)), yb.astype(int)], 1e-12, None)).mean())
+        mod.backward()
+        if args.kill_step and orig_rank == args.kill_rank and \
+                not rejoined and i + 1 == args.kill_step:
+            # die MID-ROUND: this worker contributed nothing to round
+            # i+1, stranding the survivors' pushes until the scheduler
+            # declares us dead and reconfigures the group
+            os.kill(os.getpid(), signal.SIGKILL)
+        mod.update()
+        if orig_rank == 0:
+            losses.append(loss)
+            if args.progress:
+                with open(args.progress, "w") as f:
+                    f.write(str(i + 1))
+        if args.step_sleep:
+            time.sleep(args.step_sleep)
+
+    if args.wait_rejoin and orig_rank == 0:
+        # hold the final rendezvous until the respawned worker has
+        # rejoined (or a generous deadline passes — the parent asserts
+        # the rejoin marker either way)
+        deadline = time.time() + 90
+        while kv.live_workers < 2 and time.time() < deadline:
+            time.sleep(0.2)
+    kv.barrier()
+    if orig_rank == 0:
+        kv.live_workers  # absorb the final generation into the stats
+        params = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+        np.savez(args.out, **params)
+        with open(args.losses, "w") as f:
+            json.dump(losses, f)
+        with open(args.stats, "w") as f:
+            json.dump(profiler.stats(), f)
+    kv.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestration + assertions
+# ---------------------------------------------------------------------------
+
+BASE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "MXTPU_PS_HEARTBEAT_INTERVAL": "0.2",
+    "MXTPU_DEAD_TIMEOUT": "1.5",
+}
+
+
+def _launch(workdir, tag, steps, env_extra=None, kill_step=0,
+            restart=0, allow_server_failures=0, step_sleep=0.0,
+            wait_rejoin=False, timeout=300):
+    d = os.path.join(workdir, tag)
+    os.makedirs(d, exist_ok=True)
+    out = {k: os.path.join(d, k) for k in
+           ("params.npz", "losses.json", "stats.json", "progress",
+            "marker", "pids")}
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(BASE_ENV)
+    env.update(env_extra or {})
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "-s", "2", "--pid-dir", out["pids"],
+           "--restart-workers", str(restart),
+           "--allow-server-failures", str(allow_server_failures),
+           sys.executable, os.path.abspath(__file__),
+           "--child", "worker", "--steps", str(steps),
+           "--kill-step", str(kill_step), "--kill-rank", "1",
+           "--out", out["params.npz"], "--losses", out["losses.json"],
+           "--stats", out["stats.json"], "--progress", out["progress"],
+           "--marker", out["marker"],
+           "--step-sleep", str(step_sleep)]
+    if wait_rejoin:
+        cmd.append("--wait-rejoin")
+    # own session: on a hang we must SIGKILL the whole tree, and the
+    # grandchildren (workers/servers) must not keep the output pipe —
+    # and thus communicate() — open after launch.py dies
+    logf = open(os.path.join(d, "log"), "wb")
+    proc = subprocess.Popen(cmd, env=env, stdout=logf,
+                            stderr=subprocess.STDOUT,
+                            start_new_session=True)
+    proc._elastic_log = logf
+    out["log"] = logf.name
+    return proc, out
+
+
+def _kill_server_at(outpaths, progress_target, result):
+    """Watch rank-0 progress; SIGKILL one server once it is reached."""
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        try:
+            if int(open(outpaths["progress"]).read() or 0) >= \
+                    progress_target:
+                break
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    try:
+        pid = int(open(os.path.join(outpaths["pids"],
+                                    "server-0.pid")).read())
+        os.kill(pid, signal.SIGKILL)
+        result.append(pid)
+    except (OSError, ValueError):
+        pass
+
+
+def _wait(proc, timeout):
+    hung = False
+    try:
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        hung = True
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
+        proc.wait()
+    proc._elastic_log.close()
+    text = open(proc._elastic_log.name, "rb").read().decode(
+        errors="replace")
+    return (None if hung else proc.returncode), text
+
+
+def _check_parity(workdir, failures, clean, chaos, what):
+    import numpy as np
+
+    a = json.load(open(clean["losses.json"]))
+    b = json.load(open(chaos["losses.json"]))
+    if len(a) != len(b):
+        failures.append("%s: loss trajectory length %d != clean %d"
+                        % (what, len(b), len(a)))
+    else:
+        d = float(np.abs(np.array(a) - np.array(b)).max())
+        if d > 1e-5:
+            failures.append("%s: loss trajectory diverged (max |d|=%g)"
+                            % (what, d))
+        else:
+            print("%s: %d-step loss trajectory matches clean run "
+                  "(max |d|=%g)" % (what, len(a), d))
+    pa = np.load(clean["params.npz"])
+    pb = np.load(chaos["params.npz"])
+    for k in pa.files:
+        if not np.allclose(pa[k], pb[k], atol=1e-5):
+            failures.append("%s: param %r diverged (max |d|=%g)"
+                            % (what, k,
+                               float(np.abs(pa[k] - pb[k]).max())))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="kill-one-worker only (fast, CI tier-1)")
+    ap.add_argument("--child", choices=["worker"])
+    ap.add_argument("--kill-step", type=int, default=0)
+    ap.add_argument("--kill-rank", type=int, default=1)
+    ap.add_argument("--step-sleep", type=float, default=0.0)
+    ap.add_argument("--wait-rejoin", action="store_true")
+    ap.add_argument("--out")
+    ap.add_argument("--losses")
+    ap.add_argument("--stats")
+    ap.add_argument("--progress")
+    ap.add_argument("--marker")
+    args = ap.parse_args()
+    if args.child == "worker":
+        return run_worker(args)
+
+    steps = args.steps or (10 if args.smoke else 30)
+    workdir = tempfile.mkdtemp(prefix="mxtpu_elastic_")
+    failures = []
+    repl = {"MXTPU_PS_REPLICATION": "1"}
+
+    # 1. clean reference run (replication on, nobody dies)
+    proc, clean = _launch(workdir, "clean", steps, env_extra=repl,
+                          step_sleep=0.05)
+    rc, text = _wait(proc, 300)
+    if rc != 0:
+        print(text)
+        print("FAIL: clean run rc=%r" % rc)
+        return 1
+
+    if args.smoke:
+        # 2. SIGKILL worker rank 1 mid-round; no restart: survivors
+        # must converge AND the launcher must honestly exit nonzero
+        proc, chaos = _launch(workdir, "killworker", steps,
+                              env_extra=repl, kill_step=max(2, steps // 3),
+                              step_sleep=0.3)
+        rc, text = _wait(proc, 300)
+        if rc is None:
+            print(text)
+            failures.append("kill-worker run HUNG")
+        elif rc == 0:
+            failures.append("launcher exited 0 despite a SIGKILLed "
+                            "worker (silent child death)")
+        if rc is not None:
+            if not os.path.exists(chaos["params.npz"]):
+                print(text)
+                failures.append("rank 0 never finished after worker kill")
+            else:
+                _check_parity(workdir, failures, clean, chaos,
+                              "kill-worker")
+                stats = json.load(open(chaos["stats.json"]))
+                if not stats.get("elastic_rerank"):
+                    failures.append("elastic_rerank never ticked: %s"
+                                    % stats)
+    else:
+        # 2. full chaos: worker rank 1 SIGKILLs itself mid-round (and
+        # is respawned -> rejoin), parent SIGKILLs one server mid-run;
+        # replication failover + re-rank must keep the trajectory exact
+        proc, chaos = _launch(workdir, "chaos", steps, env_extra=repl,
+                              kill_step=2, restart=1,
+                              allow_server_failures=1, step_sleep=0.25,
+                              wait_rejoin=True)
+        killed = []
+        t = threading.Thread(target=_kill_server_at,
+                             args=(chaos, max(8, steps // 3), killed),
+                             daemon=True)
+        t.start()
+        rc, text = _wait(proc, 420)
+        if rc is None:
+            print(text)
+            failures.append("chaos run HUNG")
+        elif rc != 0:
+            print(text)
+            failures.append("chaos run rc=%d" % rc)
+        else:
+            if not killed:
+                failures.append("server was never SIGKILLed (progress "
+                                "watcher missed)")
+            if not os.path.exists(chaos["marker"]):
+                failures.append("respawned worker never rejoined "
+                                "(marker missing)")
+            _check_parity(workdir, failures, clean, chaos, "chaos")
+            stats = json.load(open(chaos["stats.json"]))
+            for key in ("elastic_rerank", "elastic_failover"):
+                if not stats.get(key):
+                    failures.append("%s never ticked: %s" % (key, stats))
+
+        # 3. replication OFF: the same server kill must abort with the
+        # typed error — promptly, not a hang
+        proc, off = _launch(workdir, "noreplica", steps,
+                            env_extra={"MXTPU_PS_REPLICATION": "0"},
+                            step_sleep=0.25)
+        killed2 = []
+        t2 = threading.Thread(target=_kill_server_at, args=(off, 3,
+                                                            killed2),
+                              daemon=True)
+        t2.start()
+        t0 = time.time()
+        rc, text = _wait(proc, 180)
+        if rc is None:
+            print(text)
+            failures.append("replication-off run HUNG instead of "
+                            "aborting")
+        elif rc == 0:
+            failures.append("replication-off run claimed success with "
+                            "a dead, unreplicated server")
+        elif "ServerDiedError" not in text:
+            print(text)
+            failures.append("replication-off abort was not the typed "
+                            "ServerDiedError")
+        else:
+            print("replication-off: typed abort in %.1fs (no hang)"
+                  % (time.time() - t0))
+
+    if failures:
+        print("check_elastic FAILURES:")
+        for f in failures:
+            print("  -", f)
+        return 1
+    print("check_elastic OK: %d-step dist_sync survived %s with a "
+          "clean-run-identical trajectory" %
+          (steps, "a SIGKILLed worker" if args.smoke else
+           "a SIGKILLed worker (respawned + rejoined) AND a SIGKILLed "
+           "server (replica failover), and aborted typed with "
+           "replication off"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

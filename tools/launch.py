@@ -7,6 +7,16 @@ The analog of the reference's `tools/launch.py` → dmlc-tracker
 (MXTPU_ROLE/MXTPU_PS_ROOT_URI/...), waits for the workers, then reaps
 the rest.  Two launchers:
 
+The local launcher is failure-honest: a nonzero child exit — worker,
+server or scheduler — makes the launcher itself exit nonzero, so a
+silently-dead role can never masquerade as success.  Elastic knobs:
+``--restart-workers N`` respawns a dead worker up to N times (it
+re-registers with the scheduler as a rejoin and resumes — see
+`docs/elastic.md`); ``--allow-server-failures N`` tolerates N server
+deaths when ``MXTPU_PS_REPLICATION=1`` failover is expected to absorb
+them; ``--pid-dir DIR`` writes one ``<role>-<i>.pid`` file per child
+so chaos harnesses (`tools/check_elastic.py`) can target a role.
+
 * ``local`` — all roles as local processes (development/tests);
 * ``ssh``  — roles distributed round-robin over ``--hostfile`` hosts
   via passwordless ssh (the reference's ssh tracker): scheduler runs on
@@ -28,6 +38,7 @@ import signal
 import socket
 import subprocess
 import sys
+import time
 
 
 def _free_port() -> int:
@@ -48,6 +59,19 @@ def main(argv=None):
                     help="one host per line (ssh launcher)")
     ap.add_argument("--sync-dst-dir", default=None,
                     help="rsync CWD to this dir on every host first")
+    ap.add_argument("--restart-workers", type=int, default=0,
+                    metavar="N",
+                    help="respawn a dead (nonzero-exit) worker up to N "
+                         "times total; it re-registers as an elastic "
+                         "rejoin and resumes")
+    ap.add_argument("--allow-server-failures", type=int, default=0,
+                    metavar="N",
+                    help="tolerate N nonzero server exits mid-run "
+                         "(MXTPU_PS_REPLICATION failover absorbs them) "
+                         "instead of failing the launch")
+    ap.add_argument("--pid-dir", default=None,
+                    help="write <role>-<i>.pid per child (chaos "
+                         "harness hook)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
@@ -65,10 +89,12 @@ def main(argv=None):
         "MXTPU_NUM_WORKER": str(args.num_workers),
         "MXTPU_NUM_SERVER": str(ns),
     })
+    if args.pid_dir:
+        os.makedirs(args.pid_dir, exist_ok=True)
 
     procs = []
 
-    def spawn(role, extra=None):
+    def spawn(role, index, extra=None):
         env = dict(base)
         env["MXTPU_ROLE"] = role
         env.update(extra or {})
@@ -77,22 +103,64 @@ def main(argv=None):
                    "import mxtpu.kvstore_server as s; s.init_module()"]
         else:
             cmd = args.command
-        procs.append(subprocess.Popen(cmd, env=env))
+        p = subprocess.Popen(cmd, env=env)
+        procs.append(p)
+        if args.pid_dir:
+            with open(os.path.join(args.pid_dir,
+                                   "%s-%d.pid" % (role, index)), "w") as f:
+                f.write(str(p.pid))
+        return p
 
-    spawn("scheduler")
-    for _ in range(ns):
-        spawn("server")
-    workers = []
-    for _ in range(args.num_workers):
-        spawn("worker")
-        workers.append(procs[-1])
+    infra = [("scheduler", spawn("scheduler", 0))]
+    for i in range(ns):
+        infra.append(("server", spawn("server", i)))
+    workers = {}
+    for i in range(args.num_workers):
+        workers[i] = spawn("worker", i)
 
     rc = 0
+    restarts_left = max(0, args.restart_workers)
+    server_budget = max(0, args.allow_server_failures)
+    infra_flagged = set()
     try:
-        for w in workers:
-            code = w.wait()
-            if code != 0 and rc == 0:
-                rc = code if 0 < code < 256 else 1
+        # poll loop instead of sequential wait(): it can respawn dead
+        # workers (elastic restart) and catch SILENT scheduler/server
+        # death while workers are still running — previously a dead
+        # server could hang or fail the job with the launcher still
+        # exiting 0
+        while workers:
+            time.sleep(0.2)
+            for i, w in list(workers.items()):
+                code = w.poll()
+                if code is None:
+                    continue
+                del workers[i]
+                if code == 0:
+                    continue
+                if restarts_left > 0:
+                    restarts_left -= 1
+                    print("launch.py: worker %d exited %d — respawning "
+                          "(%d restart(s) left)" % (i, code,
+                                                    restarts_left),
+                          file=sys.stderr, flush=True)
+                    workers[i] = spawn("worker", i)
+                elif rc == 0:
+                    rc = code if 0 < code < 256 else 1
+            for role, p in infra:
+                code = p.poll()
+                if code in (None, 0) or p in infra_flagged:
+                    continue
+                infra_flagged.add(p)
+                if role == "server" and server_budget > 0:
+                    server_budget -= 1
+                    print("launch.py: server died (exit %d) — tolerated "
+                          "(%d allowed failure(s) left)"
+                          % (code, server_budget),
+                          file=sys.stderr, flush=True)
+                elif rc == 0:
+                    print("launch.py: %s died (exit %d) mid-run"
+                          % (role, code), file=sys.stderr, flush=True)
+                    rc = code if 0 < code < 256 else 1
     finally:
         for p in procs:
             if p.poll() is None:
