@@ -104,6 +104,7 @@ from . import model
 from . import operator
 from . import callback
 from . import profiler
+from . import telemetry
 from . import resilience
 from . import monitor
 from . import visualization

@@ -58,6 +58,15 @@ Elastic membership (see `docs/elastic.md`):
   * sync pushes carry a (worker id, round) pair, making retried pushes
     IDEMPOTENT: a resend of an already-counted or already-applied push
     is acknowledged without double-accumulating.
+
+Telemetry (`docs/observability.md`): every role stamps its identity
+into `mxtpu.telemetry` and attaches its counter snapshot + recent
+events to each scheduler heartbeat; the scheduler keeps the latest
+snapshot per node, answers the ``telemetry`` op with the merged
+cluster view (``kv.telemetry()``), and — because a SIGKILLed node
+cannot dump its own flight record — writes a POSTHUMOUS
+``flight_<role><rank>.json`` from the dead node's last shipped
+snapshot when the dead-node detector declares it.
 """
 from __future__ import annotations
 
@@ -78,6 +87,7 @@ import numpy as np
 from .base import (KVStoreTimeoutError, PSConnectError, ServerDiedError,
                    getenv)
 from . import resilience as _res
+from . import telemetry as _telemetry
 
 __all__ = ["Scheduler", "Server", "Worker", "role_from_env",
            "run_scheduler", "run_server"]
@@ -364,11 +374,19 @@ class _Client(object):
                 except OSError:
                     pass
                 self._sock = None
+                op = obj.get("op") if isinstance(obj, dict) else "?"
+                # a wedged peer is flight-recorder territory: dump the
+                # ring + stacks BEFORE the (possibly retried) raise so
+                # even a hang that later clears leaves its trace
+                _telemetry.record("timeout", op=str(op),
+                                  wait_s=float(timeout))
+                _telemetry.dump_flight(
+                    "kvstore_timeout", "op=%s wait=%.1fs peer=%s"
+                    % (op, timeout, (self._addr,)))
                 raise KVStoreTimeoutError(
                     "no server response within %.1fs for op %r (set "
                     "MXTPU_KVSTORE_TIMEOUT to adjust; <=0 disables)"
-                    % (timeout, obj.get("op") if isinstance(obj, dict)
-                       else "?")) from e
+                    % (timeout, op)) from e
             except OSError:
                 # connection died mid-exchange (reset/pipe): drop the
                 # socket so a retry reconnects instead of re-sending on
@@ -445,6 +463,11 @@ class Scheduler(object):
         self._threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
         self._last_beat: Dict[int, float] = {}
+        # node id -> latest heartbeat-shipped telemetry snapshot (the
+        # cluster view `kv.telemetry()` merges, and the source of the
+        # posthumous flight record when a node is declared dead)
+        self._telemetry: Dict[int, Dict[str, Any]] = {}
+        _telemetry.set_identity("scheduler", 0)
 
     # -- liveness / membership (all called with self._cv held) --------------
     def _live_workers(self) -> int:
@@ -528,8 +551,17 @@ class Scheduler(object):
                         declared = nid in self._dead
                         if not declared:
                             self._last_beat[nid] = time.time()
+                            # snapshots only from LIVE members: a
+                            # fenced zombie must not keep mutating the
+                            # dead node's last-known state after its
+                            # posthumous flight record was written
+                            snap = msg.get("telemetry")
+                            if isinstance(snap, dict):
+                                self._telemetry[nid] = snap
                     _send_msg(conn, {"ok": True,
                                      "declared_dead": declared})
+                elif op == "telemetry":
+                    _send_msg(conn, self._telemetry_view())
                 elif op == "dead_nodes":
                     timeout = float(msg.get("timeout",
                                             self._dead_timeout))
@@ -578,6 +610,23 @@ class Scheduler(object):
                 "ranks": [[nid, r] for r, nid in
                           enumerate(self._worker_order)],
                 "dead": sorted(self._dead)}
+
+    def _telemetry_view(self):
+        """The merged cluster view: latest per-node snapshots (keyed
+        by node id; the scheduler itself under its ps-lite id 1) plus
+        the aggregated counter totals."""
+        with self._cv:
+            nodes = {str(nid): snap
+                     for nid, snap in self._telemetry.items()}
+            dead = sorted(self._dead)
+            gen = self._gen
+        own = _telemetry.hb_payload()  # same event cap as shipped rows
+        if own is not None:
+            nodes["1"] = own
+        aggregate = _telemetry.aggregate_stats(
+            s.get("stats") for s in nodes.values())
+        return {"nodes": nodes, "aggregate": aggregate,
+                "gen": gen, "dead": dead}
 
     def _register(self, msg):
         rejoin = False
@@ -699,6 +748,16 @@ class Scheduler(object):
                     self._cv.notify_all()
                 live = self._live_workers()
                 gen = self._gen
+                corpses = [(nid, self._telemetry.get(nid))
+                           for nid in newly]
+            for nid, snap in corpses:
+                # the dead node cannot dump its own flight record —
+                # write one on its behalf from its last shipped
+                # snapshot (its final known step/round/counters)
+                _telemetry.record("membership", action="declared_dead",
+                                  node=nid, gen=gen)
+                if snap is not None:
+                    _telemetry.dump_flight_for(snap, "declared_dead")
             if worker_died:
                 self._reconfig_servers(live, gen)
             if newly and self._maybe_shutdown():
@@ -833,8 +892,15 @@ def _start_heartbeat(node_id: int, stopped, reginfo=None):
             return
         while not stopped():
             try:
-                rep = client.request({"op": "heartbeat",
-                                      "node_id": node_id})
+                beat = {"op": "heartbeat", "node_id": node_id}
+                # ship this role's telemetry with every beat: the
+                # scheduler's cluster view stays at most one interval
+                # stale, and a SIGKILL still leaves the last shipped
+                # snapshot behind for the posthumous flight record
+                snap = _telemetry.hb_payload()
+                if snap is not None:
+                    beat["telemetry"] = snap
+                rep = client.request(beat)
                 if isinstance(rep, dict) and rep.get("declared_dead") \
                         and reginfo is not None:
                     info = dict(reginfo())
@@ -943,6 +1009,7 @@ class Server(object):
                                     "addr": self._addr})
         self.rank = info["rank"]
         self.node_id = info.get("node_id", 8 + 2 * self.rank)
+        _telemetry.set_identity("server", self.rank)
         servers = [tuple(a) for a in info.get("servers", [])]
         ns = len(servers)
         self._repl_on = _replication_on() and ns > 1
@@ -1100,6 +1167,14 @@ class Server(object):
         if n and n != self._nw0:
             acc = acc * (float(self._nw0) / n)
         self._apply_safe(key, acc)
+        version = self._versions.get(key, 0)
+        _telemetry.record("kvstore_round", key=str(key), round=version,
+                          contributors=n,
+                          rescaled=True if n and n != self._nw0
+                          else None)
+        from . import profiler as _prof
+
+        _prof.max_stat("kvstore_round_last", version)
         self._cv.notify_all()
 
     def _push(self, msg):
@@ -1447,8 +1522,11 @@ class Worker(object):
         self._bigarray = _bigarray_bound()
         self.node_id = info.get("node_id", 9 + 2 * self.rank)
         self._closed = False
+        _telemetry.set_identity(role_from_env() or "worker", self.rank)
         if self.rejoined:
             _inc_stat("elastic_rejoin")
+            _telemetry.record("membership", action="rejoin",
+                              node=self.node_id, gen=self.gen)
         _start_heartbeat(self.node_id, lambda: self._closed,
                          reginfo=lambda: {"role": "worker",
                                           "rank": self.rank,
@@ -1480,6 +1558,8 @@ class Worker(object):
         if gen is not None and gen != self.gen:
             self.gen = gen
             _inc_stat("elastic_rerank")
+            _telemetry.record("membership", action="rerank", gen=gen,
+                              live=rep.get("num_workers"))
         if rep.get("num_workers") is not None:
             self.live_workers = int(rep["num_workers"])
         for nid, rank in rep.get("ranks", []):
@@ -1487,6 +1567,7 @@ class Worker(object):
                 self.rank = int(rank)
         if rep.get("rank") is not None:
             self.rank = int(rep["rank"])
+        _telemetry.set_identity(rank=self.rank)
 
     def _server_client(self, phys: int) -> _Client:
         """Connection to server ``phys``, dialed on first use."""
@@ -1560,6 +1641,8 @@ class Worker(object):
                                                  "from_rank": phys})
         taken = rep.get("taken") or []
         _inc_stat("elastic_failover")
+        _telemetry.record("failover", server=phys, successor=succ,
+                          shards=len(taken), step=_telemetry.current_step())
         # re-push any round the mirror had not received: per subkey the
         # replica can only be ONE round behind with the default
         # MXTPU_PS_REPL_LAG=1, and we kept exactly that round's payload
@@ -1658,6 +1741,7 @@ class Worker(object):
         self._meta_shape.setdefault(key, (value.shape, value.dtype))
         if sync:
             self._maybe_join(key)
+        version = 0
         for sidx, subkey, lo, hi in self._chunks(key, flat.size):
             msg = {"op": "push", "key": subkey, "value": flat[lo:hi],
                    "sync": sync, "worker": self.node_id}
@@ -1680,6 +1764,15 @@ class Worker(object):
                 raise ConnectionError("push of %r failed: %s"
                                       % (key, rep["error"]))
             self._last_version[subkey] = rep["version"]
+            version = max(version, int(rep["version"]))
+        # the gauge is an ALWAYS-ON profiler stat (like the server
+        # side), independent of the event telemetry opt-out
+        from . import profiler as _prof
+
+        _prof.max_stat("kvstore_round_last", version)
+        _telemetry.record("kvstore", op="push", key=str(key),
+                          round=version,
+                          step=_telemetry.current_step())
 
     def pull(self, key, sync: bool = True,
              timeout: Optional[float] = None) -> np.ndarray:
@@ -1699,6 +1792,10 @@ class Worker(object):
                 timeout=timeout)
             if time.monotonic() - t0 > straggler:
                 _inc_stat("elastic_straggler_waits")
+                _telemetry.record("kvstore", op="straggler_wait",
+                                  key=str(key),
+                                  wait_s=round(time.monotonic() - t0, 3),
+                                  step=_telemetry.current_step())
             if rep.get("value") is None:
                 raise ConnectionError(
                     "pull of %r failed: %s" % (key, rep.get(
@@ -1788,6 +1885,12 @@ class Worker(object):
                 raise ConnectionError("push_rows of %r failed: %s"
                                       % (key, rep["error"]))
             self._last_version[subkey] = rep["version"]
+
+    def telemetry(self):
+        """The scheduler's merged cluster view: per-node latest
+        heartbeat-shipped snapshots + aggregated counter totals
+        (``kv.telemetry()`` surface; see `docs/observability.md`)."""
+        return self._sched.request({"op": "telemetry"})
 
     def barrier(self):
         rep = self._sched.request({"op": "barrier",

@@ -173,6 +173,19 @@ def getenv_bool(name: str, default: bool) -> bool:
     return val not in ("0", "false", "False", "FALSE", "")
 
 
+# os.getpid() is a real syscall (~10us under sandboxed kernels), too
+# slow for per-event stamping on telemetry/profiler hot paths; cache
+# it once and refresh in forked children (dataloader workers).
+_pid_cache = [os.getpid()]
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(
+        after_in_child=lambda: _pid_cache.__setitem__(0, os.getpid()))
+
+
+def getpid_cached() -> int:
+    return _pid_cache[0]
+
+
 def check_call(ret: Any) -> Any:  # parity shim; no C boundary to check
     return ret
 

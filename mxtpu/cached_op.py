@@ -191,10 +191,13 @@ class CachedOp(object):
             _prof.inc_stat("cachedop_%s_hit" % kind)
         else:
             from . import resilience as _res
+            from . import telemetry as _tel
 
             _res.fault_barrier("compile", "cachedop:%s" % kind)
             self._seen_sigs.add(keyed)
             _prof.inc_stat("cachedop_%s_trace" % kind)
+            _tel.record("compile", site="cachedop:%s" % kind,
+                        step=_tel.current_step())
 
     def _infer_dispatch(self, key, flat: List[Any]):
         """Inference hot path: bucket-pad ragged batch dims, then serve

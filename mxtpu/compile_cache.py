@@ -275,6 +275,9 @@ def aot_compile(jitfn, example_args):
     (mxtpu/resilience.py): a transient XLA/compile-cache failure is
     retried with backoff instead of killing the run."""
     from . import resilience as _res
+    from . import telemetry as _tel
+
+    _tel.record("compile", site="aot", step=_tel.current_step())
 
     def body():
         _res.maybe_fault("compile", "aot_compile")

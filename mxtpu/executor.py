@@ -382,8 +382,12 @@ class Executor(object):
 
         if _prof.is_recording("symbolic"):
             with _prof.span("Executor::forward(%s)"
-                            % self._symbol.name, "symbolic"):
-                return self._forward_impl(is_train, **kwargs)
+                            % self._symbol.name, "symbolic") as sp:
+                outs = self._forward_impl(is_train, **kwargs)
+                # under MXTPU_PROFILER_SYNC the span blocks on exactly
+                # these outputs for a true device timing
+                sp.result = [o._data for o in outs]
+                return outs
         return self._forward_impl(is_train, **kwargs)
 
     def _forward_impl(self, is_train: bool = False, **kwargs):
@@ -543,10 +547,13 @@ class Executor(object):
             # the `compile` fault-injection chokepoint (flaky-compile
             # recovery rides the retry policy)
             from . import resilience as _res
+            from . import telemetry as _tel
 
             _res.fault_barrier("compile", "executor:%s" % kind)
             self._seen_sigs.add(sig)
             _prof.inc_stat("executor_%s_trace" % kind)
+            _tel.record("compile", site="executor:%s" % kind,
+                        step=_tel.current_step())
 
     def warmup(self, for_training: Optional[bool] = None):
         """AOT-compile this executor's programs via

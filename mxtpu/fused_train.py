@@ -274,13 +274,17 @@ class FusedTrainLoop(object):
         """Run K fused steps over pre-staged (K, ...) slot arrays.
         Returns stacked outputs (list of (K, ...) NDArrays) when
         collect_outputs, else None."""
+        import time as _time
+
         import jax
 
         from . import random as _rnd
+        from . import telemetry as _tel
 
         K = self._K
         base_key = _rnd._next_key() if self._exec._has_rng \
             else jax.random.PRNGKey(0)
+        t0 = _time.monotonic()
         p, s, aux, outs = self._jit_program(
             *self._program_args(data_stack, base_key))
         bad_flags = None
@@ -291,6 +295,13 @@ class FusedTrainLoop(object):
         self._t += K
         self._optimizer.commit_scan_steps(self._opt_indices, K)
         self._publish()
+        # one record for the whole K-step program: per-step batch size
+        # is the second dim of the staged (K, batch, ...) stacks
+        batch = int(data_stack[0].shape[1]) \
+            if data_stack and getattr(data_stack[0], "ndim", 0) > 1 else 0
+        _tel.record_step(batch_size=batch, n=K,
+                         duration=_time.monotonic() - t0,
+                         site="fused_train")
         if bad_flags is not None:
             # state is already published (skipped steps kept the old
             # buffers in-program); now account per-step health and

@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional
 from ..base import MXNetError
 from .. import optimizer as opt_mod
 from .. import resilience as _res
+from .. import telemetry as _tel
 from ..ndarray.ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
@@ -143,9 +144,14 @@ class Trainer(object):
             if self._bad_step_guard is None:
                 self._bad_step_guard = _res.BadStepGuard(site="trainer")
             if self._bad_step_guard.record(self._grads_finite()):
+                # still a wall step: the telemetry stream records it as
+                # skipped so the non-finite count stays per-step honest
+                _tel.record_step(batch_size=batch_size, skipped=True,
+                                 site="trainer")
                 return  # skip allreduce + update entirely
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        _tel.record_step(batch_size=batch_size, site="trainer")
 
     def _grads_finite(self):
         grads = []

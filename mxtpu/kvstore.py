@@ -304,6 +304,18 @@ class KVStore(object):
     def barrier(self):
         pass
 
+    def telemetry(self):
+        """Merged telemetry view (`docs/observability.md`).  For
+        non-distributed stores this is just the local process:
+        ``{"nodes": {<id>: snapshot}, "aggregate": stats}``.
+        `KVStoreDist` overrides with the scheduler's cluster view
+        built from heartbeat-shipped per-node snapshots."""
+        from . import telemetry as _tel
+
+        snap = _tel.snapshot()
+        return {"nodes": {"local": snap},
+                "aggregate": dict(snap["stats"])}
+
     def send_command_to_servers(self, head, body):
         pass
 
@@ -591,6 +603,12 @@ class KVStoreDist(KVStoreDevice):
 
     def barrier(self):
         self._worker.barrier()
+
+    def telemetry(self):
+        """The scheduler's merged cluster view: per-node telemetry
+        snapshots (shipped on the heartbeat channel) plus aggregated
+        counter totals (`docs/observability.md`)."""
+        return self._worker.telemetry()
 
     def send_command_to_servers(self, head, body):
         self._worker.send_command(head, body)

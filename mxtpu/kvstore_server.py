@@ -39,6 +39,15 @@ def init_module():
     role = _ps.role_from_env()
     if role in ("server", "scheduler"):
         KVStoreServer().run()
+        # clean shutdown reached: disarm the flight recorder FIRST —
+        # the launcher's routine teardown SIGTERM races this exit, and
+        # a healthy run must not leave crash-style flight corpses —
+        # then flush the final snapshot explicitly (the hard exit
+        # below skips atexit)
+        from . import telemetry
+
+        telemetry.uninstall_flight_recorder()
+        telemetry.flush()
         # hard exit, ps-lite style: the role's work is DONE when run()
         # returns, but interpreter/native teardown with live daemon
         # threads (XLA/PJRT pools used by the server-side updater) can

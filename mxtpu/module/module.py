@@ -415,6 +415,8 @@ class Module(BaseModule):
         if not (self.binded and self.params_initialized and
                 self.optimizer_initialized):
             raise MXNetError("init_optimizer() first")
+        from .. import telemetry as _tel
+
         self._params_dirty = True
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
@@ -428,6 +430,8 @@ class Module(BaseModule):
                            num_device=len(self._context),
                            kvstore=self._kvstore,
                            param_names=self._exec_group.param_names)
+        _tel.record_step(batch_size=self._exec_group.batch_size,
+                         site="module")
 
     def get_outputs(self, merge_multi_context=True):
         return self._exec_group.get_outputs(merge_multi_context)

@@ -8,6 +8,11 @@ installed on executors via `MXExecutorSetMonitorCallback`; every
 Here the executor exposes its arg/aux/output dicts directly, so the
 monitor pulls stats instead of receiving callbacks — same API surface
 (`install`, `tic`, `toc`, `toc_print`).
+
+Every collected (name, stat) pair is also emitted into the telemetry
+stream (`mxtpu/telemetry.py`, kind ``monitor``) carrying the CURRENT
+training-step correlation id, so aux/weight stats line up with the
+step/compile/kvstore records on the merged timeline.
 """
 from __future__ import annotations
 
@@ -75,6 +80,13 @@ class Monitor(object):
                 else:
                     res.append((n, k, str(v.asnumpy())))
         self.queue = []
+        if res:
+            from . import telemetry as _tel
+
+            step_id = _tel.current_step()
+            for n, k, v in res:
+                _tel.record("monitor", step=step_id, batch=int(n),
+                            name=k, value=v)
         return res
 
     def toc_print(self):

@@ -10,8 +10,17 @@ TPU notes: host-side spans measure dispatch + (for jitted whole-graph
 executors) device execution because the executor blocks on results it
 returns lazily; set MXTPU_PROFILER_SYNC=1 to block after every op for
 accurate per-op device times (the analog of the reference profiling
-`NaiveEngine` mode).  For kernel-level device timing use jax.profiler
-(XPlane) alongside — `start_xplane`/`stop_xplane` wrap it.
+`NaiveEngine` mode).  The flag is read PER SPAN, so it can be flipped
+mid-run; a span whose producer attached the op's results (``span.result``)
+blocks on exactly those via ``jax.block_until_ready`` instead of the
+global ``jax.effects_barrier``.  For kernel-level device timing use
+jax.profiler (XPlane) alongside — `start_xplane`/`stop_xplane` wrap it.
+
+Trace identity: every event is stamped with the REAL pid, `dump()`
+emits chrome ``process_name``/``thread_name`` metadata rows (role+rank
+from `mxtpu.telemetry`) and an ``otherData.epoch_origin_s`` wall-clock
+origin, so per-role dumps from a distributed run merge into one
+timeline via ``telemetry.merge_traces`` with clocks aligned.
 
 Autostart: MXTPU_PROFILER_AUTOSTART=1 (reference
 MXNET_PROFILER_AUTOSTART, `docs/faq/env_var.md:156`).
@@ -24,14 +33,18 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from .base import MXNetError
+from .base import MXNetError, getpid_cached
 
 __all__ = ["set_config", "set_state", "state", "pause", "resume", "dump",
            "dumps", "Domain", "Task", "Frame", "Counter", "Marker",
            "start_xplane", "stop_xplane",
-           "inc_stat", "get_stat", "stats", "reset_stats"]
+           "inc_stat", "get_stat", "set_stat", "max_stat", "stats",
+           "reset_stats"]
 
-_lock = threading.Lock()
+# RLock: the telemetry flight recorder's signal handler reads stats()
+# on whatever thread the signal lands on — possibly one already inside
+# inc_stat's critical section (re-entry only reads; see telemetry.py)
+_lock = threading.RLock()
 _RUNNING = False
 _PAUSED = False
 _CONFIG = {
@@ -45,12 +58,21 @@ _CONFIG = {
 }
 _EVENTS: List[Dict[str, Any]] = []
 _AGG: Dict[str, List[float]] = {}
+# the two origins are captured back-to-back: _START_TS anchors the
+# relative event timestamps, _START_EPOCH records what wall-clock
+# instant that zero corresponds to (the mergeable-trace contract)
 _START_TS = time.perf_counter()
-_SYNC = os.environ.get("MXTPU_PROFILER_SYNC", "0") == "1"
+_START_EPOCH = time.time()
 
 
 def _now_us() -> float:
     return (time.perf_counter() - _START_TS) * 1e6
+
+
+def _sync_enabled() -> bool:
+    """MXTPU_PROFILER_SYNC, read per-span (NOT latched at import) so a
+    run can flip into accurate-device-timing mode on the fly."""
+    return os.environ.get("MXTPU_PROFILER_SYNC", "0") == "1"
 
 
 def set_config(**kwargs):
@@ -102,30 +124,22 @@ def is_recording(kind: str = "imperative") -> bool:
 
 def record_span(name: str, cat: str, ts_us: float, dur_us: float,
                 tid: int = 0, args: Optional[Dict] = None):
-    if not _RUNNING:
+    if not _RUNNING or _PAUSED:
         return
     with _lock:
         _EVENTS.append({"name": name, "cat": cat, "ph": "X",
-                        "ts": ts_us, "dur": dur_us, "pid": 0, "tid": tid,
+                        "ts": ts_us, "dur": dur_us, "pid": getpid_cached(),
+                        "tid": tid,
                         **({"args": args} if args else {})})
         _AGG.setdefault(name, []).append(dur_us)
 
 
-# -- compile-lifecycle stats ----------------------------------------------
-# Always-on counters (a dict bump, not gated on set_state) so retrace
-# regressions on the dispatch hot path are observable without turning
-# the event profiler on: `mxtpu/compile_cache.py` ticks *_trace on
-# every new shape signature, *_hit on reuse, *_aot_hit when a warmed
-# executable serves the call, *_bucket_pad when a ragged batch was
-# padded into an existing bucket.  tools/check_retrace.py gates CI on
-# them.  The resilience layer ticks retry_*/fault_injected::<site>
-# (mxtpu/resilience.py) and the elastic PS layer ticks elastic_*:
-# elastic_failover / elastic_repush / elastic_promote (server shard
-# failover), elastic_rerank (membership generation observed),
-# elastic_rejoin (this worker re-registered into a running group),
-# elastic_straggler_waits (a sync pull blocked > MXTPU_STRAGGLER_SEC),
-# elastic_sched_reregister (heartbeat survived a scheduler restart).
-# tools/check_elastic.py gates CI on the failover path.
+# -- always-on stats -------------------------------------------------------
+# Counters (a dict bump, not gated on set_state) so hot-path
+# regressions are observable without turning the event profiler on.
+# The full counter-namespace catalog (compile-lifecycle *_trace/*_hit,
+# resilience retry_*/fault_injected::<site>, elastic_*, telemetry_*)
+# lives in `docs/observability.md`.
 
 _STATS: Dict[str, int] = {}
 
@@ -143,6 +157,21 @@ def get_stat(name: str) -> int:
     return _STATS.get(name, 0)
 
 
+def set_stat(name: str, value: int) -> None:
+    """Set an absolute gauge value (e.g. ``step_time_us_last``) —
+    counters use :func:`inc_stat`, gauges this."""
+    with _lock:
+        _STATS[name] = int(value)
+
+
+def max_stat(name: str, value: int) -> None:
+    """Raise a watermark gauge (e.g. ``device_mem_watermark_bytes``)
+    to ``value`` if it is higher."""
+    with _lock:
+        if int(value) > _STATS.get(name, 0):
+            _STATS[name] = int(value)
+
+
 def stats() -> Dict[str, int]:
     """Snapshot of the compile-lifecycle counters."""
     with _lock:
@@ -155,34 +184,42 @@ def reset_stats() -> None:
 
 
 def record_counter(name: str, value: float, ts_us: Optional[float] = None):
-    if not _RUNNING:
+    if not _RUNNING or _PAUSED:
         return
     with _lock:
         _EVENTS.append({"name": name, "ph": "C",
                         "ts": ts_us if ts_us is not None else _now_us(),
-                        "pid": 0, "args": {name: value}})
+                        "pid": getpid_cached(), "args": {name: value}})
 
 
 class _Span(object):
     """Context manager measuring one span (engine ProfileOperator
-    analog)."""
+    analog).  A producer may attach the span's device results via
+    ``span.result = <jax arrays>``; under MXTPU_PROFILER_SYNC the exit
+    then blocks on exactly those (``jax.block_until_ready``) for a
+    true synchronous device timing, falling back to the global
+    ``jax.effects_barrier`` when nothing was attached."""
 
-    __slots__ = ("name", "cat", "t0")
+    __slots__ = ("name", "cat", "t0", "result")
 
     def __init__(self, name: str, cat: str):
         self.name = name
         self.cat = cat
+        self.result = None
 
     def __enter__(self):
         self.t0 = _now_us()
         return self
 
     def __exit__(self, *exc):
-        if _SYNC:
+        if _sync_enabled():
             try:
                 import jax
 
-                jax.effects_barrier()
+                if self.result is not None:
+                    jax.block_until_ready(self.result)
+                else:
+                    jax.effects_barrier()
             except Exception:
                 pass
         record_span(self.name, self.cat, self.t0, _now_us() - self.t0,
@@ -267,20 +304,52 @@ class Marker(object):
         self.name = (domain.name + "::" if domain else "") + name
 
     def mark(self, scope: str = "process"):
-        if not _RUNNING:
+        if not _RUNNING or _PAUSED:
             return
         with _lock:
             _EVENTS.append({"name": self.name, "ph": "i", "ts": _now_us(),
-                            "pid": 0, "tid": 0, "s": scope[0]})
+                            "pid": getpid_cached(), "tid": 0, "s": scope[0]})
 
 
 # -- dumping ---------------------------------------------------------------
 
 def dump(finished: bool = True, profile_process: str = "worker"):
     """Write accumulated events as chrome://tracing JSON (reference
-    `DumpProfile`, `profiler.cc:166`)."""
+    `DumpProfile`, `profiler.cc:166`).
+
+    The dump is self-describing for cross-process merging: events
+    carry the real pid, a ``process_name`` metadata row names this
+    role+rank, and ``otherData.epoch_origin_s`` records the wall-clock
+    instant of ts=0 so `mxtpu.telemetry.merge_traces` can align
+    per-role dumps onto one timeline."""
+    try:
+        from . import telemetry as _tel
+
+        ident = _tel.identity()
+    except Exception:
+        ident = {"role": "local", "rank": 0, "pid": os.getpid()}
+    pid = os.getpid()
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": "%s%d (pid %d)"
+                  % (ident["role"], ident["rank"], pid)}},
+    ]
+    # name the thread rows that actually hold events (spans record
+    # tid = get_ident() % 1000, so label those, marking this thread —
+    # the dumper, almost always the dispatch thread — as such)
+    main_tid = threading.get_ident() % 1000
     with _lock:
-        payload = {"traceEvents": list(_EVENTS), "displayTimeUnit": "ms"}
+        seen_tids = {e.get("tid", 0) for e in _EVENTS}
+        for tid in sorted(seen_tids):
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid,
+                         "args": {"name": "dispatch" if tid == main_tid
+                                  else "thread-%d" % tid}})
+        payload = {"traceEvents": meta + list(_EVENTS),
+                   "displayTimeUnit": "ms",
+                   "otherData": {"epoch_origin_s": _START_EPOCH,
+                                 "role": ident["role"],
+                                 "rank": ident["rank"], "pid": pid}}
         if finished:
             _EVENTS.clear()
     with open(_CONFIG["filename"], "w") as f:

@@ -82,6 +82,7 @@ __all__ = [
     "validate_manifest",
     "list_manifest_epochs",
     "latest_valid_epoch",
+    "chain_prev_signal",
     "install_preemption_hook",
     "remove_preemption_hook",
     "preempted",
@@ -287,15 +288,22 @@ def run_with_retry(site: str, fn: Callable[[], Any],
         except retry_on as e:
             if isinstance(e, PERMANENT_ERRORS):
                 raise
+            from . import telemetry as _tel
+
             elapsed = time.monotonic() - t0
             if attempt >= retries or (budget > 0 and elapsed >= budget):
                 _prof.inc_stat("retry_failures::" + site)
+                _tel.record("retry", site=site, exhausted=True,
+                            attempts=attempt + 1,
+                            error=type(e).__name__)
                 raise RetryExhausted(
                     "%r failed %d time(s) over %.2fs (MXTPU_RETRY_MAX=%d,"
                     " MXTPU_RETRY_TIMEOUT=%.1f): %s"
                     % (site, attempt + 1, elapsed, retries, budget,
                        e)) from e
             _prof.inc_stat("retry_attempts::" + site)
+            _tel.record("retry", site=site, attempt=attempt + 1,
+                        error=type(e).__name__)
             sleep = min(_BACKOFF_CAP, base * (2 ** attempt))
             sleep *= 0.5 + 0.5 * _retry_rng.random()  # jitter
             if budget > 0:
@@ -493,6 +501,11 @@ class CheckpointWriter(object):
                 json.dump(payload, f, indent=1, sort_keys=True)
         run_with_retry("checkpoint", _write)
         _prof.inc_stat("checkpoint_committed")
+        from . import telemetry as _tel
+
+        _tel.record("checkpoint", epoch=self.epoch,
+                    step=_tel.current_step(),
+                    files=len(self._files))
         return mpath
 
 
@@ -576,6 +589,22 @@ _preempt_prev: Dict[int, Any] = {}
 _preempted = threading.Event()
 
 
+def chain_prev_signal(prev, signum, frame) -> None:
+    """Honor a signal's PREVIOUS disposition after a chained handler
+    ran: keep ignoring if it was ignored, call a previous python
+    handler, or re-deliver under SIG_DFL so the process dies the way
+    it would have.  Shared by this module's preemption hook and the
+    telemetry flight recorder — the two may both be installed, each
+    chaining to the other through here."""
+    if prev is signal.SIG_IGN:
+        return  # the signal was ignored before us: keep ignoring it
+    if callable(prev):
+        prev(signum, frame)
+    else:  # SIG_DFL / unknown: die the way we would have
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
 def _preempt_handler(signum, frame):
     from . import profiler as _prof
 
@@ -593,13 +622,7 @@ def _preempt_handler(signum, frame):
     if not forward:
         return
     # emergency state is on disk; now honor the prior disposition
-    if prev is signal.SIG_IGN:
-        return  # the signal was ignored before us: keep ignoring it
-    if callable(prev):
-        prev(signum, frame)
-    else:  # SIG_DFL / unknown: die the way we would have
-        signal.signal(signum, signal.SIG_DFL)
-        os.kill(os.getpid(), signum)
+    chain_prev_signal(prev, signum, frame)
 
 
 _PREEMPT_FORWARD = [True]
@@ -684,6 +707,13 @@ class BadStepGuard(object):
         _prof.inc_stat("bad_steps_skipped::" + self.site)
         if self.limit and self.consecutive >= self.limit:
             _prof.inc_stat("bad_steps_abort")
+            from . import telemetry as _tel
+
+            # this abort is a crash from the operator's point of view:
+            # leave a flight record naming where divergence won
+            _tel.dump_flight("bad_steps_abort",
+                             "site=%s consecutive=%d limit=%d"
+                             % (self.site, self.consecutive, self.limit))
             raise MXNetError(
                 "%d consecutive non-finite update steps at %r "
                 "(MXTPU_MAX_BAD_STEPS=%d): aborting — the model has "

@@ -1,0 +1,822 @@
+"""Unified telemetry: event ring, per-step metrics, flight recorder.
+
+The profiler (`mxtpu/profiler.py`) answers "where did the time go in
+THIS process while I was watching"; this module answers the production
+questions around it: what was every role doing just before the job
+wedged, how fast is each rank actually stepping, and what does the
+WHOLE cluster look like from one place.  Three pieces, one identity
+(role / rank / pid / wall-clock epoch timestamps) shared by all:
+
+  * **Structured event log** — a bounded in-memory ring of typed
+    records (:data:`EVENT_KINDS`): training steps, XLA compiles,
+    kvstore rounds, retries, failovers, checkpoints, membership
+    changes, monitor stats.  Producers live in ``executor.py``,
+    ``cached_op.py``, ``fused_train.py``, ``gluon/trainer.py``,
+    ``module/module.py``, ``kvstore.py``, ``_ps.py``,
+    ``resilience.py``, ``compile_cache.py`` and ``monitor.py``.
+    Every record carries epoch (``time.time()``) timestamps plus the
+    step / kvstore-round correlation ids, so records from different
+    processes merge on a common axis.
+
+  * **Cross-process aggregation** — every PS role ships its counter
+    snapshot + recent events to the scheduler on the existing
+    heartbeat channel (`_ps._start_heartbeat`); ``kv.telemetry()``
+    returns the scheduler's merged per-node view, and
+    ``tools/launch.py --telemetry-dir`` makes each role write a final
+    ``telemetry_<role><rank>.json`` which :func:`merge_dir` folds into
+    ONE chrome trace (clocks aligned via the epoch timestamps) and a
+    cluster counter view (per-rank step time, straggler spread,
+    retry/failover totals).
+
+  * **Flight recorder** — :func:`dump_flight` writes the ring + the
+    counter snapshot + all-thread stacks as
+    ``flight_<role><rank>.json``.  Triggers: SIGTERM/SIGQUIT
+    (:func:`install_flight_recorder`), unhandled exceptions
+    (sys/threading excepthook), a dist kvstore timeout
+    (``MXTPU_KVSTORE_TIMEOUT`` expiry in ``_ps._Client``), and the
+    ``MXTPU_MAX_BAD_STEPS`` abort.  A SIGKILLed node cannot dump its
+    own corpse, so the scheduler writes a POSTHUMOUS flight file from
+    the node's last heartbeat-shipped snapshot when it declares the
+    node dead (:func:`dump_flight_for`) — a ``check_elastic``-style
+    kill still leaves a diagnosable record naming the dead rank's
+    last round.
+
+Always-on and cheap: ``MXTPU_TELEMETRY=0`` opts out entirely (every
+producer call is then one bool check); the ring is bounded
+(``MXTPU_TELEMETRY_RING``, default 512) and the per-step path is a few
+dict operations with NO device synchronization.  The device-memory
+watermark samples ``jax.live_arrays()`` only every
+``MXTPU_TELEMETRY_MEMSAMPLE`` (64) steps.  Measured overhead is <1%
+on the training hot paths (`docs/observability.md`).
+
+Event record schema (all values JSON-safe scalars)::
+
+    {"kind": <EVENT_KINDS>, "ts": <epoch seconds>,
+     "role": "worker", "rank": 0, "pid": 12345,
+     "step": <step id>?, "round": <kvstore round>?, ...payload}
+
+See `docs/observability.md` for the full per-kind payload catalog.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+from .base import getenv, getenv_bool, getpid_cached
+
+__all__ = [
+    "EVENT_KINDS",
+    "GAUGE_STATS",
+    "enabled",
+    "enable",
+    "set_identity",
+    "identity",
+    "record",
+    "record_step",
+    "current_step",
+    "events",
+    "clear",
+    "metrics",
+    "snapshot",
+    "hb_payload",
+    "aggregate_stats",
+    "dump_flight",
+    "dump_flight_for",
+    "install_flight_recorder",
+    "uninstall_flight_recorder",
+    "flush",
+    "merge_dir",
+    "merge_traces",
+    "Speedometer",
+]
+
+#: The typed record vocabulary.  ``step`` = one (or K fused) training
+#: steps; ``compile`` = a new XLA program is being built; ``kvstore`` =
+#: a worker-side push/pull; ``kvstore_round`` = a server applied a
+#: completed sync round; ``retry`` = a resilience chokepoint retried;
+#: ``failover`` = elastic server failover; ``membership`` = group
+#: change (death declared / re-rank / rejoin); ``checkpoint`` = a
+#: manifest committed; ``monitor`` = a Monitor tensor stat; ``timeout``
+#: = a dist kvstore exchange expired; ``flight`` = a flight dump fired.
+EVENT_KINDS = ("step", "compile", "kvstore", "kvstore_round", "retry",
+               "failover", "membership", "checkpoint", "monitor",
+               "timeout", "flight")
+
+#: ``profiler.stats()`` keys that are point-in-time gauges, not
+#: additive counters: cluster aggregation takes their MAX, and counter
+#: reconciliation (`tools/check_telemetry.py`) excludes them from the
+#: sum-of-roles check.
+GAUGE_STATS = ("step_time_us_last", "device_mem_watermark_bytes",
+               "kvstore_round_last")
+
+# RLock, NOT Lock: the flight recorder's signal handler snapshots
+# state on whatever thread the signal lands on — if that thread was
+# inside record_step()'s critical section, a non-reentrant lock would
+# deadlock the handler against itself and turn a clean SIGTERM into a
+# wedge.  Re-entry only ever READS, so mid-update values are safe.
+_lock = threading.RLock()
+
+_ENABLED = getenv_bool("MXTPU_TELEMETRY", True)
+_RING_SIZE = max(16, int(getenv("MXTPU_TELEMETRY_RING", "512") or 512))
+_MEM_SAMPLE_EVERY = max(1, int(getenv("MXTPU_TELEMETRY_MEMSAMPLE", "64")
+                               or 64))
+# the live_arrays fallback walks every device buffer (milliseconds on
+# a big process): never more often than this many seconds
+_MEM_MIN_INTERVAL = float(getenv("MXTPU_TELEMETRY_MEM_INTERVAL", "10")
+                          or 10)
+_HB_EVENTS = max(0, int(getenv("MXTPU_TELEMETRY_HB_EVENTS", "64") or 64))
+
+_RING: collections.deque = collections.deque(maxlen=_RING_SIZE)
+
+# anchor for telling THIS run's flight records apart from leftovers in
+# a reused --telemetry-dir (files older than process start are stale)
+_START_TIME = time.time()
+
+_IDENTITY = {
+    "role": getenv("MXTPU_ROLE", getenv("DMLC_ROLE", "local")) or "local",
+    "rank": 0,
+}
+
+# per-step metric accumulators (under _lock)
+_METRICS = {"steps": 0, "examples": 0.0, "dt_sum": 0.0, "dt_last": 0.0,
+            "last_t": None, "nonfinite": 0, "mem_watermark": 0}
+
+
+def enabled() -> bool:
+    """Telemetry on?  ``MXTPU_TELEMETRY=0`` opts out at import."""
+    return _ENABLED
+
+
+def enable(on: bool = True) -> None:
+    """Flip telemetry at runtime (tests / embedding)."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def set_identity(role: Optional[str] = None,
+                 rank: Optional[int] = None) -> None:
+    """Stamp this process's role/rank into every future record.  The
+    PS layer calls this as soon as the scheduler assigns a rank (and
+    again on elastic re-rank)."""
+    with _lock:
+        if role is not None:
+            _IDENTITY["role"] = str(role)
+        if rank is not None:
+            _IDENTITY["rank"] = int(rank)
+
+
+def identity() -> Dict[str, Any]:
+    """``{"role", "rank", "pid"}`` of this process (the cached pid is
+    refreshed on fork, so dataloader workers stamp their own)."""
+    with _lock:
+        return {"role": _IDENTITY["role"], "rank": _IDENTITY["rank"],
+                "pid": getpid_cached()}
+
+
+def record(kind: str, **fields) -> None:
+    """Append one typed record to the ring.  One bool check when
+    telemetry is off; a dict build + deque append when on — safe on
+    hot paths.  ``fields`` must be JSON-safe scalars."""
+    if not _ENABLED:
+        return
+    ev = {"kind": kind, "ts": time.time(), "pid": getpid_cached(),
+          "role": _IDENTITY["role"], "rank": _IDENTITY["rank"]}
+    for k, v in fields.items():
+        if v is not None:
+            ev[k] = v
+    _RING.append(ev)
+
+
+def record_step(batch_size: int = 0, n: int = 1,
+                duration: Optional[float] = None,
+                skipped: bool = False, site: str = "train") -> int:
+    """Account one training step (or ``n`` fused steps) and emit a
+    ``step`` record.  ``duration`` defaults to the wall time since the
+    previous call — the full iteration time including data/forward/
+    backward, measured with NO device sync.  ``skipped`` marks a
+    non-finite-grad step the trainer dropped.  Returns the step id
+    (the correlation id monitor/kvstore records share)."""
+    if not _ENABLED:
+        return 0
+    now = time.monotonic()
+    with _lock:
+        last = _METRICS["last_t"]
+        _METRICS["last_t"] = now
+        if duration is None:
+            duration = (now - last) if last is not None else 0.0
+        _METRICS["steps"] += n
+        step_id = _METRICS["steps"]
+        _METRICS["examples"] += float(batch_size) * n
+        _METRICS["dt_sum"] += duration
+        _METRICS["dt_last"] = duration / max(1, n)
+        if skipped:
+            _METRICS["nonfinite"] += n
+        dt_last = _METRICS["dt_last"]
+    from . import profiler as _prof
+
+    _prof.inc_stat("telemetry_steps", n)
+    if batch_size:
+        _prof.inc_stat("telemetry_examples", int(batch_size) * n)
+    _prof.set_stat("step_time_us_last", int(dt_last * 1e6))
+    record("step", step=step_id, n=n, batch=int(batch_size),
+           dur_s=round(duration, 6), site=site,
+           skipped=True if skipped else None)
+    if step_id == n or (step_id % _MEM_SAMPLE_EVERY) < n:
+        _sample_device_mem()
+    return step_id
+
+
+_last_mem_sample = [0.0]
+
+
+def _sample_device_mem() -> None:
+    """Device-memory watermark — sampled every
+    ``MXTPU_TELEMETRY_MEMSAMPLE`` steps, never per step.  Prefers the
+    runtime's O(1) ``device.memory_stats()`` (real allocator numbers
+    on TPU); the ``jax.live_arrays()`` fallback walks every buffer
+    (milliseconds on a large process), so it is additionally
+    rate-limited to once per ``MXTPU_TELEMETRY_MEM_INTERVAL``
+    seconds."""
+    try:
+        import jax
+
+        nbytes = 0
+        for dev in jax.local_devices():
+            try:
+                stats = getattr(dev, "memory_stats", lambda: None)()
+            except Exception:
+                stats = None  # unimplemented on some PJRT plugins:
+                # treat like a None return so the fallback still runs
+            if not stats:
+                nbytes = 0
+                break
+            nbytes += int(stats.get("peak_bytes_in_use",
+                                    stats.get("bytes_in_use", 0)))
+        if not nbytes:
+            now = time.monotonic()
+            if now - _last_mem_sample[0] < _MEM_MIN_INTERVAL:
+                return
+            _last_mem_sample[0] = now
+            nbytes = sum(int(a.nbytes) for a in jax.live_arrays())
+    except Exception:
+        return
+    with _lock:
+        if nbytes > _METRICS["mem_watermark"]:
+            _METRICS["mem_watermark"] = nbytes
+    from . import profiler as _prof
+
+    _prof.max_stat("device_mem_watermark_bytes", nbytes)
+
+
+def current_step() -> int:
+    """The latest COMPLETED step id (0 before any step).  Producers
+    stamping in-flight work (a push, a compile) therefore tag it with
+    the previous step's id — the documented join rule is "events of
+    step N carry step == N-1" (`docs/observability.md`)."""
+    with _lock:
+        return _METRICS["steps"]
+
+
+def events(kind: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Snapshot of the ring (oldest first), optionally one kind."""
+    evs = list(_RING)
+    if kind is not None:
+        evs = [e for e in evs if e.get("kind") == kind]
+    return evs
+
+
+def clear() -> None:
+    """Drop all ring records and reset the step metrics (tests)."""
+    _RING.clear()
+    with _lock:
+        _METRICS.update(steps=0, examples=0.0, dt_sum=0.0, dt_last=0.0,
+                        last_t=None, nonfinite=0, mem_watermark=0)
+
+
+def metrics() -> Dict[str, Any]:
+    """Always-on per-step training metrics of THIS process: step
+    count, latency (last/avg seconds), examples/sec over the run,
+    non-finite steps skipped, device-memory watermark bytes."""
+    with _lock:
+        dt_sum = _METRICS["dt_sum"]
+        return {
+            "steps": _METRICS["steps"],
+            "examples": _METRICS["examples"],
+            "step_time_last_s": _METRICS["dt_last"],
+            "step_time_avg_s": dt_sum / max(1, _METRICS["steps"]),
+            "examples_per_sec": (_METRICS["examples"] / dt_sum)
+            if dt_sum > 0 else 0.0,
+            "nonfinite_steps": _METRICS["nonfinite"],
+            "device_mem_watermark_bytes": _METRICS["mem_watermark"],
+        }
+
+
+def snapshot(max_events: Optional[int] = None) -> Dict[str, Any]:
+    """This process's full telemetry state: identity + wall-clock
+    timestamp + ``profiler.stats()`` + :func:`metrics` + ring events.
+    The unit that ships over the heartbeat and lands in the per-role
+    ``telemetry_*.json`` files."""
+    from . import profiler as _prof
+
+    evs = events()
+    if max_events is not None and len(evs) > max_events:
+        evs = evs[-max_events:]
+    snap = identity()
+    snap["ts"] = time.time()
+    snap["stats"] = _prof.stats()
+    snap["metrics"] = metrics()
+    snap["events"] = evs
+    return snap
+
+
+def hb_payload() -> Optional[Dict[str, Any]]:
+    """Snapshot a role attaches to its scheduler heartbeat (capped at
+    ``MXTPU_TELEMETRY_HB_EVENTS`` recent events); None when off."""
+    if not _ENABLED:
+        return None
+    return snapshot(max_events=_HB_EVENTS)
+
+
+def aggregate_stats(stat_dicts) -> Dict[str, int]:
+    """Fold per-node counter snapshots into one cluster view: additive
+    counters sum, :data:`GAUGE_STATS` take the max."""
+    out: Dict[str, int] = {}
+    for stats in stat_dicts:
+        for k, v in (stats or {}).items():
+            if k in GAUGE_STATS:
+                out[k] = max(out.get(k, 0), int(v))
+            else:
+                out[k] = out.get(k, 0) + int(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+_FLIGHT = {"dir": None, "signals_installed": False,
+           "hooks_installed": False, "prev_handlers": {},
+           "prev_excepthook": None, "prev_threadhook": None}
+
+
+def _flight_dir() -> Optional[str]:
+    return _FLIGHT["dir"] or getenv("MXTPU_TELEMETRY_DIR")
+
+
+def _thread_stacks() -> Dict[str, List[str]]:
+    """All-thread stack traces, formatted (the post-mortem hang
+    answer: WHERE was every thread when the trigger fired)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for tid, frame in sys._current_frames().items():
+        key = "%s-%d" % (names.get(tid, "thread"), tid)
+        out[key] = traceback.format_stack(frame)
+    return out
+
+
+def _write_json(path: str, payload: Dict[str, Any]) -> Optional[str]:
+    """Atomic write (temp + fsync + rename via resilience) so a crash
+    mid-dump never leaves a truncated file a post-mortem tool would
+    trust.  Returns None instead of raising — dump paths run inside
+    signal handlers and excepthooks."""
+    try:
+        from .resilience import atomic_write
+
+        with atomic_write(path, "w") as f:
+            json.dump(payload, f, default=str)
+    except Exception:
+        return None
+    return path
+
+
+def _flight_target(d: str, role: str, rank: int, pid: int) -> str:
+    """Pick the path a flight dump lands at.  The base name is
+    ``flight_<role><rank>.json`` — but a FRESH record there written by
+    a DIFFERENT process (e.g. the posthumous corpse of the dead worker
+    whose rank this survivor inherited after an elastic re-rank) must
+    not be clobbered, so the dump diverts to a pid-suffixed sibling
+    (still ``flight_*.json``, so the merge index picks both up).
+    Records from a previous run (mtime before this process started)
+    are stale and fair game."""
+    base = os.path.join(d, "flight_%s%d.json" % (role, rank))
+    try:
+        if os.path.getmtime(base) < _START_TIME:
+            return base  # leftover from an earlier run
+        with open(base) as f:
+            existing = json.load(f)
+        if int(existing.get("pid", -1)) == pid:
+            return base  # our own earlier dump: newer state wins
+    except (OSError, ValueError):
+        return base
+    return os.path.join(d, "flight_%s%d_pid%d.json" % (role, rank, pid))
+
+
+def dump_flight(reason: str, detail: str = "",
+                directory: Optional[str] = None) -> Optional[str]:
+    """Dump the flight record — ring events, counter snapshot, step
+    metrics, all-thread stacks — as ``flight_<role><rank>.json`` in
+    ``directory`` (default ``MXTPU_TELEMETRY_DIR``).  Returns the path
+    or None (disabled / no directory / IO failure — never raises)."""
+    d = directory or _flight_dir()
+    if not _ENABLED or not d:
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+        payload = snapshot()
+        payload["reason"] = str(reason)
+        if detail:
+            payload["detail"] = str(detail)[:2000]
+        payload["threads"] = _thread_stacks()
+        record("flight", trigger=str(reason))
+        path = _flight_target(d, payload["role"], payload["rank"],
+                              payload["pid"])
+        out = _write_json(path, payload)
+    except Exception:
+        return None
+    if out:
+        from . import profiler as _prof
+
+        _prof.inc_stat("flight_dumps")
+    return out
+
+
+def dump_flight_for(snap: Dict[str, Any], reason: str,
+                    directory: Optional[str] = None) -> Optional[str]:
+    """POSTHUMOUS flight record: the scheduler writes the dead node's
+    last heartbeat-shipped snapshot on its behalf when it declares the
+    node dead — a SIGKILLed rank cannot dump its own corpse, but its
+    last known step/round/counters are still on record."""
+    d = directory or _flight_dir()
+    if not _ENABLED or not d or not isinstance(snap, dict):
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+        payload = dict(snap)
+        payload["reason"] = str(reason)
+        payload["posthumous"] = True
+        payload["declared_ts"] = time.time()
+        role = payload.get("role", "node")
+        rank = int(payload.get("rank", 0))
+        pid = int(payload.get("pid", 0))
+        path = os.path.join(d, "flight_%s%d.json" % (role, rank))
+        try:
+            if os.path.getmtime(path) >= _START_TIME:
+                # a fresh record already sits at the canonical name.
+                # Same pid: the node dumped its OWN richer record (e.g.
+                # SIGTERM then silence) — never clobber it with this
+                # staler snapshot.  Different pid: a DIFFERENT
+                # incarnation died there earlier this run (elastic
+                # respawn at the same rank) — divert to a pid-suffixed
+                # sibling so the second death still leaves its corpse.
+                with open(path) as f:
+                    if int(json.load(f).get("pid", -1)) == pid:
+                        return None
+                path = os.path.join(
+                    d, "flight_%s%d_pid%d.json" % (role, rank, pid))
+        except (OSError, ValueError):
+            pass  # stale leftover / unreadable: the canonical name
+        return _write_json(path, payload)
+    except Exception:
+        return None
+
+
+def _flight_signal_handler(signum, frame):
+    try:
+        name = signal.Signals(signum).name
+    except ValueError:
+        name = str(signum)
+    dump_flight("signal", name)
+    from .resilience import chain_prev_signal
+
+    chain_prev_signal(_FLIGHT["prev_handlers"].get(signum),
+                      signum, frame)
+
+
+def _flight_excepthook(exc_type, exc, tb):
+    dump_flight("exception", "%s: %s" % (exc_type.__name__, exc))
+    prev = _FLIGHT["prev_excepthook"] or sys.__excepthook__
+    prev(exc_type, exc, tb)
+
+
+def _flight_threadhook(args):
+    dump_flight("thread_exception", "%s: %s in %r"
+                % (getattr(args.exc_type, "__name__", "?"),
+                   args.exc_value, getattr(args.thread, "name", "?")))
+    prev = _FLIGHT["prev_threadhook"]
+    if prev is not None:
+        prev(args)
+
+
+def install_flight_recorder(directory: Optional[str] = None,
+                            signals=(signal.SIGTERM, signal.SIGQUIT)
+                            ) -> None:
+    """Arm the flight recorder: set the dump directory (default
+    ``MXTPU_TELEMETRY_DIR``), chain SIGTERM/SIGQUIT handlers (previous
+    disposition still runs — the process dies as before, with a corpse
+    on disk), and wrap sys/threading excepthooks so an unhandled
+    exception dumps too.  Idempotent; signal install is skipped off
+    the main thread (hooks still arm)."""
+    if directory is not None:
+        _FLIGHT["dir"] = os.path.abspath(directory)
+    if not _FLIGHT["hooks_installed"]:
+        _FLIGHT["prev_excepthook"] = sys.excepthook
+        sys.excepthook = _flight_excepthook
+        if hasattr(threading, "excepthook"):
+            _FLIGHT["prev_threadhook"] = threading.excepthook
+            threading.excepthook = _flight_threadhook
+        _FLIGHT["hooks_installed"] = True
+    if not _FLIGHT["signals_installed"]:
+        try:
+            for sig in signals:
+                _FLIGHT["prev_handlers"][sig] = signal.signal(
+                    sig, _flight_signal_handler)
+            _FLIGHT["signals_installed"] = True
+        except ValueError:
+            pass  # not the main thread
+
+
+def uninstall_flight_recorder() -> None:
+    """Restore the previous signal handlers and excepthooks (tests)."""
+    if _FLIGHT["signals_installed"]:
+        for sig, prev in _FLIGHT["prev_handlers"].items():
+            try:
+                signal.signal(sig, prev if prev is not None
+                              else signal.SIG_DFL)
+            except (ValueError, TypeError):
+                pass
+        _FLIGHT["prev_handlers"].clear()
+        _FLIGHT["signals_installed"] = False
+    if _FLIGHT["hooks_installed"]:
+        sys.excepthook = _FLIGHT["prev_excepthook"] or sys.__excepthook__
+        if hasattr(threading, "excepthook") and \
+                _FLIGHT["prev_threadhook"] is not None:
+            threading.excepthook = _FLIGHT["prev_threadhook"]
+        _FLIGHT["hooks_installed"] = False
+    _FLIGHT["dir"] = None
+
+
+def flush(directory: Optional[str] = None) -> Optional[str]:
+    """Write this process's final snapshot as
+    ``telemetry_<role><rank>.json`` (the per-role unit
+    :func:`merge_dir` consumes).  Called at exit when
+    ``MXTPU_TELEMETRY_DIR`` is set; server/scheduler roles call it
+    explicitly before their hard ``os._exit``."""
+    d = directory or _flight_dir()
+    if not _ENABLED or not d:
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+        snap = snapshot()
+        path = os.path.join(d, "telemetry_%s%d.json"
+                            % (snap["role"], snap["rank"]))
+        return _write_json(path, snap)
+    except Exception:
+        return None
+
+
+if getenv("MXTPU_TELEMETRY_DIR") and _ENABLED:
+    # a launched role: arm the crash paths and flush a final snapshot
+    # on clean interpreter exit (roles that hard-exit call flush()
+    # themselves — see kvstore_server.init_module)
+    import atexit
+
+    install_flight_recorder()
+    atexit.register(flush)
+
+if hasattr(os, "register_at_fork"):
+    # fork-without-exec children (DataLoader pool workers) are
+    # HELPERS, not roles: they inherit the armed SIGTERM handler and
+    # the parent's role/rank, so routine pool.terminate() would leave
+    # crash-style flight corpses under the parent's name — and the
+    # first one would claim flight_<role><rank>.json, blocking the
+    # scheduler's posthumous record for the real worker.  Disarm in
+    # the child; a process that execs (launch.py roles) re-imports and
+    # re-arms itself.
+    os.register_at_fork(after_in_child=uninstall_flight_recorder)
+
+
+# ---------------------------------------------------------------------------
+# Merging (per-role files -> one chrome trace + one cluster view)
+# ---------------------------------------------------------------------------
+
+def _role_key(snap: Dict[str, Any]) -> str:
+    return "%s%d" % (snap.get("role", "node"), int(snap.get("rank", 0)))
+
+
+def _events_to_chrome(snap: Dict[str, Any], t0: float) -> List[Dict]:
+    """Telemetry ring records -> chrome trace events.  Records carry
+    EPOCH timestamps, so alignment is just a shared origin ``t0``:
+    ``ts_us = (ts - t0) * 1e6``.  ``step`` records with a duration
+    render as complete (X) spans ending at their timestamp; everything
+    else is an instant."""
+    pid = int(snap.get("pid", 0))
+    out = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": "%s (pid %d)" % (_role_key(snap), pid)}}]
+    for ev in snap.get("events", []):
+        ts_us = (float(ev.get("ts", t0)) - t0) * 1e6
+        args = {k: v for k, v in ev.items()
+                if k not in ("kind", "ts", "pid", "role", "rank")}
+        dur = ev.get("dur_s")
+        if ev.get("kind") == "step" and dur:
+            # the record's ts is the step's END; when the start would
+            # fall before the merged origin, clip the DURATION too so
+            # the span still ends at its true instant
+            start = max(0.0, ts_us - float(dur) * 1e6)
+            out.append({"name": "step", "cat": "telemetry", "ph": "X",
+                        "ts": start, "dur": ts_us - start,
+                        "pid": pid, "tid": 0, "args": args})
+        else:
+            out.append({"name": ev.get("kind", "event"),
+                        "cat": "telemetry", "ph": "i", "ts": ts_us,
+                        "pid": pid, "tid": 0, "s": "p", "args": args})
+    return out
+
+
+def merge_traces(paths, out_path: Optional[str] = None) -> Dict[str, Any]:
+    """Merge per-role PROFILER chrome dumps into one trace.  Each dump
+    written by ``profiler.dump()`` stamps real pids into its events
+    and records ``otherData.epoch_origin_s`` — the wall-clock instant
+    its relative timestamps count from — so this shifts every file
+    onto the earliest origin and concatenates.  Returns the merged
+    trace dict (and writes it to ``out_path`` when given)."""
+    loaded = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                trace = json.load(f)
+        except (OSError, ValueError):
+            continue
+        origin = trace.get("otherData", {}).get("epoch_origin_s")
+        if origin is None:
+            # a foreign chrome trace with no epoch anchor cannot be
+            # placed on the shared axis; anchoring it at 0 would shift
+            # every OTHER file by ~50 years — fall back to the file's
+            # mtime as a rough anchor instead
+            try:
+                origin = os.path.getmtime(p)
+            except OSError:
+                continue
+        loaded.append((float(origin), trace))
+    if not loaded:
+        merged = {"traceEvents": [], "displayTimeUnit": "ms"}
+    else:
+        t0 = min(origin for origin, _ in loaded)
+        evs: List[Dict] = []
+        for origin, trace in loaded:
+            shift_us = (origin - t0) * 1e6
+            for ev in trace.get("traceEvents", []):
+                ev = dict(ev)
+                if ev.get("ph") != "M" and "ts" in ev:
+                    ev["ts"] = float(ev["ts"]) + shift_us
+                evs.append(ev)
+        merged = {"traceEvents": evs, "displayTimeUnit": "ms",
+                  "otherData": {"epoch_origin_s": t0}}
+    if out_path:
+        _write_json(out_path, merged)
+    return merged
+
+
+def merge_dir(directory: str, out_trace: str = "merged_trace.json",
+              out_cluster: str = "cluster.json") -> Dict[str, Any]:
+    """Fold a telemetry directory — ``telemetry_<role><rank>.json``
+    final snapshots, ``flight_*.json`` corpses, and any
+    ``trace_*.json`` profiler dumps — into:
+
+      * ``merged_trace.json``: ONE chrome trace with a process row per
+        role-rank and all clocks aligned on the earliest epoch
+        timestamp seen;
+      * ``cluster.json``: the merged counter view — per-role stats +
+        step metrics, the cluster aggregate (:func:`aggregate_stats`),
+        per-rank average step time, the straggler spread
+        (slowest/fastest worker avg step time), retry + failover
+        totals, and the flight-record index.
+
+    Returns the cluster dict."""
+    snaps: Dict[str, Dict[str, Any]] = {}
+    flights: List[Dict[str, Any]] = []
+    names = sorted(os.listdir(directory))
+    for name in names:
+        path = os.path.join(directory, name)
+        if name.startswith("telemetry_") and name.endswith(".json"):
+            try:
+                with open(path) as f:
+                    snap = json.load(f)
+            except (OSError, ValueError):
+                continue
+            snaps[_role_key(snap)] = snap
+        elif name.startswith("flight_") and name.endswith(".json"):
+            try:
+                with open(path) as f:
+                    fl = json.load(f)
+            except (OSError, ValueError):
+                continue
+            flights.append({
+                "file": name,
+                "role": fl.get("role"), "rank": fl.get("rank"),
+                "reason": fl.get("reason"),
+                "posthumous": bool(fl.get("posthumous")),
+                "last_step": (fl.get("metrics") or {}).get("steps"),
+                "last_round": (fl.get("stats") or {}).get(
+                    "kvstore_round_last"),
+            })
+            # a corpse's events belong on the timeline too (dead nodes
+            # wrote no final telemetry_ snapshot)
+            key = _role_key(fl)
+            if key not in snaps:
+                snaps[key] = fl
+
+    # per-role profiler chrome dumps (trace_*.json) join the timeline
+    # too; the shared origin t0 must be the EARLIEST instant any
+    # source knows about — telemetry records carry epoch timestamps
+    # directly, profiler dumps carry an epoch origin for their ts=0
+    prof_paths = [os.path.join(directory, n) for n in names
+                  if n.startswith("trace_") and n.endswith(".json")]
+    prof_merged = merge_traces(prof_paths) if prof_paths else None
+    all_ts = [float(ev["ts"]) for s in snaps.values()
+              for ev in s.get("events", []) if "ts" in ev]
+    if prof_merged and prof_merged.get("traceEvents"):
+        all_ts.append(float(prof_merged["otherData"]["epoch_origin_s"]))
+    t0 = min(all_ts) if all_ts else time.time()
+    trace_events: List[Dict] = []
+    for snap in snaps.values():
+        trace_events.extend(_events_to_chrome(snap, t0))
+    if prof_merged and prof_merged.get("traceEvents"):
+        shift_us = (float(prof_merged["otherData"]["epoch_origin_s"])
+                    - t0) * 1e6
+        for ev in prof_merged["traceEvents"]:
+            if ev.get("ph") != "M" and "ts" in ev:
+                ev["ts"] = float(ev["ts"]) + shift_us
+            trace_events.append(ev)
+    merged = {"traceEvents": trace_events, "displayTimeUnit": "ms",
+              "otherData": {"epoch_origin_s": t0}}
+    _write_json(os.path.join(directory, out_trace), merged)
+
+    per_rank_step = {}
+    for key, snap in snaps.items():
+        m = snap.get("metrics") or {}
+        if m.get("steps"):
+            per_rank_step[key] = m.get("step_time_avg_s", 0.0)
+    worker_avgs = [v for k, v in per_rank_step.items()
+                   if k.startswith("worker") and v > 0]
+    aggregate = aggregate_stats(s.get("stats") for s in snaps.values())
+    cluster = {
+        "roles": {k: {"pid": s.get("pid"), "stats": s.get("stats", {}),
+                      "metrics": s.get("metrics", {})}
+                  for k, s in snaps.items()},
+        "aggregate": aggregate,
+        "gauge_stats": list(GAUGE_STATS),
+        "per_rank_step_time_s": per_rank_step,
+        "straggler_spread_s": (max(worker_avgs) - min(worker_avgs))
+        if worker_avgs else 0.0,
+        "retry_total": sum(v for k, v in aggregate.items()
+                           if k.startswith("retry_attempts::")),
+        "failover_total": aggregate.get("elastic_failover", 0),
+        "flights": flights,
+    }
+    _write_json(os.path.join(directory, out_cluster), cluster)
+    return cluster
+
+
+# ---------------------------------------------------------------------------
+# Speedometer-style callback (gluon loops)
+# ---------------------------------------------------------------------------
+
+class Speedometer(object):
+    """Per-batch callable for gluon training loops that logs the LIVE
+    telemetry metrics every ``frequent`` batches — the
+    `mxtpu.callback.Speedometer` idiom, but fed by the always-on
+    telemetry stream instead of its own clock, so the numbers it
+    prints are the same ones ``kv.telemetry()`` aggregates::
+
+        speedo = telemetry.Speedometer(frequent=50)
+        for batch in loader:
+            ...; trainer.step(bs)
+            speedo()
+    """
+
+    def __init__(self, frequent: int = 50, logger=None):
+        import logging
+
+        self.frequent = max(1, int(frequent))
+        self.logger = logger or logging.getLogger(__name__)
+        self._count = 0
+
+    def __call__(self, *_args) -> None:
+        self._count += 1
+        if self._count % self.frequent:
+            return
+        m = metrics()
+        self.logger.info(
+            "telemetry: step %d\t%.1f samples/sec\tstep %.1f ms "
+            "(avg %.1f ms)\tnonfinite %d\tmem watermark %.1f MB",
+            m["steps"], m["examples_per_sec"],
+            m["step_time_last_s"] * 1e3, m["step_time_avg_s"] * 1e3,
+            m["nonfinite_steps"],
+            m["device_mem_watermark_bytes"] / 1e6)

@@ -1,10 +1,13 @@
 """Profiler + monitor + visualization tests (reference:
 `tests/python/unittest/test_profiler.py`)."""
 import json
+import logging
 import os
 import tempfile
+import threading
 
 import numpy as np
+import pytest
 
 import mxtpu as mx
 from mxtpu import nd, sym, profiler
@@ -58,6 +61,115 @@ def test_profiler_task_counter_marker():
     assert "unit::work" in profiler.dumps(reset=True)
 
 
+def test_profiler_pause_gates_spans_and_markers():
+    """Satellite: the pause/resume gate applies to every recording
+    surface — is_recording(), spans taken through the public span()
+    helper, counters, and markers: NOTHING recorded during pause may
+    appear in the dump."""
+    profiler.set_config(profile_all=True)
+    profiler.set_state("run")
+    assert profiler.is_recording("imperative")
+    profiler.pause()
+    assert not profiler.is_recording("imperative")
+    assert not profiler.is_recording("symbolic")
+    profiler.Marker(None, "paused_mark").mark()
+    with profiler.span("paused_span", "operator"):
+        pass
+    profiler.record_counter("paused_counter", 1.0)
+    profiler.resume()
+    assert profiler.is_recording("imperative")
+    profiler.Marker(None, "live_mark").mark()
+    with profiler.span("live_span", "operator"):
+        pass
+    with tempfile.TemporaryDirectory() as td:
+        fname = os.path.join(td, "p.json")
+        profiler.set_config(filename=fname)
+        profiler.set_state("stop")
+        profiler.dump()
+        names = {e["name"] for e in
+                 json.load(open(fname))["traceEvents"]}
+    assert "live_mark" in names and "live_span" in names
+    assert "paused_mark" not in names
+    assert "paused_span" not in names
+    assert "paused_counter" not in names
+    profiler.dumps(reset=True)
+
+
+def test_profiler_dumps_json_aggregation():
+    profiler.set_config(profile_all=True)
+    profiler.dumps(reset=True)
+    profiler.set_state("run")
+    a = nd.ones((8, 8))
+    for _ in range(4):
+        nd.dot(a, a).wait_to_read()
+    profiler.set_state("stop")
+    rows = json.loads(profiler.dumps(reset=True, format="json"))
+    dot = next(r for r in rows if r["name"] == "dot")
+    assert dot["count"] == 4
+    assert dot["total_us"] >= dot["max_us"] >= dot["avg_us"] > 0
+    assert dot["min_us"] <= dot["avg_us"]
+    assert dot["total_us"] == pytest.approx(dot["avg_us"] * 4, rel=1e-6)
+
+
+def test_inc_stat_concurrent_threads():
+    """Satellite: inc_stat is lock-protected — concurrent bumps from
+    many threads must not lose increments."""
+    profiler.reset_stats()
+    n_threads, n_incs = 8, 500
+
+    def bump():
+        for _ in range(n_incs):
+            profiler.inc_stat("concurrency_probe")
+
+    threads = [threading.Thread(target=bump) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert profiler.get_stat("concurrency_probe") == n_threads * n_incs
+    profiler.reset_stats()
+
+
+def test_reset_stats_isolation():
+    profiler.inc_stat("isolation_probe", 3)
+    profiler.set_stat("isolation_gauge", 42)
+    assert profiler.stats()["isolation_probe"] == 3
+    profiler.reset_stats()
+    assert profiler.get_stat("isolation_probe") == 0
+    assert "isolation_probe" not in profiler.stats()
+    assert "isolation_gauge" not in profiler.stats()
+
+
+def test_set_and_max_stat_gauges():
+    profiler.reset_stats()
+    profiler.set_stat("gauge", 10)
+    profiler.set_stat("gauge", 4)       # absolute: overwrites down
+    assert profiler.get_stat("gauge") == 4
+    profiler.max_stat("watermark", 5)
+    profiler.max_stat("watermark", 3)   # watermark: never descends
+    assert profiler.get_stat("watermark") == 5
+    profiler.max_stat("watermark", 9)
+    assert profiler.get_stat("watermark") == 9
+    profiler.reset_stats()
+
+
+def test_profiler_sync_is_dynamic(monkeypatch):
+    """Satellite: MXTPU_PROFILER_SYNC is read per span, not latched at
+    import — flipping the env mid-run changes behavior, and a span
+    with attached device results blocks on exactly those."""
+    monkeypatch.delenv("MXTPU_PROFILER_SYNC", raising=False)
+    assert not profiler._sync_enabled()
+    monkeypatch.setenv("MXTPU_PROFILER_SYNC", "1")
+    assert profiler._sync_enabled()
+    profiler.set_config(profile_all=True)
+    profiler.set_state("run")
+    with profiler.span("sync_probe", "operator") as sp:
+        sp.result = nd.ones((16, 16))._data * 2  # block target
+    profiler.set_state("stop")
+    rows = json.loads(profiler.dumps(reset=True, format="json"))
+    assert any(r["name"] == "sync_probe" for r in rows)
+
+
 def _mlp():
     data = sym.Variable("data")
     fc1 = sym.FullyConnected(data=data, num_hidden=8, name="fc1")
@@ -78,6 +190,64 @@ def test_monitor_collects_stats():
     ex.forward(is_train=False, data=mx.nd.ones((4, 10)))
     res = mon.toc()
     assert res and any("softmax_output" in k for _, k, _v in res)
+
+
+def test_monitor_interval_and_monitor_all():
+    """Satellite: direct Monitor coverage — interval gating (only
+    every Nth tic collects), monitor_all pulls args/aux too, and the
+    pattern filter applies."""
+    from mxtpu.monitor import Monitor
+
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 10), softmax_label=(4,))
+    mon = Monitor(interval=2, monitor_all=True)
+    mon.install(ex)
+
+    mon.tic()  # step 0: activated
+    ex.forward(is_train=False, data=mx.nd.ones((4, 10)))
+    res0 = mon.toc()
+    names0 = {k for _, k, _ in res0}
+    assert any("fc1_weight" in n for n in names0), names0  # args too
+
+    mon.tic()  # step 1: NOT activated (interval=2)
+    ex.forward(is_train=False, data=mx.nd.ones((4, 10)))
+    assert mon.toc() == []
+
+    mon.tic()  # step 2: activated again
+    ex.forward(is_train=False, data=mx.nd.ones((4, 10)))
+    assert mon.toc()
+
+
+def test_monitor_pattern_and_sort():
+    from mxtpu.monitor import Monitor
+
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 10), softmax_label=(4,))
+    mon = Monitor(interval=1, pattern=".*fc1.*", sort=True,
+                  monitor_all=True)
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=False, data=mx.nd.ones((4, 10)))
+    res = mon.toc()
+    assert res
+    names = [k for _, k, _ in res]
+    assert all("fc1" in n for n in names)
+    assert names == sorted(names)
+
+
+def test_monitor_custom_stat_and_toc_print(caplog):
+    from mxtpu.monitor import Monitor
+
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 10), softmax_label=(4,))
+    mon = Monitor(interval=1, stat_func=lambda x: x.max())
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=False, data=mx.nd.ones((4, 10)))
+    with caplog.at_level(logging.INFO):
+        mon.toc_print()
+    assert any("softmax_output" in r.getMessage()
+               for r in caplog.records)
 
 
 def test_print_summary():

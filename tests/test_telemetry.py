@@ -1,0 +1,500 @@
+"""Unified telemetry tests (`mxtpu/telemetry.py`,
+`docs/observability.md`): event ring, per-step metrics, flight
+recorder, cross-process merge.  The end-to-end multi-process path
+(heartbeat shipping, posthumous flight, launcher merge) is guarded by
+`tools/check_telemetry.py` via `tests/test_tools.py`."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import profiler, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.clear()
+    telemetry.set_identity("local", 0)
+    yield
+    telemetry.clear()
+    telemetry.enable(True)
+
+
+# ---------------------------------------------------------------------------
+# event ring
+# ---------------------------------------------------------------------------
+
+def test_record_identity_and_payload():
+    telemetry.set_identity("worker", 3)
+    telemetry.record("compile", site="executor:train", step=7)
+    (ev,) = telemetry.events("compile")
+    assert ev["role"] == "worker" and ev["rank"] == 3
+    assert ev["pid"] == os.getpid()
+    assert ev["site"] == "executor:train" and ev["step"] == 7
+    assert abs(ev["ts"] - time.time()) < 5  # epoch, not relative
+    telemetry.set_identity("local", 0)
+
+
+def test_ring_is_bounded():
+    n = telemetry._RING.maxlen
+    for i in range(n + 50):
+        telemetry.record("step", step=i)
+    evs = telemetry.events()
+    assert len(evs) == n
+    # oldest dropped, newest kept
+    assert evs[-1]["step"] == n + 49
+
+
+def test_disable_is_a_noop():
+    telemetry.enable(False)
+    telemetry.record("step", step=1)
+    assert telemetry.record_step(batch_size=4) == 0
+    assert telemetry.events() == []
+    assert telemetry.metrics()["steps"] == 0
+    telemetry.enable(True)
+
+
+def test_none_fields_dropped():
+    telemetry.record("step", step=1, skipped=None)
+    (ev,) = telemetry.events("step")
+    assert "skipped" not in ev
+
+
+# ---------------------------------------------------------------------------
+# per-step metrics
+# ---------------------------------------------------------------------------
+
+def test_record_step_metrics_and_gauges():
+    s1 = telemetry.record_step(batch_size=8, duration=0.01)
+    s2 = telemetry.record_step(batch_size=8, duration=0.03)
+    assert (s1, s2) == (1, 2)
+    m = telemetry.metrics()
+    assert m["steps"] == 2 and m["examples"] == 16.0
+    assert m["step_time_last_s"] == pytest.approx(0.03)
+    assert m["step_time_avg_s"] == pytest.approx(0.02)
+    assert m["examples_per_sec"] == pytest.approx(16.0 / 0.04)
+    # surfaced through profiler.stats() too
+    stats = profiler.stats()
+    assert stats["telemetry_steps"] >= 2
+    assert stats["step_time_us_last"] == 30000
+
+
+def test_record_step_skipped_counts_nonfinite():
+    telemetry.record_step(batch_size=4, duration=0.01, skipped=True)
+    assert telemetry.metrics()["nonfinite_steps"] == 1
+    (ev,) = telemetry.events("step")
+    assert ev["skipped"] is True
+
+
+def test_fused_step_record_counts_k():
+    telemetry.record_step(batch_size=4, n=8, duration=0.08,
+                          site="fused_train")
+    m = telemetry.metrics()
+    assert m["steps"] == 8 and m["examples"] == 32.0
+    assert m["step_time_last_s"] == pytest.approx(0.01)  # per step
+    (ev,) = telemetry.events("step")
+    assert ev["n"] == 8 and ev["site"] == "fused_train"
+
+
+def test_trainer_step_records_telemetry():
+    from mxtpu import autograd, gluon
+
+    net = gluon.nn.Dense(2)
+    net.initialize(ctx=mx.cpu())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    x = mx.nd.ones((4, 3))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    before = telemetry.current_step()
+    trainer.step(4)
+    assert telemetry.current_step() == before + 1
+    ev = telemetry.events("step")[-1]
+    assert ev["site"] == "trainer" and ev["batch"] == 4
+
+
+def test_module_update_records_telemetry():
+    x = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 3))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer()
+    from mxtpu.io.io import DataBatch
+
+    mod.forward(DataBatch(data=[mx.nd.ones((4, 3))],
+                          label=[mx.nd.zeros((4,))]), is_train=True)
+    mod.backward()
+    before = telemetry.current_step()
+    mod.update()
+    assert telemetry.current_step() == before + 1
+    ev = telemetry.events("step")[-1]
+    assert ev["site"] == "module"
+    # a bind on a fresh module records compile events for new sigs
+    assert any(e["site"].startswith("executor:")
+               for e in telemetry.events("compile"))
+
+
+def test_monitor_events_share_step_id():
+    from mxtpu.monitor import Monitor
+
+    x = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    telemetry.record_step(batch_size=2, duration=0.01)
+    step_id = telemetry.current_step()
+    mon = Monitor(interval=1)
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=False, data=mx.nd.ones((2, 3)))
+    res = mon.toc()
+    assert res
+    evs = telemetry.events("monitor")
+    assert evs and all(e["step"] == step_id for e in evs)
+    assert any("fc_output" in e["name"] for e in evs)
+
+
+def test_speedometer_logs(caplog):
+    import logging
+
+    telemetry.record_step(batch_size=4, duration=0.01)
+    speedo = telemetry.Speedometer(frequent=2)
+    with caplog.at_level(logging.INFO, logger="mxtpu.telemetry"):
+        speedo()
+        assert not caplog.records  # not yet at the reporting cadence
+        speedo()
+    assert any("samples/sec" in r.getMessage() for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# snapshot / aggregation
+# ---------------------------------------------------------------------------
+
+def test_snapshot_shape_and_hb_cap():
+    for i in range(100):
+        telemetry.record("step", step=i)
+    snap = telemetry.snapshot(max_events=10)
+    assert set(snap) >= {"role", "rank", "pid", "ts", "stats",
+                         "metrics", "events"}
+    assert len(snap["events"]) == 10
+    assert snap["events"][-1]["step"] == 99
+    hb = telemetry.hb_payload()
+    assert hb is not None and len(hb["events"]) <= 64
+    telemetry.enable(False)
+    assert telemetry.hb_payload() is None
+    telemetry.enable(True)
+
+
+def test_aggregate_stats_sums_counters_maxes_gauges():
+    agg = telemetry.aggregate_stats([
+        {"telemetry_steps": 3, "step_time_us_last": 100,
+         "device_mem_watermark_bytes": 5},
+        {"telemetry_steps": 4, "step_time_us_last": 70,
+         "device_mem_watermark_bytes": 9},
+        None,
+    ])
+    assert agg["telemetry_steps"] == 7
+    assert agg["step_time_us_last"] == 100
+    assert agg["device_mem_watermark_bytes"] == 9
+
+
+def test_kv_telemetry_local_backend():
+    kv = mx.kv.create("local")
+    telemetry.record_step(batch_size=2, duration=0.01)
+    view = kv.telemetry()
+    assert "local" in view["nodes"]
+    assert view["aggregate"].get("telemetry_steps", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_dump_flight_contents(tmp_path):
+    telemetry.set_identity("worker", 2)
+    telemetry.record("kvstore", op="push", round=5)
+    profiler.max_stat("kvstore_round_last", 5)
+    path = telemetry.dump_flight("unit_test", "details here",
+                                 directory=str(tmp_path))
+    telemetry.set_identity("local", 0)
+    assert path and path.endswith("flight_worker2.json")
+    fl = json.load(open(path))
+    assert fl["reason"] == "unit_test" and fl["detail"] == "details here"
+    assert fl["stats"]["kvstore_round_last"] >= 5
+    assert any(e["kind"] == "kvstore" for e in fl["events"])
+    # all-thread stacks present, main thread included
+    assert any("MainThread" in k for k in fl["threads"])
+    assert any("dump_flight" in "".join(v) for v in fl["threads"].values())
+
+
+def test_dump_flight_without_dir_is_noop():
+    saved = telemetry._FLIGHT["dir"]
+    telemetry._FLIGHT["dir"] = None
+    try:
+        if not os.environ.get("MXTPU_TELEMETRY_DIR"):
+            assert telemetry.dump_flight("nowhere") is None
+    finally:
+        telemetry._FLIGHT["dir"] = saved
+
+
+def test_dump_flight_for_posthumous(tmp_path):
+    snap = {"role": "worker", "rank": 1, "pid": 999,
+            "stats": {"kvstore_round_last": 3},
+            "metrics": {"steps": 3},
+            "events": [{"kind": "step", "ts": time.time(), "step": 3}]}
+    path = telemetry.dump_flight_for(snap, "declared_dead",
+                                     directory=str(tmp_path))
+    assert path and path.endswith("flight_worker1.json")
+    fl = json.load(open(path))
+    assert fl["posthumous"] is True and fl["reason"] == "declared_dead"
+    assert fl["stats"]["kvstore_round_last"] == 3
+
+
+def test_posthumous_never_clobbers_self_dump(tmp_path):
+    """A node that managed to dump its OWN flight record (thread
+    stacks, final ring) must not have it overwritten by the
+    scheduler's staler heartbeat-snapshot version."""
+    telemetry.set_identity("worker", 1)
+    own = telemetry.dump_flight("signal", "SIGTERM",
+                                directory=str(tmp_path))
+    telemetry.set_identity("local", 0)
+    assert own
+    # the posthumous snapshot carries the SAME pid (same incarnation)
+    snap = {"role": "worker", "rank": 1, "pid": os.getpid(),
+            "stats": {}, "metrics": {}, "events": []}
+    assert telemetry.dump_flight_for(snap, "declared_dead",
+                                     directory=str(tmp_path)) is None
+    fl = json.load(open(own))
+    assert fl["reason"] == "signal" and "threads" in fl
+
+
+def test_posthumous_second_death_same_rank_diverts(tmp_path):
+    """--restart-workers: a respawned worker dying at the SAME rank
+    later in the run must still leave its corpse — diverted to a
+    pid-suffixed sibling, not silently dropped."""
+    first = {"role": "worker", "rank": 1, "pid": 111, "stats": {},
+             "metrics": {"steps": 3}, "events": []}
+    p1 = telemetry.dump_flight_for(first, "declared_dead",
+                                   directory=str(tmp_path))
+    assert p1 and p1.endswith("flight_worker1.json")
+    second = {"role": "worker", "rank": 1, "pid": 222, "stats": {},
+              "metrics": {"steps": 9}, "events": []}
+    p2 = telemetry.dump_flight_for(second, "declared_dead",
+                                   directory=str(tmp_path))
+    assert p2 and p2.endswith("flight_worker1_pid222.json")
+    assert json.load(open(p1))["metrics"]["steps"] == 3
+    assert json.load(open(p2))["metrics"]["steps"] == 9
+
+
+def test_flight_diverts_from_inherited_rank_corpse(tmp_path):
+    """An elastic re-rank can hand a survivor the dead worker's rank;
+    its own flight dump must not clobber the posthumous corpse — it
+    diverts to a pid-suffixed sibling the merge index still finds."""
+    corpse = {"role": "worker", "rank": 0, "pid": 999999,
+              "stats": {}, "metrics": {"steps": 3}, "events": []}
+    path = telemetry.dump_flight_for(corpse, "declared_dead",
+                                     directory=str(tmp_path))
+    assert path and path.endswith("flight_worker0.json")
+    telemetry.set_identity("worker", 0)  # survivor inherited rank 0
+    telemetry._FLIGHT["dir"] = str(tmp_path)
+    try:
+        own = telemetry.dump_flight("signal", "SIGTERM")
+    finally:
+        telemetry._FLIGHT["dir"] = None
+        telemetry.set_identity("local", 0)
+    assert own and own != path and "_pid%d" % os.getpid() in own
+    # the corpse survived intact, and both are merge-indexable
+    assert json.load(open(path))["metrics"]["steps"] == 3
+    cluster = telemetry.merge_dir(str(tmp_path))
+    assert len(cluster["flights"]) == 2
+
+
+def test_stale_flight_from_previous_run_is_replaced(tmp_path):
+    """A leftover flight file in a REUSED telemetry dir (mtime before
+    this process started) must not mask this run's posthumous dump."""
+    stale = tmp_path / "flight_worker1.json"
+    stale.write_text(json.dumps({"role": "worker", "rank": 1,
+                                 "metrics": {"steps": 77}}))
+    old = telemetry._START_TIME - 100
+    os.utime(stale, (old, old))
+    snap = {"role": "worker", "rank": 1, "pid": 4242, "stats": {},
+            "metrics": {"steps": 5}, "events": []}
+    path = telemetry.dump_flight_for(snap, "declared_dead",
+                                     directory=str(tmp_path))
+    assert path == str(stale)
+    assert json.load(open(path))["metrics"]["steps"] == 5
+
+
+def test_bad_steps_abort_dumps_flight(tmp_path, monkeypatch):
+    from mxtpu import resilience as res
+
+    monkeypatch.setenv("MXTPU_MAX_BAD_STEPS", "2")
+    telemetry._FLIGHT["dir"] = str(tmp_path)
+    try:
+        guard = res.BadStepGuard(site="unit")
+        guard.record(False)
+        with pytest.raises(mx.base.MXNetError):
+            guard.record(False)
+    finally:
+        telemetry._FLIGHT["dir"] = None
+    fl = json.load(open(tmp_path / "flight_local0.json"))
+    assert fl["reason"] == "bad_steps_abort"
+    assert "site=unit" in fl["detail"]
+
+
+_CRASH_SCRIPT = r"""
+import os, sys
+import mxtpu
+from mxtpu import telemetry
+telemetry.set_identity("worker", 0)
+telemetry.record("step", step=42)
+mode = sys.argv[1]
+if mode == "exception":
+    raise RuntimeError("synthetic crash")
+elif mode == "sigterm":
+    print("READY", flush=True)
+    import time
+    time.sleep(30)
+"""
+
+
+def _crash_env(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXTPU_TELEMETRY_DIR"] = str(tmp_path)
+    return env
+
+
+def test_flight_on_unhandled_exception(tmp_path):
+    r = subprocess.run([sys.executable, "-c", _CRASH_SCRIPT,
+                        "exception"], env=_crash_env(tmp_path),
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode != 0 and "synthetic crash" in r.stderr
+    fl = json.load(open(tmp_path / "flight_worker0.json"))
+    assert fl["reason"] == "exception"
+    assert "RuntimeError" in fl["detail"]
+    assert any(e.get("step") == 42 for e in fl["events"])
+
+
+def test_flight_on_sigterm(tmp_path):
+    proc = subprocess.Popen([sys.executable, "-c", _CRASH_SCRIPT,
+                             "sigterm"], env=_crash_env(tmp_path),
+                            stdout=subprocess.PIPE, text=True)
+    assert proc.stdout.readline().strip() == "READY"
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=60)
+    assert rc != 0  # previous disposition still ran: the process died
+    fl = json.load(open(tmp_path / "flight_worker0.json"))
+    assert fl["reason"] == "signal" and fl["detail"] == "SIGTERM"
+    # the interpreter also flushed its final snapshot? no — SIGTERM
+    # default disposition kills without atexit; only the flight file
+    # is guaranteed, and that is the point of the recorder
+    assert any(e.get("step") == 42 for e in fl["events"])
+
+
+# ---------------------------------------------------------------------------
+# merging
+# ---------------------------------------------------------------------------
+
+def _fake_snap(role, rank, t0, steps, pid):
+    evs = [{"kind": "step", "ts": t0 + 0.1 * (i + 1), "role": role,
+            "rank": rank, "pid": pid, "step": i + 1, "dur_s": 0.1,
+            "batch": 4} for i in range(steps)]
+    return {"role": role, "rank": rank, "pid": pid, "ts": t0 + 1,
+            "stats": {"telemetry_steps": steps,
+                      "step_time_us_last": 1000 * (rank + 1)},
+            "metrics": {"steps": steps,
+                        "step_time_avg_s": 0.1 * (rank + 1)},
+            "events": evs}
+
+
+def test_merge_dir_trace_and_cluster(tmp_path):
+    t0 = 1_700_000_000.0
+    for role, rank, steps, pid in (("worker", 0, 5, 100),
+                                   ("worker", 1, 5, 101),
+                                   ("server", 0, 3, 102)):
+        snap = _fake_snap(role, rank, t0, steps, pid)
+        with open(tmp_path / ("telemetry_%s%d.json" % (role, rank)),
+                  "w") as f:
+            json.dump(snap, f)
+    # a corpse with no final snapshot joins via its flight file
+    fl = _fake_snap("worker", 2, t0, 2, 103)
+    fl["reason"] = "declared_dead"
+    fl["posthumous"] = True
+    with open(tmp_path / "flight_worker2.json", "w") as f:
+        json.dump(fl, f)
+
+    cluster = telemetry.merge_dir(str(tmp_path))
+    trace = json.load(open(tmp_path / "merged_trace.json"))
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M"}
+    assert {"worker0 (pid 100)", "worker1 (pid 101)",
+            "server0 (pid 102)", "worker2 (pid 103)"} <= names
+    non_meta = [e for e in trace["traceEvents"] if e.get("ph") != "M"]
+    assert all(e["ts"] >= 0 for e in non_meta)
+    # clock alignment: same-epoch events land at the same merged ts
+    w0 = [e for e in non_meta if e["pid"] == 100 and e["ph"] == "X"]
+    w1 = [e for e in non_meta if e["pid"] == 101 and e["ph"] == "X"]
+    assert w0[0]["ts"] == pytest.approx(w1[0]["ts"], abs=1.0)
+
+    assert cluster["aggregate"]["telemetry_steps"] == 15
+    # gauge max (the worker-2 corpse, rank+1 scaling) — not a sum
+    assert cluster["aggregate"]["step_time_us_last"] == 3000
+    assert cluster["per_rank_step_time_s"]["worker0"] == \
+        pytest.approx(0.1)
+    # spread over ALL worker rows, corpse included (0.3 - 0.1)
+    assert cluster["straggler_spread_s"] == pytest.approx(0.2)
+    (flight,) = cluster["flights"]
+    assert flight["role"] == "worker" and flight["rank"] == 2
+    assert flight["posthumous"] and flight["last_step"] == 2
+
+
+def test_merge_traces_aligns_profiler_dumps(tmp_path):
+    t0 = 1_700_000_000.0
+    # two per-role dumps whose relative clocks start 2s apart: the same
+    # wall instant must land at the same merged timestamp
+    a = {"traceEvents": [{"name": "x", "ph": "X", "ts": 2e6,
+                          "dur": 10.0, "pid": 10, "tid": 0}],
+         "otherData": {"epoch_origin_s": t0}}
+    b = {"traceEvents": [{"name": "y", "ph": "X", "ts": 0.0,
+                          "dur": 10.0, "pid": 11, "tid": 0}],
+         "otherData": {"epoch_origin_s": t0 + 2.0}}
+    pa, pb = tmp_path / "trace_a.json", tmp_path / "trace_b.json"
+    pa.write_text(json.dumps(a))
+    pb.write_text(json.dumps(b))
+    merged = telemetry.merge_traces([str(pa), str(pb)],
+                                    str(tmp_path / "out.json"))
+    evs = {e["name"]: e for e in merged["traceEvents"]
+           if e.get("ph") != "M"}
+    assert evs["x"]["ts"] == pytest.approx(evs["y"]["ts"])
+    assert json.load(open(tmp_path / "out.json"))["traceEvents"]
+
+
+def test_profiler_dump_is_merge_ready(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "trace_local0.json"),
+                        profile_all=True)
+    profiler.set_state("run")
+    (mx.nd.ones((4, 4)) * 2).wait_to_read()
+    profiler.set_state("stop")
+    profiler.dump()
+    trace = json.load(open(tmp_path / "trace_local0.json"))
+    other = trace["otherData"]
+    assert abs(other["epoch_origin_s"] - time.time()) < 3600
+    assert other["pid"] == os.getpid()
+    metas = [e for e in trace["traceEvents"] if e.get("ph") == "M"]
+    assert any(e["name"] == "process_name" for e in metas)
+    spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert spans and all(e["pid"] == os.getpid() for e in spans)

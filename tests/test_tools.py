@@ -76,6 +76,18 @@ def test_check_elastic_smoke_guard():
     assert "check_elastic OK" in out
 
 
+def test_check_telemetry_guard():
+    """tools/check_telemetry.py: a 2x2 dist_sync run with a SIGKILLed
+    worker must stay observable — the merged chrome trace covers
+    scheduler + servers + workers with epoch-aligned clocks, the
+    scheduler writes a posthumous flight record naming the dead rank's
+    last round, per-role counter sums reconcile with the cluster view,
+    and kv.telemetry() serves the live scheduler view (see
+    mxtpu/telemetry.py, docs/observability.md)."""
+    out = _run(["tools/check_telemetry.py"], timeout=420)
+    assert "check_telemetry OK" in out
+
+
 @pytest.mark.slow
 def test_check_elastic_full_guard():
     """Full chaos gauntlet: SIGKILL one worker (respawned by

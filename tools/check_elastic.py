@@ -151,6 +151,10 @@ BASE_ENV = {
     "JAX_PLATFORMS": "cpu",
     "MXTPU_PS_HEARTBEAT_INTERVAL": "0.2",
     "MXTPU_DEAD_TIMEOUT": "1.5",
+    # the SIGKILLs below can land inside a persistent-cache write; a
+    # truncated entry in the SHARED suite cache (tests/conftest.py)
+    # segfaults later deserializing runs — keep chaos children out
+    "MXTPU_COMPILE_CACHE": "0",
 }
 
 

@@ -1,0 +1,410 @@
+#!/usr/bin/env python
+"""Unified-telemetry guard: a chaotic dist_sync run must stay observable.
+
+Drives ONE real multi-process `dist_sync` run (tools/launch.py: 1
+scheduler + 2 servers + 2 workers, `--telemetry-dir` armed) in which
+worker rank 1 SIGKILLs itself mid-round — the `check_elastic` failure
+mode — and fails (rc=1) unless the telemetry subsystem
+(`docs/observability.md`) leaves the full diagnosable record behind:
+
+  1. **merged timeline covers every role** — `merged_trace.json` (the
+     launcher's post-run merge) must contain process rows + events for
+     the scheduler, both servers and the surviving worker, with all
+     clocks on one epoch-aligned axis;
+  2. **the SIGKILLed rank leaves a corpse** — the scheduler's
+     dead-node detector must have written the POSTHUMOUS
+     `flight_worker1.json` from the victim's last heartbeat-shipped
+     snapshot, naming the dead rank's last completed kvstore round
+     (`stats.kvstore_round_last`) and last step;
+  3. **counter totals reconcile** — for every additive counter,
+     `cluster.json`'s aggregate must equal the independently
+     recomputed sum over the per-role `telemetry_*.json` files
+     (gauges — `telemetry.GAUGE_STATS` — take the max instead);
+  4. **the scheduler's live view agrees** — rank 0 dumps
+     `kv.telemetry()` before closing; it must list the scheduler +
+     both servers + both workers (the dead one included: its last
+     snapshot outlives it) and its per-node stats must show the dead
+     worker's steps stopping at the kill round;
+  5. the launcher must still exit nonzero (the SIGKILLed worker is a
+     real failure — telemetry must never paper over it).
+
+``--overhead`` (not wired into CI: wall-clock noise) times a local
+train loop with MXTPU_TELEMETRY=0 vs 1 and prints the relative cost;
+the committed numbers live in `docs/observability.md`.
+
+Usage: python tools/check_telemetry.py [--steps N] [--overhead]
+"""
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+# ---------------------------------------------------------------------------
+# child: one dist_sync training worker (run under tools/launch.py)
+# ---------------------------------------------------------------------------
+
+def run_worker(args):
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import profiler, telemetry
+    from mxtpu.io.io import DataBatch
+
+    profiler.set_config(profile_all=True)
+    profiler.set_state("run")
+
+    kv = mx.kv.create("dist_sync")
+    rank = kv.rank
+
+    mx.random.seed(11)
+    x = mx.sym.Variable("data")
+    y = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(x, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(h, label=y, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Uniform(0.1))
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1})
+
+    rng = np.random.RandomState(0)
+    for i in range(args.steps):
+        xb = rng.rand(4, 10).astype("float32")
+        yb = rng.randint(0, 3, (4,)).astype("float32")
+        mod.forward(DataBatch(data=[mx.nd.array(xb)],
+                              label=[mx.nd.array(yb)]), is_train=True)
+        mod.backward()
+        if rank == args.kill_rank and i + 1 == args.kill_step:
+            # die MID-ROUND (after backward, before the sync push):
+            # this round strands until the scheduler declares us dead
+            os.kill(os.getpid(), signal.SIGKILL)
+        mod.update()
+        time.sleep(args.step_sleep)
+
+    if rank == 0:
+        # hold the final rendezvous until the kill was DECLARED, so the
+        # posthumous flight record exists before the job tears down
+        deadline = time.time() + 60
+        while kv.live_workers > 1 and time.time() < deadline:
+            time.sleep(0.2)
+        view = kv.telemetry()
+        with open(args.sched_view, "w") as f:
+            json.dump(view, f, default=str)
+    kv.barrier()
+    kv.close()
+    # per-role profiler chrome dump: exercises the mergeable-trace
+    # identity (real pid + process_name + epoch origin)
+    tdir = os.environ.get("MXTPU_TELEMETRY_DIR")
+    if tdir:
+        profiler.set_config(filename=os.path.join(
+            tdir, "trace_worker%d.json" % rank))
+        profiler.dump()
+    telemetry.flush()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestration + assertions
+# ---------------------------------------------------------------------------
+
+BASE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "MXTPU_PS_HEARTBEAT_INTERVAL": "0.2",
+    "MXTPU_DEAD_TIMEOUT": "1.5",
+    # children get SIGKILLed mid-run by design; a kill landing inside
+    # a persistent-cache write can poison the SHARED suite cache
+    # (tests/conftest.py points every test at one dir) and a corrupt
+    # entry segfaults later deserializing runs — keep the chaos
+    # children out of it
+    "MXTPU_COMPILE_CACHE": "0",
+}
+
+
+def _sum_per_role(snaps):
+    """Independent re-aggregation of the per-role final snapshots."""
+    from mxtpu import telemetry
+
+    return telemetry.aggregate_stats(s.get("stats") for s in snaps)
+
+
+def run_check(args):
+    import subprocess
+
+    from mxtpu import telemetry
+
+    steps = args.steps
+    kill_step = max(2, steps // 3)
+    workdir = tempfile.mkdtemp(prefix="mxtpu_telemetry_")
+    tdir = os.path.join(workdir, "telemetry")
+    sched_view = os.path.join(workdir, "sched_view.json")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(BASE_ENV)
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "-s", "2", "--telemetry-dir", tdir,
+           sys.executable, os.path.abspath(__file__),
+           "--child", "worker", "--steps", str(steps),
+           "--kill-step", str(kill_step), "--kill-rank", "1",
+           "--step-sleep", str(args.step_sleep),
+           "--sched-view", sched_view]
+    logp = os.path.join(workdir, "log")
+    with open(logp, "wb") as logf:
+        proc = subprocess.Popen(cmd, env=env, stdout=logf,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        try:
+            rc = proc.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
+            rc = None
+    text = open(logp, "rb").read().decode(errors="replace")
+
+    failures = []
+    if rc is None:
+        print(text)
+        return ["run HUNG"]
+    if rc == 0:
+        failures.append("launcher exited 0 despite the SIGKILLed "
+                        "worker (telemetry must not mask failures)")
+
+    # 1. merged chrome trace covers all roles with aligned clocks
+    trace_path = os.path.join(tdir, "merged_trace.json")
+    if not os.path.exists(trace_path):
+        print(text)
+        failures.append("merged_trace.json missing (launcher merge)")
+        return failures
+    trace = json.load(open(trace_path))
+    evs = trace["traceEvents"]
+    proc_names = {e["args"]["name"] for e in evs
+                  if e.get("ph") == "M" and e.get("name") == "process_name"}
+    for want in ("scheduler0", "server0", "server1", "worker0"):
+        if not any(n.startswith(want + " ") for n in proc_names):
+            failures.append("merged trace has no process row for %r "
+                            "(rows: %s)" % (want, sorted(proc_names)))
+    pids_with_events = {e["pid"] for e in evs if e.get("ph") != "M"}
+    pids_named = {e["pid"] for e in evs if e.get("ph") == "M"}
+    if not pids_with_events - {0}:
+        failures.append("merged trace has no real-pid events")
+    if any(ts < 0 for ts in (e.get("ts", 0) for e in evs)):
+        failures.append("negative timestamps: clock alignment broken")
+    ts_all = [e["ts"] for e in evs if e.get("ph") != "M"]
+    if ts_all and (max(ts_all) - min(ts_all)) > 20 * 60 * 1e6:
+        failures.append("timeline spans >20min for a <1min run: "
+                        "epoch offsets were not applied")
+    if len(pids_named & pids_with_events) < 4:
+        failures.append("fewer than 4 named processes contributed "
+                        "events (roles missing from the timeline)")
+
+    # 2. posthumous flight record for the SIGKILLed rank
+    flight_path = os.path.join(tdir, "flight_worker1.json")
+    if not os.path.exists(flight_path):
+        failures.append("flight_worker1.json missing: the scheduler "
+                        "never wrote the posthumous flight record")
+    else:
+        fl = json.load(open(flight_path))
+        if not fl.get("posthumous"):
+            failures.append("flight_worker1.json not marked posthumous")
+        if fl.get("reason") != "declared_dead":
+            failures.append("flight reason %r != declared_dead"
+                            % fl.get("reason"))
+        last_round = (fl.get("stats") or {}).get("kvstore_round_last", 0)
+        last_step = (fl.get("metrics") or {}).get("steps", 0)
+        # the victim died entering round kill_step; its last shipped
+        # snapshot is at most one heartbeat (0.2s < step_sleep) stale
+        if not (kill_step - 2 <= last_round <= kill_step):
+            failures.append("flight names round %r, expected ~%d"
+                            % (last_round, kill_step - 1))
+        if not (kill_step - 2 <= last_step <= kill_step):
+            failures.append("flight names step %r, expected ~%d"
+                            % (last_step, kill_step - 1))
+        if not fl.get("events"):
+            failures.append("posthumous flight carries no events")
+
+    # 3. counter totals reconcile: cluster aggregate == sum of roles
+    cluster_path = os.path.join(tdir, "cluster.json")
+    if not os.path.exists(cluster_path):
+        failures.append("cluster.json missing")
+        return failures
+    cluster = json.load(open(cluster_path))
+    # one snapshot per role-rank, by the published contract
+    # (docs/observability.md): the final telemetry_ file, or — for a
+    # rank that died without writing one — its flight corpse
+    per_role = {}
+    for name in sorted(os.listdir(tdir)):
+        path = os.path.join(tdir, name)
+        if name.startswith("telemetry_") and name.endswith(".json"):
+            s = json.load(open(path))
+            per_role["%s%s" % (s.get("role"), s.get("rank"))] = s
+    for name in sorted(os.listdir(tdir)):
+        path = os.path.join(tdir, name)
+        if name.startswith("flight_") and name.endswith(".json"):
+            s = json.load(open(path))
+            per_role.setdefault(
+                "%s%s" % (s.get("role"), s.get("rank")), s)
+    if len(per_role) < 5:  # scheduler + 2 servers + 2 workers
+        failures.append("expected 5 per-role snapshots, got %s"
+                        % sorted(per_role))
+    want = _sum_per_role(per_role.values())
+    got = cluster.get("aggregate", {})
+    for key in sorted(set(want) | set(got)):
+        if key in telemetry.GAUGE_STATS:
+            continue
+        if want.get(key, 0) != got.get(key, 0):
+            failures.append(
+                "counter %r does not reconcile: sum-of-roles %s != "
+                "cluster view %s" % (key, want.get(key, 0),
+                                     got.get(key, 0)))
+    if not cluster.get("per_rank_step_time_s", {}).get("worker0"):
+        failures.append("cluster view has no worker0 step time")
+
+    # 4. the scheduler's live view (kv.telemetry() from rank 0)
+    if not os.path.exists(sched_view):
+        failures.append("rank 0 never dumped kv.telemetry()")
+    else:
+        view = json.load(open(sched_view))
+        nodes = view.get("nodes", {})
+        roles = sorted("%s%s" % (n.get("role"), n.get("rank"))
+                       for n in nodes.values())
+        for want_role in ("scheduler0", "server0", "server1",
+                          "worker0", "worker1"):
+            if want_role not in roles:
+                failures.append("scheduler view missing %r (has %s)"
+                                % (want_role, roles))
+        dead = next((n for n in nodes.values()
+                     if n.get("role") == "worker"
+                     and n.get("rank") == 1), None)
+        if dead is not None:
+            dsteps = (dead.get("metrics") or {}).get("steps", steps)
+            if dsteps > kill_step:
+                failures.append(
+                    "scheduler view shows dead worker at step %d, "
+                    "past its kill step %d" % (dsteps, kill_step))
+        if not view.get("aggregate", {}).get("telemetry_steps"):
+            failures.append("scheduler aggregate has no telemetry_steps")
+        # CROSS-SOURCE reconciliation (not circular like the
+        # cluster.json check above, which re-aggregates the same
+        # files): the scheduler's live view was built from
+        # heartbeat-SHIPPED snapshots, the per-role files were written
+        # at exit by each process independently.  Counters that went
+        # static well before the final query (training stopped, then
+        # rank 0 waited out the death declaration = many beats) must
+        # agree exactly across the two transports.
+        live = view.get("aggregate", {})
+        for key in ("telemetry_steps", "executor_train_trace"):
+            want_v = want.get(key, 0)
+            if live.get(key, 0) != want_v:
+                failures.append(
+                    "live scheduler aggregate disagrees with on-disk "
+                    "per-role sum for %r: %s (shipped) != %s (files)"
+                    % (key, live.get(key, 0), want_v))
+
+    if failures:
+        print(text)
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# overhead probe (manual; numbers committed in docs/observability.md)
+# ---------------------------------------------------------------------------
+
+_OVERHEAD_SCRIPT = r"""
+import os, sys, time
+import numpy as np
+import mxtpu as mx
+from mxtpu.io.io import DataBatch
+mx.random.seed(7)
+x = mx.sym.Variable("data"); y = mx.sym.Variable("softmax_label")
+h = mx.sym.FullyConnected(x, num_hidden=64, name="fc1")
+h = mx.sym.Activation(h, act_type="relu")
+h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+net = mx.sym.SoftmaxOutput(h, label=y, name="softmax")
+mod = mx.mod.Module(net, context=mx.cpu())
+mod.bind(data_shapes=[("data", (32, 128))],
+         label_shapes=[("softmax_label", (32,))])
+mod.init_params(); mod.init_optimizer()
+xb = mx.nd.array(np.random.rand(32, 128).astype("float32"))
+yb = mx.nd.array(np.zeros((32,), "float32"))
+batch = DataBatch(data=[xb], label=[yb])
+for _ in range(20):  # warmup (compile)
+    mod.forward(batch, is_train=True); mod.backward(); mod.update()
+n = int(sys.argv[1])
+t0 = time.perf_counter()
+for _ in range(n):
+    mod.forward(batch, is_train=True); mod.backward(); mod.update()
+mod.get_outputs()[0].wait_to_read()
+print((time.perf_counter() - t0) / n)
+"""
+
+
+def run_overhead(args):
+    import subprocess
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    results = {}
+    for flag in ("0", "1"):
+        env["MXTPU_TELEMETRY"] = flag
+        times = []
+        for _ in range(args.overhead_reps):
+            r = subprocess.run(
+                [sys.executable, "-c", _OVERHEAD_SCRIPT,
+                 str(args.overhead_iters)],
+                env=env, capture_output=True, text=True, timeout=600)
+            if r.returncode != 0:
+                print(r.stdout + r.stderr)
+                return 1
+            times.append(float(r.stdout.strip().splitlines()[-1]))
+        results[flag] = min(times)  # best-of: least scheduler noise
+        print("MXTPU_TELEMETRY=%s: %.1f us/step (best of %d)"
+              % (flag, results[flag] * 1e6, args.overhead_reps))
+    rel = (results["1"] - results["0"]) / results["0"] * 100.0
+    print("telemetry overhead: %+.2f%% per step" % rel)
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=9)
+    ap.add_argument("--child", choices=["worker"])
+    ap.add_argument("--kill-step", type=int, default=0)
+    ap.add_argument("--kill-rank", type=int, default=1)
+    ap.add_argument("--step-sleep", type=float, default=0.3)
+    ap.add_argument("--sched-view")
+    ap.add_argument("--overhead", action="store_true",
+                    help="measure MXTPU_TELEMETRY=0 vs 1 step cost")
+    ap.add_argument("--overhead-iters", type=int, default=300)
+    ap.add_argument("--overhead-reps", type=int, default=3)
+    args = ap.parse_args()
+    if args.child == "worker":
+        return run_worker(args)
+    if args.overhead:
+        return run_overhead(args)
+
+    failures = run_check(args)
+    if failures:
+        print("check_telemetry FAILURES:")
+        for f in failures:
+            print("  -", f)
+        return 1
+    print("check_telemetry OK: %d-step 2x2 dist_sync with a SIGKILLed "
+          "worker left a merged all-role timeline, a posthumous flight "
+          "record naming the dead rank's last round, reconciled "
+          "counter totals, and a live scheduler view" % args.steps)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,14 @@ def main(argv=None):
     ap.add_argument("--pid-dir", default=None,
                     help="write <role>-<i>.pid per child (chaos "
                          "harness hook)")
+    ap.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                    help="unified telemetry (docs/observability.md): "
+                         "every role dumps telemetry_<role><rank>.json "
+                         "(and flight_* on crash/kill) into DIR, and "
+                         "after the run the launcher merges them into "
+                         "merged_trace.json (one chrome trace, clocks "
+                         "aligned) + cluster.json (per-rank step time, "
+                         "straggler spread, counter totals)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
@@ -91,6 +99,10 @@ def main(argv=None):
     })
     if args.pid_dir:
         os.makedirs(args.pid_dir, exist_ok=True)
+    if args.telemetry_dir:
+        tdir = os.path.abspath(args.telemetry_dir)
+        os.makedirs(tdir, exist_ok=True)
+        base["MXTPU_TELEMETRY_DIR"] = tdir
 
     procs = []
 
@@ -173,7 +185,41 @@ def main(argv=None):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+    if args.telemetry_dir:
+        _merge_telemetry(base, tdir)
     return rc
+
+
+def _merge_telemetry(env, tdir):
+    """Fold the per-role telemetry files into merged_trace.json +
+    cluster.json (a child process: the launcher itself never imports
+    the framework).  Diagnostics must not fail a finished launch —
+    a merge failure is reported, not propagated."""
+    env = dict(env)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # the merge helper must not be a telemetry PRODUCER: with the dir
+    # armed its own atexit flush would drop a telemetry_local0.json
+    # into the directory it just merged, polluting later re-merges
+    env.pop("MXTPU_TELEMETRY_DIR", None)
+    env["MXTPU_TELEMETRY"] = "0"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; from mxtpu import telemetry; "
+             "telemetry.merge_dir(sys.argv[1])", tdir],
+            env=env, capture_output=True, text=True, timeout=120)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        print("launch.py: telemetry merge failed: %s" % e,
+              file=sys.stderr, flush=True)
+        return
+    if r.returncode != 0:
+        print("launch.py: telemetry merge failed:\n%s" % r.stderr,
+              file=sys.stderr, flush=True)
+    else:
+        print("launch.py: telemetry merged -> %s" %
+              os.path.join(tdir, "merged_trace.json"),
+              file=sys.stderr, flush=True)
 
 
 def _launch_ssh(args, ns):
