@@ -105,6 +105,7 @@ from . import operator
 from . import callback
 from . import profiler
 from . import telemetry
+from . import inspect
 from . import resilience
 from . import monitor
 from . import visualization

@@ -80,6 +80,7 @@ class CachedOp(object):
         else:
             self._jit_train_donated = None
         self._infer_fn = infer_fn
+        self._train_full_jit = None  # lazy fwd+bwd composite (analysis)
         self._fused_jits: Dict[Tuple[int, ...], Any] = {}
         self._has_rng = any((not n.is_variable) and n.op.needs_rng
                             for n in sym._topo())
@@ -100,6 +101,22 @@ class CachedOp(object):
         else:
             self._data_idx = [i for i, n in enumerate(self._arg_names)
                               if _DATA_NAME_RE.match(n)]
+        # program-inspector registry record (mx.inspect) — keyed by the
+        # owning block's name when known (the "program_name" flag
+        # HybridBlock sets), else the traced symbol's head name.  A
+        # stable key means a REBUILT CachedOp for the same block (whose
+        # auto-generated node names shift with the trace counter)
+        # accumulates signature history — that is what makes
+        # input-structure churn blameable.
+        from . import inspect as _insp
+
+        block_name = self._flags.get("program_name")
+        self._insp = _insp.program(
+            "cachedop", block_name or sym.name,
+            arg_names=self._arg_names + self._aux_names, symbol=sym,
+            # block names are per-process unique; bare symbol head
+            # names (direct CachedOp users) are not
+            reuse=bool(block_name))
 
     @property
     def symbol(self) -> Symbol:
@@ -128,7 +145,7 @@ class CachedOp(object):
         recording = _ag.is_recording()
 
         if recording:
-            self._track_sig("train" if training else "infer", flat)
+            tok = self._track_sig("train" if training else "infer", flat)
             if training:
                 def tupled(*xs):
                     return self._jit_train(key, *xs)
@@ -138,11 +155,22 @@ class CachedOp(object):
 
             all_nd = list(args) + list(aux_arrays)
             outs, node = _ag._record_fn("_CachedOp", tupled, all_nd, flat)
+            if tok is not None:
+                # the recording path runs under jax.vjp, so the train
+                # program XLA builds spans forward AND backward — hand
+                # the registry a matching fwd+bwd composite, not the
+                # forward-only jit (whose cost would understate a
+                # train step by the whole backward pass)
+                tok.done(self._analysis_train_jit() if training
+                         else self._jit_infer,
+                         (key,) + tuple(flat))
         else:
             if training:
-                self._track_sig("train", flat)
+                tok = self._track_sig("train", flat)
                 jit_train = self._jit_train_donated or self._jit_train
                 outs = jit_train(key, *flat)
+                if tok is not None:
+                    tok.done(jit_train, (key,) + tuple(flat))
             else:
                 outs = self._infer_dispatch(key, flat)
             node = None
@@ -181,23 +209,41 @@ class CachedOp(object):
             return None
         return spec
 
-    def _track_sig(self, kind: str, flat_or_sig):
-        from . import profiler as _prof
+    def _track_sig(self, kind: str, flat_or_sig, names=None):
+        """Retrace accounting — see ``inspect.track_compile`` for the
+        contract (None on hit, pending-compile token on a new
+        signature).  ``names`` overrides the per-slot arg names when
+        the signature's slot order is not ``list_arguments() + aux``
+        (the fused dispatch)."""
+        from . import inspect as _insp_mod
 
         sig = flat_or_sig if isinstance(flat_or_sig, tuple) \
             else _cc.sig_of(flat_or_sig)
-        keyed = (kind, sig)
-        if keyed in self._seen_sigs:
-            _prof.inc_stat("cachedop_%s_hit" % kind)
-        else:
-            from . import resilience as _res
-            from . import telemetry as _tel
+        return _insp_mod.track_compile(
+            self._insp, self._seen_sigs, "cachedop_%s" % kind,
+            "cachedop:%s" % kind, kind, sig,
+            arg_names=names or (self._arg_names + self._aux_names))
 
-            _res.fault_barrier("compile", "cachedop:%s" % kind)
-            self._seen_sigs.add(keyed)
-            _prof.inc_stat("cachedop_%s_trace" % kind)
-            _tel.record("compile", site="cachedop:%s" % kind,
-                        step=_tel.current_step())
+    def _analysis_train_jit(self):
+        """Forward+backward composite mirroring what the RECORDING
+        train path compiles (``jax.vjp`` over the forward jit), used
+        only for the registry's lazy cost/memory analysis — never
+        dispatched.  Cotangents are taken for all inputs (the tape
+        pulls a subset), so the figures are a faithful slight
+        over-approximation of the recorded step."""
+        if self._train_full_jit is None:
+            import jax
+            import jax.numpy as jnp
+
+            fwd = self._jit_train
+
+            def full(key, *flat):
+                outs, vjp = jax.vjp(lambda *xs: fwd(key, *xs), *flat)
+                ones = tuple(jnp.ones_like(o) for o in outs)
+                return outs, vjp(ones)
+
+            self._train_full_jit = jax.jit(full)
+        return self._train_full_jit
 
     def _infer_dispatch(self, key, flat: List[Any]):
         """Inference hot path: bucket-pad ragged batch dims, then serve
@@ -235,9 +281,13 @@ class CachedOp(object):
         compiled = self._aot_infer.get(sig)
         if compiled is not None:
             _prof.inc_stat("cachedop_aot_hit")
+            self._insp.hit()
             return compiled(key, *flat)
-        self._track_sig("infer", sig)
-        return self._jit_infer(key, *flat)
+        tok = self._track_sig("infer", sig)
+        outs = self._jit_infer(key, *flat)
+        if tok is not None:
+            tok.done(self._jit_infer, (key,) + tuple(flat))
+        return outs
 
     def _pad_mask(self, flat, b: int, bp: int):
         """Per-output slice mask for padding b -> bp, from shape
@@ -288,13 +338,16 @@ class CachedOp(object):
                 "warmup expects %d args + %d aux shapes, got %d + %d"
                 % (len(self._arg_names), len(self._aux_names),
                    len(specs), len(aux_specs)))
-        sig = tuple((s, str(d)) for s, d in specs + aux_specs)
+        # key must match the dispatch path's _cc.sig_of (dtype OBJECTS)
+        sig = tuple((s, d) for s, d in specs + aux_specs)
         if sig in self._aot_infer:
             return self
         k = jax.random.PRNGKey(0)
         structs = [jax.ShapeDtypeStruct(k.shape, k.dtype)] + \
             [jax.ShapeDtypeStruct(s, d) for s, d in specs + aux_specs]
-        self._aot_infer[sig] = _cc.aot_compile(self._jit_infer, structs)
+        self._aot_infer[sig] = _cc.aot_compile(self._jit_infer, structs,
+                                               program=self._insp,
+                                               kind="infer")
         _prof.inc_stat("cachedop_warmup")
         return self
 
@@ -361,6 +414,15 @@ class CachedOp(object):
         stack_vals = tuple(args[i]._data for i in stacked)
         fixed_vals = [args[i]._data for i in fixed]
         aux_vals = [a._data for a in aux_arrays]
-        outs = jit_program(self._key(), stack_vals, fixed_vals, aux_vals)
+        # the fused scan program is a compile site like any other:
+        # retrace accounting + blame + the compile fault barrier
+        tok = self._track_sig(
+            "fused_infer", list(stack_vals) + fixed_vals + aux_vals,
+            names=[self._arg_names[i] for i in stacked] +
+                  [self._arg_names[i] for i in fixed] + self._aux_names)
+        key = self._key()
+        outs = jit_program(key, stack_vals, fixed_vals, aux_vals)
+        if tok is not None:
+            tok.done(jit_program, (key, stack_vals, fixed_vals, aux_vals))
         ctx = args[stacked[0]].ctx
         return [NDArray(o, ctx=ctx, _committed=True) for o in outs]

@@ -92,6 +92,10 @@ def enable_persistent_cache(path: Optional[str] = None) -> str:
                           ("jax_persistent_cache_min_compile_time_secs", 0.0)):
             if hasattr(jax.config, name):
                 jax.config.update(name, val)
+        # zero thresholds mean MANY small writes, often from several
+        # processes sharing one dir — they must be atomic (torn reads
+        # heap-corrupt jaxlib 0.4.x at deserialize)
+        _patch_atomic_cache_writes()
         _reset_jax_cache_latch()
         _cache_dir = path
     from . import profiler as _prof
@@ -113,6 +117,82 @@ def disable_persistent_cache() -> None:
 def persistent_cache_dir() -> Optional[str]:
     """The active on-disk cache directory, or None when disabled."""
     return _cache_dir
+
+
+def _patch_atomic_cache_writes() -> None:
+    """Make JAX's on-disk cache writes ATOMIC (temp + ``os.replace``).
+
+    jaxlib 0.4.x ``LRUCache.put`` writes entries with a bare
+    ``path.write_bytes`` — no temp file, and no lock unless eviction
+    is on.  A concurrent reader (this suite runs many processes
+    against ONE shared cache dir) or a SIGKILL landing mid-write
+    leaves/observes a TORN entry, and deserializing one is not a
+    graceful miss: jaxlib heap-corrupts (rc -11 / "corrupted
+    double-linked list").  With the entry-size thresholds dropped to
+    zero (see :func:`enable_persistent_cache`) every tiny program is
+    written, so the window is hit in practice.  ``os.replace`` is
+    atomic on POSIX: readers see the old state or the full entry,
+    never a partial one; an interrupted writer leaves only a ``.tmp``
+    sibling the reader never looks at.  Version-guarded: if the
+    internals moved, the patch silently does not install."""
+    try:
+        from jax._src import lru_cache as _lru
+
+        cls = _lru.LRUCache
+        if getattr(cls.put, "_mxtpu_atomic", False):
+            return
+        cache_suffix = _lru._CACHE_SUFFIX
+        atime_suffix = _lru._ATIME_SUFFIX
+
+        def put(self, key, val):
+            if not key:
+                raise ValueError("key cannot be empty")
+            if self.eviction_enabled and len(val) > self.max_size:
+                import warnings
+
+                warnings.warn(  # keep the stock diagnostic
+                    f"Cache value for key {key!r} of size {len(val)} "
+                    f"bytes exceeds the maximum cache size of "
+                    f"{self.max_size} bytes")
+                return
+            cache_path = self.path / f"{key}{cache_suffix}"
+            atime_path = self.path / f"{key}{atime_suffix}"
+            if self.eviction_enabled:
+                self.lock.acquire(timeout=self.lock_timeout_secs)
+            try:
+                if cache_path.exists():
+                    return
+                self._evict_if_needed(additional_size=len(val))
+                import tempfile
+
+                # mkstemp, not a fixed pid-derived name: two THREADS
+                # putting the same key must not share one temp file
+                # (a reopen+truncate race would atomically install a
+                # torn entry — the exact corruption this patch kills)
+                fd, tmp = tempfile.mkstemp(dir=str(self.path),
+                                           suffix=".tmp")
+                try:
+                    with os.fdopen(fd, "wb") as f:
+                        f.write(val)
+                    os.replace(tmp, cache_path)
+                except BaseException:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+                    raise
+                import time as _time
+
+                atime_path.write_bytes(
+                    _time.time_ns().to_bytes(8, "little"))
+            finally:
+                if self.eviction_enabled:
+                    self.lock.release()
+
+        put._mxtpu_atomic = True
+        cls.put = put
+    except Exception:  # pragma: no cover - jax internals moved
+        pass
 
 
 def _reset_jax_cache_latch() -> None:
@@ -261,28 +341,50 @@ def donation_enabled() -> bool:
 
 
 def sig_of(vals: Sequence[Any]) -> Tuple:
-    """Hashable shape/dtype signature of a flat list of arrays."""
-    return tuple((tuple(v.shape), str(v.dtype)) for v in vals)
+    """Hashable shape/dtype signature of a flat list of arrays.
+
+    The dtype OBJECT (np.dtype — hashable, interned per kind) is used
+    rather than ``str(dtype)``: stringifying a dtype costs ~7 us and
+    this runs per dispatch on the serving hot path (the whole
+    signature build is ~6 us for a 5-array program; measured by
+    ``tools/check_inspect.py --overhead-only``)."""
+    return tuple((tuple(v.shape), v.dtype) for v in vals)
 
 
-def aot_compile(jitfn, example_args):
+def aot_compile(jitfn, example_args, program=None, kind="aot"):
     """``jit(...).lower(*args).compile()``: build the executable without
     running it.  ``example_args`` may be arrays or ShapeDtypeStructs;
     the returned Compiled object is called with matching concrete
     arrays and NEVER touches the jit's trace/compile cache.
 
+    ``program`` (a ``mx.inspect`` :class:`ProgramRecord`) registers
+    the built executable in the program-inspector registry under
+    ``kind`` — analysis is immediate and cheap because the Compiled
+    object is already in hand.
+
     Runs under the ``compile`` fault-injection site + retry policy
     (mxtpu/resilience.py): a transient XLA/compile-cache failure is
     retried with backoff instead of killing the run."""
+    import time as _time
+
     from . import resilience as _res
     from . import telemetry as _tel
 
-    _tel.record("compile", site="aot", step=_tel.current_step())
+    # zero-valued fields are backfilled IN PLACE by the inspector
+    # (pre-created here so the ring-resident dict never grows)
+    ev = _tel.record("compile", site="aot", step=_tel.current_step(),
+                     program=program.name if program is not None else None,
+                     variant=kind, flops=0.0, peak_bytes=0, compile_s=0.0)
 
     def body():
         _res.maybe_fault("compile", "aot_compile")
         return jitfn.lower(*example_args).compile()
-    return _res.run_with_retry("compile", body)
+    t0 = _time.perf_counter()
+    compiled = _res.run_with_retry("compile", body)
+    if program is not None:
+        program.record_aot(kind, example_args, compiled,
+                           _time.perf_counter() - t0, event=ev)
+    return compiled
 
 
 def shape_struct(shape, dtype):

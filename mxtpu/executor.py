@@ -26,6 +26,7 @@ XLA buffer assignment owns memory.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -95,11 +96,21 @@ def _build_graph_fn(symbol: Symbol, arg_names: List[str],
     import jax
 
     from . import amp as _amp
+    from . import inspect as _insp
 
     compute_dtype = _amp.get_compute_dtype()
     nodes = _topo_order(symbol._outputs)
     arg_pos = {n: i for i, n in enumerate(arg_names)}
     aux_pos = {n: i for i, n in enumerate(aux_names)}
+    # layer attribution (MXTPU_INSPECT_SCOPES, default on): each node
+    # executes under jax.named_scope(node name), so HLO op metadata
+    # and jax.profiler device traces resolve back to model layers.
+    # Trace-time only — zero runtime cost in the compiled program.
+    if _insp.scopes_enabled():
+        node_scope = {id(n): _insp.scope_name(n.name) for n in nodes
+                      if not n.is_variable}
+    else:
+        node_scope = None
 
     def graph_fn_impl(arg_vals, aux_vals, key):
         env: Dict[Tuple[int, int], Any] = {}
@@ -124,12 +135,16 @@ def _build_graph_fn(symbol: Symbol, arg_names: List[str],
                 attrs = dict(node.attrs)
                 if node.op.train_aware:
                     attrs["is_train"] = is_train
+                scope = jax.named_scope(node_scope[id(node)]) \
+                    if node_scope is not None else contextlib.nullcontext()
                 if node.op.needs_rng:
                     sub = jax.random.fold_in(key, rng_i)
                     rng_i += 1
-                    out = node.op.fn(sub, *invals, **attrs)
+                    with scope:
+                        out = node.op.fn(sub, *invals, **attrs)
                 else:
-                    out = node.op.fn(*invals, **attrs)
+                    with scope:
+                        out = node.op.fn(*invals, **attrs)
                 if not isinstance(out, tuple):
                     out = (out,)
                 n_vis = node.op.n_outputs(node.attrs)
@@ -284,6 +299,13 @@ class Executor(object):
         self._aot_step = None
         self._seen_sigs: set = set()
         self._pad_masks: Dict = {}
+        # program-inspector registry record (mx.inspect): signatures,
+        # compile wall times, retrace blame, lazy cost/HLO analysis
+        from . import inspect as _insp
+
+        self._insp = _insp.program("executor", symbol.name,
+                                   arg_names=self._arg_names,
+                                   symbol=symbol)
 
     # -- binding entry points --------------------------------------------
     @staticmethod
@@ -428,10 +450,13 @@ class Executor(object):
         if is_train and self._diff_idx and self._explicit_ograd_mode:
             # split path: outputs + residual-closing vjp in one dispatch;
             # backward applies the cached pullback (no fwd recompute)
-            self._track_sig("train", self._arg_vals())
+            tok = self._track_sig("train", self._arg_vals())
             self._last_fwd_state = (self._arg_vals(), saved_aux, key)
             outs, aux_new, vjp = self._jit_fwd_vjp(
                 self._arg_vals(), self._aux_vals(), key)
+            if tok is not None:
+                tok.done(self._jit_fwd_vjp,
+                         (self._arg_vals(), self._aux_vals(), key))
             self._cached_vjp = (vjp, aux_new)
             self._cached_grads = None
             self._write_aux(aux_new)
@@ -452,27 +477,39 @@ class Executor(object):
             self._last_fwd_state = (self._arg_vals(), saved_aux, key)
             if self._aot_step is not None:
                 _prof.inc_stat("executor_aot_hit")
+                self._insp.hit()
                 outs, grads, aux_new = self._aot_step(
                     self._arg_vals(), self._aux_vals(), key, ograds)
             else:
-                self._track_sig("train", self._arg_vals())
+                tok = self._track_sig("train", self._arg_vals())
                 outs, grads, aux_new = self._jit_step(
                     self._arg_vals(), self._aux_vals(), key, ograds)
+                if tok is not None:
+                    tok.done(self._jit_step,
+                             (self._arg_vals(), self._aux_vals(), key,
+                              ograds))
             self._cached_grads = grads
             self._write_aux(aux_new)
         elif is_train:
-            self._track_sig("train", self._arg_vals())
+            tok = self._track_sig("train", self._arg_vals())
             outs, aux_new = self._jit_fwd_train(
                 self._arg_vals(), self._aux_vals(), key)
+            if tok is not None:
+                tok.done(self._jit_fwd_train,
+                         (self._arg_vals(), self._aux_vals(), key))
             self._write_aux(aux_new)
         elif ragged:
             outs = self._forward_bucketed(ragged, key)
         elif self._aot_infer is not None:
             _prof.inc_stat("executor_aot_hit")
+            self._insp.hit()
             outs = self._aot_infer(self._arg_vals(), self._aux_vals(), key)
         else:
-            self._track_sig("infer", self._arg_vals())
+            tok = self._track_sig("infer", self._arg_vals())
             outs = self._jit_fwd_infer(self._arg_vals(), self._aux_vals(), key)
+            if tok is not None:
+                tok.done(self._jit_fwd_infer,
+                         (self._arg_vals(), self._aux_vals(), key))
         self.outputs = [NDArray(o, ctx=self._ctx, _committed=True)
                         for o in outs]
         return self.outputs
@@ -509,8 +546,11 @@ class Executor(object):
                 call_vals[i] = v
             if bp != b:
                 _prof.inc_stat("executor_bucket_fallback")
-        self._track_sig("infer", call_vals)
+        tok = self._track_sig("infer", call_vals)
         outs = self._jit_fwd_infer(call_vals, self._aux_vals(), key)
+        if tok is not None:
+            tok.done(self._jit_fwd_infer,
+                     (call_vals, self._aux_vals(), key))
         if mask is not None:
             outs = [o[:b] if m else o for o, m in zip(outs, mask)]
         return outs
@@ -536,24 +576,16 @@ class Executor(object):
         return mask
 
     def _track_sig(self, kind: str, vals):
+        """Retrace accounting for one dispatch — see
+        ``inspect.track_compile`` for the contract (None on hit,
+        pending-compile token on a new signature)."""
         from . import compile_cache as _cc
-        from . import profiler as _prof
+        from . import inspect as _insp_mod
 
-        sig = (kind, _cc.sig_of(vals))
-        if sig in self._seen_sigs:
-            _prof.inc_stat("executor_%s_hit" % kind)
-        else:
-            # a NEW signature is about to trigger an XLA build: this is
-            # the `compile` fault-injection chokepoint (flaky-compile
-            # recovery rides the retry policy)
-            from . import resilience as _res
-            from . import telemetry as _tel
-
-            _res.fault_barrier("compile", "executor:%s" % kind)
-            self._seen_sigs.add(sig)
-            _prof.inc_stat("executor_%s_trace" % kind)
-            _tel.record("compile", site="executor:%s" % kind,
-                        step=_tel.current_step())
+        return _insp_mod.track_compile(
+            self._insp, self._seen_sigs, "executor_%s" % kind,
+            "executor:%s" % kind, kind, _cc.sig_of(vals),
+            arg_names=self._arg_names)
 
     def warmup(self, for_training: Optional[bool] = None):
         """AOT-compile this executor's programs via
@@ -580,13 +612,16 @@ class Executor(object):
         k = jax.random.PRNGKey(0)
         key = jax.ShapeDtypeStruct(k.shape, k.dtype)
         self._aot_infer = _cc.aot_compile(self._jit_fwd_infer,
-                                          (args, aux, key))
+                                          (args, aux, key),
+                                          program=self._insp, kind="infer")
         _prof.inc_stat("executor_warmup")
         if for_training and self._diff_idx:
             ograds = [jax.ShapeDtypeStruct(s, d)
                       for s, d in self._out_avals()]
             self._aot_step = _cc.aot_compile(self._jit_step,
-                                             (args, aux, key, ograds))
+                                             (args, aux, key, ograds),
+                                             program=self._insp,
+                                             kind="train")
             _prof.inc_stat("executor_warmup")
         return self
 

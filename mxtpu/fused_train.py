@@ -154,6 +154,17 @@ class FusedTrainLoop(object):
         self._jit_program = jax.jit(self._make_program(),
                                     donate_argnums=(0, 1, 2))
 
+        # program-inspector registry record (mx.inspect): the fused
+        # K-step program is a first-class compile site — signature =
+        # the staged data stacks (params/opt-state shapes are fixed)
+        from . import inspect as _insp
+
+        self._insp = _insp.program(
+            "fused_train", ex._symbol.name,
+            arg_names=[self._arg_names[i] for i in self._data_idx],
+            symbol=ex._symbol)
+        self._seen_sigs: set = set()
+
     def _make_program(self):
         import jax
         import jax.numpy as jnp
@@ -281,12 +292,21 @@ class FusedTrainLoop(object):
         from . import random as _rnd
         from . import telemetry as _tel
 
+        from . import compile_cache as _cc
+        from . import inspect as _insp_mod
+
         K = self._K
         base_key = _rnd._next_key() if self._exec._has_rng \
             else jax.random.PRNGKey(0)
+        tok = _insp_mod.track_compile(
+            self._insp, self._seen_sigs, "fused_train", "fused_train",
+            "train", _cc.sig_of(data_stack),
+            arg_names=[self._arg_names[i] for i in self._data_idx])
+        prog_args = self._program_args(data_stack, base_key)
         t0 = _time.monotonic()
-        p, s, aux, outs = self._jit_program(
-            *self._program_args(data_stack, base_key))
+        p, s, aux, outs = self._jit_program(*prog_args)
+        if tok is not None:
+            tok.done(self._jit_program, prog_args)
         bad_flags = None
         if self._guard is not None:
             bad_flags = np.asarray(outs["bad"])

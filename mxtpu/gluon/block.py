@@ -23,7 +23,7 @@ from ..context import Context, current_context
 from ..ndarray.ndarray import NDArray
 from .. import ndarray as nd_mod
 from .. import symbol as sym_mod
-from ..symbol.symbol import Symbol
+from ..symbol.symbol import NameManager, Symbol
 from ..cached_op import CachedOp
 from .parameter import (Parameter, ParameterDict, DeferredInitializationError,
                         tensor_types)
@@ -60,6 +60,29 @@ def _regroup(flat, fmt):
         item, flat = _regroup(flat, f)
         structure.append(item)
     return structure, flat
+
+
+class _TraceNames(NameManager):
+    """NameManager active while tracing one block's ``hybrid_forward``:
+    anonymous ops get the block's ABSOLUTE prefix ("mlp_fc1_"), so the
+    traced graph — and through `mx.inspect`'s per-node `named_scope`,
+    the HLO op metadata and device traces — resolves to model layers
+    instead of bare "fullyconnected2" counters.  Counters are shared
+    with the enclosing manager (one dict per trace), so a
+    weight-shared block called twice still yields unique node names.
+    Explicit names pass through untouched (unlike `mx.name.Prefix`):
+    Parameter.var() and user-named ops must keep their exact names or
+    `_build_cache`'s arg mapping breaks."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._counter = NameManager.current()._counter
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        if name:
+            return name
+        return self._prefix + super().get(None, hint)
 
 
 class _BlockScope(object):
@@ -344,7 +367,8 @@ class HybridBlock(Block):
         flat, in_fmt = _flatten(list(args), "input")
         data_syms = [sym_mod.var("data%d" % i) for i in range(len(flat))]
         structured, _ = _regroup(list(data_syms), in_fmt)
-        out = self._call_hybrid(sym_mod, structured, trace=True)
+        with _TraceNames(self.prefix):
+            out = self._call_hybrid(sym_mod, structured, trace=True)
         out_flat, out_fmt = _flatten(out, "output")
         out_sym = out_flat[0] if len(out_flat) == 1 else \
             sym_mod.Group(out_flat)
@@ -356,7 +380,10 @@ class HybridBlock(Block):
         out_sym, out_fmt, in_fmt = self._trace_symbol(*args)
         self._out_fmt = out_fmt
         self._in_fmt = in_fmt
-        self._cached_op = CachedOp(out_sym, self._flags)
+        # "program_name" keys the mx.inspect registry record by THIS
+        # block, so retraces across cache rebuilds stay one program
+        self._cached_op = CachedOp(
+            out_sym, list(self._flags) + [("program_name", self.name)])
         # map graph arguments to data slots / Parameters
         arg_names = self._cached_op._arg_names
         aux_names = self._cached_op._aux_names
@@ -458,7 +485,8 @@ class HybridBlock(Block):
                 return self._run_cached(x, *args)
             return self._call_hybrid(nd_mod, [x] + list(args))
         if isinstance(first, Symbol):
-            return self._call_hybrid(sym_mod, [x] + list(args))
+            with _TraceNames(self.prefix):
+                return self._call_hybrid(sym_mod, [x] + list(args))
         raise MXNetError("HybridBlock input must be NDArray or Symbol, got %s"
                          % type(first))
 
@@ -522,6 +550,38 @@ class HybridBlock(Block):
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Per-layer summary.  With example ``inputs`` (NDArrays or
+        shape tuples) the block is traced symbolically and the call
+        delegates to :func:`mxtpu.visualization.print_summary` — layer
+        table with output shapes, param counts, and the XLA FLOPs
+        column (plus the registry's whole-program figures when this
+        block's compiled program exists in ``mx.inspect``).  Without
+        inputs, falls back to the plain Block walk."""
+        if not inputs:
+            return super().summary()
+        example = [nd_mod.zeros(tuple(a)) if isinstance(a, (tuple, list))
+                   else a for a in inputs]
+        try:
+            for p in self._collect_all_reg_params().values():
+                p.data()
+        except (DeferredInitializationError, MXNetError):
+            self._deferred_infer_shape(*example)
+            for p in self._collect_all_params():
+                p._finish_deferred_init()
+        if self._cached_op is not None:
+            # reuse the live cache's symbol: its graph head is what the
+            # mx.inspect registry keys on, so the compiled-program
+            # footer (whole-program FLOPs / peak memory) resolves
+            out_sym = self._cached_op.symbol
+        else:
+            out_sym, _, _ = self._trace_symbol(*example)
+        flat, _ = _flatten(list(example), "input")
+        shapes = {"data%d" % i: tuple(a.shape) for i, a in enumerate(flat)}
+        from .. import visualization
+
+        return visualization.print_summary(out_sym, shape=shapes)
 
     # -- AOT warmup --------------------------------------------------------
     def warmup(self, input_shapes, dtype="float32"):
@@ -637,7 +697,8 @@ class SymbolBlock(HybridBlock):
         return self._run_cached(x, *args)
 
     def _build_symbol_cache(self, n_inputs):
-        self._cached_op = CachedOp(self._symbol, ())
+        self._cached_op = CachedOp(self._symbol,
+                                   (("program_name", self.name),))
         by_name = {p.name: p for p in self.params.values()}
         self._cached_arg_map = []
         for i, name in enumerate(self._cached_op._arg_names):

@@ -180,18 +180,24 @@ def identity() -> Dict[str, Any]:
                 "pid": getpid_cached()}
 
 
-def record(kind: str, **fields) -> None:
+def record(kind: str, **fields) -> Optional[Dict[str, Any]]:
     """Append one typed record to the ring.  One bool check when
     telemetry is off; a dict build + deque append when on — safe on
-    hot paths.  ``fields`` must be JSON-safe scalars."""
+    hot paths.  ``fields`` must be JSON-safe scalars.  Returns the
+    record dict (held by reference in the ring) so a producer may
+    BACKFILL scalar fields it created eagerly — `mx.inspect` fills
+    ``flops``/``peak_bytes`` on ``compile`` events once its lazy
+    analysis runs (assignment to pre-existing keys only, so a
+    concurrent JSON dump never sees the dict change size)."""
     if not _ENABLED:
-        return
+        return None
     ev = {"kind": kind, "ts": time.time(), "pid": getpid_cached(),
           "role": _IDENTITY["role"], "rank": _IDENTITY["rank"]}
     for k, v in fields.items():
         if v is not None:
             ev[k] = v
     _RING.append(ev)
+    return ev
 
 
 def record_step(batch_size: int = 0, n: int = 1,
@@ -766,6 +772,14 @@ def merge_dir(directory: str, out_trace: str = "merged_trace.json",
     worker_avgs = [v for k, v in per_rank_step.items()
                    if k.startswith("worker") and v > 0]
     aggregate = aggregate_stats(s.get("stats") for s in snaps.values())
+    # compile rollup (mx.inspect counters): wall-clock seconds each
+    # rank spent building XLA programs, and how many of those builds
+    # were RE-compiles of an already-seen program (retrace blame)
+    per_rank_compile = {
+        k: round((s.get("stats") or {}).get("inspect_compile_wall_us", 0)
+                 / 1e6, 3)
+        for k, s in snaps.items()
+        if (s.get("stats") or {}).get("inspect_compile_wall_us")}
     cluster = {
         "roles": {k: {"pid": s.get("pid"), "stats": s.get("stats", {}),
                       "metrics": s.get("metrics", {})}
@@ -778,6 +792,9 @@ def merge_dir(directory: str, out_trace: str = "merged_trace.json",
         "retry_total": sum(v for k, v in aggregate.items()
                            if k.startswith("retry_attempts::")),
         "failover_total": aggregate.get("elastic_failover", 0),
+        "per_rank_compile_s": per_rank_compile,
+        "compile_total": aggregate.get("inspect_compiles", 0),
+        "recompile_total": aggregate.get("inspect_recompiles", 0),
         "flights": flights,
     }
     _write_json(os.path.join(directory, out_cluster), cluster)

@@ -1,7 +1,11 @@
 """Network visualization (reference: `python/mxnet/visualization.py`).
 
 `print_summary` walks the symbol graph and prints a layer table with
-output shapes and parameter counts; `plot_network` renders a graphviz
+output shapes, parameter counts and — when input shapes are given — a
+per-layer FLOPs column from XLA's own cost analysis (the same cost
+model the `mx.inspect` program registry reports); when a bound
+compiled program exists for the symbol, the footer cites the
+registry's whole-program figures.  `plot_network` renders a graphviz
 digraph when graphviz is installed.
 """
 from __future__ import annotations
@@ -16,9 +20,41 @@ from .symbol.symbol import Symbol, _topo_order
 __all__ = ["print_summary", "plot_network"]
 
 
+def _node_flops(node, shape_dict, provided):
+    """XLA FLOP estimate for one op node, from its input shapes (the
+    per-layer source of `print_summary`'s FLOPs column)."""
+    from . import inspect as _insp
+
+    in_shapes, in_dtypes = [], []
+    for inode, idx in node.inputs:
+        if inode.is_variable:
+            s = provided.get(inode.name) or \
+                shape_dict.get(inode.name) or \
+                shape_dict.get("%s_output" % inode.name)
+        else:
+            key = "%s_output" % inode.name
+            s = shape_dict.get(key)
+            if s is None and inode.num_outputs() > 1:
+                s = shape_dict.get("%s_output%d" % (inode.name, idx))
+        if s is None:
+            return None
+        in_shapes.append(tuple(s))
+        in_dtypes.append("float32")
+    return _insp.op_flops(node, in_shapes, in_dtypes)
+
+
 def print_summary(symbol: Symbol, shape: Optional[Dict] = None,
-                  line_length: int = 120, positions=(.44, .64, .74, 1.)):
-    """Layer-table summary (reference `visualization.py:print_summary`)."""
+                  line_length: int = 120, positions=None,
+                  flops: str = "auto"):
+    """Layer-table summary (reference `visualization.py:print_summary`).
+
+    With ``shape`` given and ``flops`` not ``False``, a per-layer
+    FLOPs column is added (XLA cost analysis per op, memoized); when a
+    compiled program is registered for this symbol in ``mx.inspect``,
+    the footer reports the whole-program FLOPs / peak-memory figures
+    from the registry.  A caller-provided ``positions`` is always
+    honored: a 5-tuple lays out the FLOPs table, a 4-tuple keeps the
+    caller's classic 4-column layout (FLOPs column omitted)."""
     if not isinstance(symbol, Symbol):
         raise MXNetError("symbol must be a Symbol")
     shape_dict = {}
@@ -27,8 +63,20 @@ def print_summary(symbol: Symbol, shape: Optional[Dict] = None,
         _, out_shapes, _ = internals.infer_shape(**shape)
         shape_dict = dict(zip(internals.list_outputs(), out_shapes))
 
+    want_flops = bool(shape_dict) and flops not in (False, "off", "0")
+    if want_flops and positions is not None and len(positions) != 5:
+        want_flops = False  # honor an explicit 4-column layout
+    if want_flops:
+        if positions is None:
+            positions = (.38, .54, .64, .80, 1.)
+        fields = ["Layer (type)", "Output Shape", "Param #", "FLOPs",
+                  "Previous Layer"]
+    else:
+        if positions is None:
+            positions = (.44, .64, .74, 1.)
+        fields = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
     positions = [int(line_length * p) for p in positions]
-    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
 
     lines = []
 
@@ -44,12 +92,15 @@ def print_summary(symbol: Symbol, shape: Optional[Dict] = None,
     lines.append("=" * line_length)
 
     total_params = 0
+    total_flops = 0.0
     nodes = _topo_order(symbol._outputs)
     for node in nodes:
         if node.is_variable and node.name in ("data",):
             out_shape = shape.get(node.name) if shape else None
-            print_row([f"{node.name}(null)", out_shape or "", 0, ""],
-                      positions)
+            row = [f"{node.name}(null)", out_shape or "", 0, ""]
+            if want_flops:
+                row.insert(3, "")
+            print_row(row, positions)
             lines.append("_" * line_length)
             continue
         if node.is_variable:
@@ -76,10 +127,32 @@ def print_summary(symbol: Symbol, shape: Optional[Dict] = None,
             elif inode.name == "data":
                 pred.append(inode.name)
         total_params += cur_param
-        print_row(["%s(%s)" % (node.name, op_name), out_shape, cur_param,
-                   ",".join(pred)], positions)
+        row = ["%s(%s)" % (node.name, op_name), out_shape, cur_param,
+               ",".join(pred)]
+        if want_flops:
+            nf = _node_flops(node, shape_dict, dict(shape or {}))
+            if nf is None:
+                row.insert(3, "?")
+            else:
+                total_flops += nf
+                row.insert(3, "%d" % int(nf))
+        print_row(row, positions)
         lines.append("_" * line_length)
     lines.append("Total params: %d" % total_params)
+    if want_flops:
+        lines.append("Total FLOPs (XLA per-op forward estimate): %d"
+                     % int(total_flops))
+        from . import inspect as _insp
+
+        prog = _insp.find_for_symbol(symbol)
+        if prog is not None and prog.latest_sig() is not None:
+            a = prog.latest_sig().analyze()
+            if a.get("flops"):
+                lines.append(
+                    "Compiled program %s [%s]: FLOPs %d, peak memory "
+                    "%.2f MB" % (prog.name, prog.latest_sig().kind,
+                                 int(a["flops"]),
+                                 a.get("peak_bytes", 0) / 2**20))
     lines.append("_" * line_length)
     out = "\n".join(lines)
     print(out)

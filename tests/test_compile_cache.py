@@ -478,3 +478,47 @@ def test_enable_persistent_cache_api(tmp_path):
         if os.environ.get("MXTPU_COMPILE_CACHE"):
             # give the rest of the suite its conftest cache back
             mx.enable_persistent_cache()
+
+
+def test_persistent_cache_writes_are_atomic(tmp_path):
+    """enable_persistent_cache patches jaxlib's LRUCache.put to write
+    temp + os.replace: jaxlib 0.4.x writes entries with a bare
+    write_bytes, and a torn entry (concurrent reader, or SIGKILL
+    mid-write) heap-corrupts the process at deserialize — the
+    rc=-11 test_bench flake.  Readers must only ever observe a
+    complete entry."""
+    cache = str(tmp_path / "atomic")
+    try:
+        mx.enable_persistent_cache(cache)
+        from jax._src import lru_cache as _lru
+
+        assert getattr(_lru.LRUCache.put, "_mxtpu_atomic", False), \
+            "atomic-write patch did not install on this jaxlib"
+        probe = _lru.LRUCache(str(tmp_path / "probe"), max_size=-1)
+        val = b"v" * (1 << 20)
+        import threading
+
+        stop = threading.Event()
+        torn = []
+
+        def reader():
+            while not stop.is_set():
+                for i in range(8):
+                    got = probe.get("k%d" % i)
+                    if got is not None and got != val:
+                        torn.append((i, len(got)))
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        for i in range(8):
+            probe.put("k%d" % i, val)
+        stop.set()
+        t.join(5)
+        assert not torn, "reader observed torn cache entries: %s" % torn
+        # no .tmp litter left behind on the happy path
+        assert not [f for f in os.listdir(str(tmp_path / "probe"))
+                    if f.endswith(".tmp")]
+    finally:
+        mx.disable_persistent_cache()
+        if os.environ.get("MXTPU_COMPILE_CACHE"):
+            mx.enable_persistent_cache()

@@ -1,0 +1,463 @@
+"""Program inspector (`mxtpu/inspect.py`): compiled-program registry,
+retrace blame, layer-attributed HLO, device-trace entry point.
+
+Covers the ISSUE-5 acceptance surface: the registry is populated by
+Executor, CachedOp and FusedTrainLoop with nonzero FLOP/peak-memory
+figures; blame names the exact changed argument for shape/dtype/
+new-arg churn; cost numbers are stable across cache hits; named_scope
+layer names appear in the lowered HLO; `tools/hlo_report.py` runs on
+a 2-layer MLP under JAX_PLATFORMS=cpu.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, profiler, telemetry
+from mxtpu.gluon import nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    profiler.reset_stats()
+    mx.inspect.reset()
+    telemetry.clear()
+    yield
+    mx.inspect.reset()
+
+
+def _mlp_sym():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(
+        data=fc2, label=mx.sym.Variable("softmax_label"), name="softmax")
+
+
+def _hybrid_net():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def _module(batch=8):
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (batch, 10))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# registry population
+# ---------------------------------------------------------------------------
+
+def test_executor_registers_with_cost_and_memory():
+    ex = _mlp_sym().simple_bind(mx.cpu(), data=(4, 10),
+                                softmax_label=(4,))
+    ex.forward(is_train=False, data=mx.nd.ones((4, 10)))
+    (prog,) = [p for p in mx.inspect.programs()
+               if p["site"] == "executor"]
+    assert prog["name"] == "executor:softmax"
+    assert prog["n_sigs"] == 1 and prog["compiles"] == 1
+    assert prog["flops"] > 0
+    assert prog["peak_bytes"] > 0
+    assert prog["compile_wall_s"] > 0
+    assert prog["kinds"] == ["infer"]
+
+
+def test_cachedop_registers_infer_and_train():
+    net = _hybrid_net()
+    x = mx.nd.ones((4, 10))
+    net(x).wait_to_read()
+    with autograd.record():
+        out = net(x)
+    out.backward()
+    (prog,) = [p for p in mx.inspect.programs()
+               if p["site"] == "cachedop"]
+    assert sorted(prog["kinds"]) == ["infer", "train"]
+    assert prog["compiles"] == 2
+    assert prog["flops"] > 0 and prog["peak_bytes"] > 0
+
+
+def test_fused_train_loop_registers():
+    from mxtpu.fused_train import FusedTrainLoop
+    from mxtpu.io.io import DataBatch
+
+    mod = _module()
+    loop = FusedTrainLoop(mod, steps_per_program=2)
+    rng = np.random.RandomState(0)
+    batches = [DataBatch(
+        data=[mx.nd.array(rng.rand(8, 10).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 4, 8).astype(np.float32))])
+        for _ in range(2)]
+    loop.run(batches)
+    loop.run(batches)  # second run is a cache hit
+    (prog,) = [p for p in mx.inspect.programs()
+               if p["site"] == "fused_train"]
+    assert prog["compiles"] == 1 and prog["hits"] == 1
+    assert prog["flops"] > 0 and prog["peak_bytes"] > 0
+    assert profiler.get_stat("fused_train_trace") == 1
+    assert profiler.get_stat("fused_train_hit") == 1
+
+
+def test_warmup_aot_registers():
+    ex = _mlp_sym().simple_bind(mx.cpu(), data=(4, 10),
+                                softmax_label=(4,))
+    ex.warmup(for_training=False)
+    (prog,) = [p for p in mx.inspect.programs(analyze=False)
+               if p["site"] == "executor"]
+    assert prog["aot_compiles"] == 1
+    # the AOT executable is analyzed immediately (it is already built)
+    sig = prog["signatures"][0]
+    assert sig["aot"] is True and sig["flops"] > 0
+
+
+# ---------------------------------------------------------------------------
+# retrace blame
+# ---------------------------------------------------------------------------
+
+def test_blame_names_changed_arg_shape_churn():
+    net = _hybrid_net()
+    net(mx.nd.ones((8, 10))).wait_to_read()
+    net(mx.nd.ones((9, 10))).wait_to_read()
+    (prog,) = mx.inspect.programs(analyze=False)
+    (blame,) = prog["blame"]
+    assert "data0" in blame and "(8, 10)" in blame and "(9, 10)" in blame
+    assert "shape buckets" in blame  # leading-dim churn gets the hint
+    # the culprit is named in profiler.stats() ...
+    keys = [k for k in profiler.stats()
+            if k.startswith("retrace_blame::") and "data0:shape" in k]
+    assert keys, profiler.stats()
+    # ... and on the telemetry compile event
+    evs = [e for e in telemetry.events("compile") if e.get("blame")]
+    assert evs and "data0" in evs[-1]["blame"]
+    assert mx.inspect.blame_summary()[blame] == 1
+
+
+def test_blame_names_changed_arg_dtype_churn():
+    net = _hybrid_net()
+    x = mx.nd.ones((4, 10))
+    net(x).wait_to_read()
+    net(x.astype("float16")).wait_to_read()
+    (prog,) = mx.inspect.programs(analyze=False)
+    (blame,) = prog["blame"]
+    assert "data0" in blame and "dtype" in blame
+    assert "float32" in blame and "float16" in blame
+
+
+def test_blame_arity_churn_unit():
+    """Input-structure churn (different arg count) blames arity."""
+    from mxtpu.inspect import compute_blame
+
+    old = ((((8, 10)), "float32"),)
+    new = ((((8, 10)), "float32"), (((8, 3)), "float32"))
+    blame, culprits = compute_blame(["data0", "data1"], [old], new)
+    assert "arg count 1→2" in blame
+    assert culprits == [("*", "arity")]
+
+
+def test_blame_arity_churn_through_rebuild():
+    """A HybridBlock whose input STRUCTURE changes rebuilds its
+    CachedOp; the stable program key keeps both builds on one record
+    so the arity blame fires."""
+    class Net(nn.HybridBlock):
+        def hybrid_forward(self, F, x, y=None):
+            return x * 2 if y is None else x * 2 + y
+
+    net = Net()
+    net.initialize()
+    net.hybridize()
+    net(mx.nd.ones((2, 3))).wait_to_read()
+    net(mx.nd.ones((2, 3)), mx.nd.ones((2, 3))).wait_to_read()
+    progs = [p for p in mx.inspect.programs(analyze=False)
+             if p["site"] == "cachedop"]
+    assert len(progs) == 1  # one logical program across the rebuild
+    (blame,) = progs[0]["blame"]
+    assert "arg count 1→2" in blame
+
+
+def test_same_head_name_distinct_graphs_no_phantom_blame():
+    """Two unrelated graphs sharing the conventional head name
+    (`softmax`) must get SEPARATE registry records — not fabricate
+    retrace blame against each other."""
+    for dim in (10, 20):
+        ex = _mlp_sym().simple_bind(mx.cpu(), data=(8, dim),
+                                    softmax_label=(8,))
+        ex.forward(is_train=False, data=mx.nd.ones((8, dim)))
+    progs = [p for p in mx.inspect.programs(analyze=False)
+             if p["site"] == "executor"]
+    assert len(progs) == 2
+    assert {p["name"] for p in progs} == \
+        {"executor:softmax", "executor:softmax#2"}
+    assert all("blame" not in p for p in progs)
+    assert not mx.inspect.blame_summary()
+    assert profiler.get_stat("inspect_recompiles") == 0
+
+
+def test_same_symbol_rebinding_shares_record():
+    """Re-binding the SAME symbol (graph identity) stays one logical
+    program — that churn is genuinely blameable."""
+    sym = _mlp_sym()
+    for dim in (8, 9):
+        ex = sym.simple_bind(mx.cpu(), data=(dim, 10),
+                             softmax_label=(dim,))
+        ex.forward(is_train=False, data=mx.nd.ones((dim, 10)))
+    progs = [p for p in mx.inspect.programs(analyze=False)
+             if p["site"] == "executor"]
+    assert len(progs) == 1 and progs[0]["n_sigs"] == 2
+    (blame,) = progs[0]["blame"]
+    assert "data" in blame and "shape" in blame
+
+
+def test_call_fused_registers_and_blames():
+    """CachedOp.call_fused (the fused-inference scan) is a compile
+    site: it registers, counts retraces, and blames shape churn."""
+    net = _hybrid_net()
+    x = mx.nd.ones((3, 4, 10))  # K=3 stacked batches
+    net.forward_fused(x)
+    net.forward_fused(x)        # hit
+    net.forward_fused(mx.nd.ones((3, 5, 10)))  # batch churn
+    (prog,) = [p for p in mx.inspect.programs(analyze=False)
+               if p["site"] == "cachedop"]
+    fused = [s for s in prog["signatures"] if s["kind"] == "fused_infer"]
+    assert len(fused) == 2
+    assert profiler.get_stat("cachedop_fused_infer_trace") == 2
+    assert profiler.get_stat("cachedop_fused_infer_hit") == 1
+    (blame,) = [s["blame"] for s in fused if "blame" in s]
+    assert "data0" in blame and "shape" in blame
+
+
+def test_compile_event_keys_complete_at_record_time():
+    """Backfill only assigns to PRE-CREATED keys (flops/peak_bytes/
+    compile_s) so a concurrently-serialized ring dict never changes
+    size."""
+    net = _hybrid_net()
+    net(mx.nd.ones((4, 10))).wait_to_read()
+    (ev,) = telemetry.events("compile")
+    keys_before = set(ev)
+    assert {"flops", "peak_bytes", "compile_s"} <= keys_before
+    mx.inspect.analyze_all()
+    assert set(ev) == keys_before  # values changed, key set did not
+
+
+def test_print_summary_honors_custom_4col_positions():
+    out = mx.visualization.print_summary(
+        _mlp_sym(), shape={"data": (4, 10), "softmax_label": (4,)},
+        positions=(.3, .5, .7, 1.))
+    assert "FLOPs" not in out  # explicit 4-column layout respected
+    assert "fc1" in out
+
+
+def test_aot_sigs_excluded_from_blame_priors():
+    """AOT signatures span the full example-arg tree (aux, rng key)
+    while dispatch sigs cover only the tracked args; diffing across
+    the two domains must not fabricate arity blame."""
+    net = _hybrid_net()
+    net.warmup([(4, 10)])
+    net(mx.nd.ones((4, 10))).wait_to_read()  # aot hit
+    net(mx.nd.ones((5, 10))).wait_to_read()  # first dispatch sig
+    (prog,) = [p for p in mx.inspect.programs(analyze=False)
+               if p["site"] == "cachedop"]
+    assert "blame" not in prog, prog["blame"]
+    net(mx.nd.ones((6, 10))).wait_to_read()  # real shape churn
+    (prog,) = [p for p in mx.inspect.programs(analyze=False)
+               if p["site"] == "cachedop"]
+    (blame,) = prog["blame"]
+    assert "data0" in blame and "shape" in blame
+
+
+def test_first_compile_has_no_blame():
+    net = _hybrid_net()
+    net(mx.nd.ones((4, 10))).wait_to_read()
+    (prog,) = mx.inspect.programs(analyze=False)
+    assert "blame" not in prog
+    assert profiler.get_stat("inspect_recompiles") == 0
+
+
+# ---------------------------------------------------------------------------
+# cost stability, hits, telemetry backfill
+# ---------------------------------------------------------------------------
+
+def test_cost_stable_across_cache_hits():
+    net = _hybrid_net()
+    x = mx.nd.ones((4, 10))
+    net(x).wait_to_read()
+    first = [p for p in mx.inspect.programs()][0]
+    for _ in range(3):
+        net(x).wait_to_read()
+    again = [p for p in mx.inspect.programs()][0]
+    assert again["flops"] == first["flops"] > 0
+    assert again["peak_bytes"] == first["peak_bytes"] > 0
+    assert again["hits"] == first["hits"] + 3
+    assert again["compiles"] == first["compiles"] == 1
+
+
+def test_compile_event_backfilled_in_place():
+    net = _hybrid_net()
+    net(mx.nd.ones((4, 10))).wait_to_read()
+    (ev,) = telemetry.events("compile")
+    assert ev["flops"] == 0.0 and ev["peak_bytes"] == 0
+    assert "compile_s" in ev and ev["compile_s"] > 0
+    mx.inspect.analyze_all()
+    assert ev["flops"] > 0 and ev["peak_bytes"] > 0  # same dict, filled
+
+
+def test_registry_counters_reconcile_with_stats():
+    net = _hybrid_net()
+    for bs in (8, 8, 9):
+        net(mx.nd.ones((bs, 10))).wait_to_read()
+    stats = profiler.stats()
+    progs = mx.inspect.programs(analyze=False)
+    assert sum(p["compiles"] for p in progs) == \
+        stats["cachedop_infer_trace"] == stats["inspect_compiles"]
+    assert sum(p["hits"] for p in progs) == stats["cachedop_infer_hit"]
+
+
+def test_disabled_inspector_still_emits_compile_events():
+    mx.inspect.enable(False)
+    try:
+        net = _hybrid_net()
+        net(mx.nd.ones((4, 10))).wait_to_read()
+        assert mx.inspect.programs() == []
+        (ev,) = telemetry.events("compile")
+        assert ev["site"] == "cachedop:infer"
+        # hot-path counters unaffected
+        assert profiler.get_stat("cachedop_infer_trace") == 1
+    finally:
+        mx.inspect.enable(True)
+
+
+# ---------------------------------------------------------------------------
+# layer attribution (named scopes) + HLO + report
+# ---------------------------------------------------------------------------
+
+def test_named_scope_layer_names_in_hlo():
+    ex = _mlp_sym().simple_bind(mx.cpu(), data=(4, 10),
+                                softmax_label=(4,))
+    ex.forward(is_train=False, data=mx.nd.ones((4, 10)))
+    hlo = mx.inspect.hlo("executor:softmax")
+    assert 'op_name="' in hlo
+    for layer in ("fc1", "relu1", "fc2"):
+        assert layer in hlo, "layer %s missing from HLO metadata" % layer
+
+
+def test_gluon_layer_names_in_hlo():
+    # hybridized blocks trace under _TraceNames, so HLO op metadata
+    # carries the block-prefixed layer names, not bare op counters
+    net = nn.HybridSequential(prefix="mlp_")
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", prefix="fc1_"))
+        net.add(nn.Dense(4, prefix="fc2_"))
+    net.initialize()
+    net.hybridize()
+    net(mx.nd.ones((4, 10))).wait_to_read()
+    hlo = mx.inspect.hlo("cachedop:mlp")
+    for layer in ("mlp_fc1_", "mlp_fc2_"):
+        assert layer in hlo, "layer %s missing from HLO metadata" % layer
+
+
+def test_scope_name_sanitization():
+    assert mx.inspect.scope_name("fc1") == "fc1"
+    assert mx.inspect.scope_name("a b:c") == "a_b_c"
+    assert mx.inspect.scope_name("") == "op"
+
+
+def test_report_and_summary():
+    net = _hybrid_net()
+    net(mx.nd.ones((4, 10))).wait_to_read()
+    rep = mx.inspect.report()
+    assert rep["site"] == "cachedop"
+    assert rep["cost"]["flops"] > 0
+    assert rep["memory"]["peak_bytes"] > 0
+    assert "op_histogram_top" in rep and rep["op_histogram_top"]
+    text = mx.inspect.summary()
+    assert "cachedop" in text and "GFLOP" in text
+
+
+def test_trace_entry_point(tmp_path):
+    net = _hybrid_net()
+    x = mx.nd.ones((4, 10))
+    net(x).wait_to_read()
+    logdir = str(tmp_path / "trace")
+    with mx.inspect.trace(logdir):
+        net(x).wait_to_read()
+    dumped = []
+    for root, _, files in os.walk(logdir):
+        dumped.extend(files)
+    assert any(f.endswith((".xplane.pb", ".trace.json.gz", ".json.gz"))
+               for f in dumped), dumped
+
+
+# ---------------------------------------------------------------------------
+# satellites: visualization FLOPs column, HybridBlock.summary, hlo_report
+# ---------------------------------------------------------------------------
+
+def test_print_summary_flops_column_and_registry_footer():
+    sym = _mlp_sym()
+    ex = sym.simple_bind(mx.cpu(), data=(4, 10), softmax_label=(4,))
+    ex.forward(is_train=False, data=mx.nd.ones((4, 10)))
+    out = mx.visualization.print_summary(
+        sym, shape={"data": (4, 10), "softmax_label": (4,)})
+    assert "FLOPs" in out
+    assert "Total FLOPs (XLA per-op forward estimate):" in out
+    assert "Compiled program executor:softmax" in out
+    # opting out restores the 4-column table
+    out4 = mx.visualization.print_summary(
+        sym, shape={"data": (4, 10), "softmax_label": (4,)}, flops=False)
+    assert "FLOPs" not in out4
+
+
+def test_hybridblock_summary_delegates():
+    net = _hybrid_net()
+    x = mx.nd.ones((2, 10))
+    net(x).wait_to_read()
+    out = net.summary(x)
+    assert "FLOPs" in out and "Total params: 244" in out
+    plain = net.summary()
+    assert "Dense" in plain
+
+
+def test_hlo_report_runs_on_mlp():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, "tools/hlo_report.py", "--model", "mlp",
+         "--batch", "4", "--spp", "1", "--dtype", "float32"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    rep = json.loads(r.stdout)
+    assert rep["program"].startswith("fused_train:")
+    assert rep["cost"]["flops"] > 0
+    assert rep["memory"]["peak_bytes"] > 0
+    assert rep["op_histogram_top"]
+
+
+def test_cluster_rollup_compile_fields(tmp_path):
+    """merge_dir rolls up per-rank compile seconds + recompile totals
+    from the inspect counters."""
+    snap = {"role": "worker", "rank": 0, "pid": 1, "ts": 1.0,
+            "stats": {"inspect_compile_wall_us": 2500000,
+                      "inspect_compiles": 4, "inspect_recompiles": 1},
+            "metrics": {}, "events": []}
+    with open(tmp_path / "telemetry_worker0.json", "w") as f:
+        json.dump(snap, f)
+    cluster = telemetry.merge_dir(str(tmp_path))
+    assert cluster["per_rank_compile_s"] == {"worker0": 2.5}
+    assert cluster["compile_total"] == 4
+    assert cluster["recompile_total"] == 1

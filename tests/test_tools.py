@@ -54,6 +54,34 @@ def test_check_retrace_guard():
     assert out.startswith("OK")
 
 
+def test_check_retrace_blame_on_churn():
+    """tools/check_retrace.py --churn: a deliberate batch-size churn
+    must FAIL the guard and the failure output must name the exact
+    culprit argument from the mx.inspect retrace-blame registry."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, "tools/check_retrace.py", "--steps", "2",
+         "--churn", "2"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert r.returncode == 1, (r.returncode, r.stdout, r.stderr)
+    assert "retrace-blame" in r.stderr, r.stderr
+    assert "data0" in r.stderr and "shape" in r.stderr, r.stderr
+
+
+def test_check_inspect_guard():
+    """tools/check_inspect.py: 5 training steps with a forced mid-run
+    shape change must leave the program-inspector registry holding
+    BOTH compiled programs, blame naming `data0` in the registry,
+    profiler.stats() and the telemetry compile event, counter totals
+    that reconcile with profiler.stats(), and a cache-hit bookkeeping
+    path under 10us/call (see mxtpu/inspect.py,
+    docs/observability.md)."""
+    out = _run(["tools/check_inspect.py"])
+    assert "check_inspect OK" in out
+
+
 def test_check_resilience_guard():
     """tools/check_resilience.py: a short fault-injected training run
     (compile-fail + kvstore-pull-fail + checkpoint-fail + SIGTERM +

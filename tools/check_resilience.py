@@ -177,6 +177,11 @@ def _spawn(extra, env_extra=None):
     env = dict(os.environ)
     env.pop("MXTPU_FAULT_INJECT", None)
     env.pop("MXTPU_MAX_BAD_STEPS", None)
+    # children here get SIGKILLed/SIGTERMed mid-run; a kill landing
+    # inside a jax persistent-cache write truncates the entry and
+    # jaxlib 0.4.x SEGFAULTS deserializing it later (same mitigation
+    # as check_elastic/check_telemetry)
+    env["MXTPU_COMPILE_CACHE"] = "0"
     env.update(env_extra or {})
     return subprocess.Popen([sys.executable, os.path.abspath(__file__)]
                             + extra, env=env,

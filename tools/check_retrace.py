@@ -5,10 +5,17 @@ Runs a tiny hybridized Gluon model for N inference steps and N training
 steps at a FIXED input shape and fails (rc=1) if the profiler's
 compile-lifecycle trace counters (`mxtpu.profiler.stats()`, keys
 `*_trace`) tick after the first step of each mode — i.e. if the hot
-path started re-tracing/recompiling per step.  Wired as a fast test in
-`tests/test_tools.py` so a retrace regression can't land silently.
+path started re-tracing/recompiling per step.  On failure the top
+retrace-blame culprits from the `mx.inspect` program registry are
+printed, naming the exact argument whose shape/dtype churned.  Wired
+as a fast test in `tests/test_tools.py` so a retrace regression can't
+land silently.
 
-Usage: python tools/check_retrace.py [--steps N]
+``--churn K`` deliberately varies the batch size across K extra
+inference steps — a self-test of the guard AND of retrace blame (the
+failure output must name `data0`); used by `tests/test_tools.py`.
+
+Usage: python tools/check_retrace.py [--steps N] [--churn K]
 """
 import argparse
 import os
@@ -21,6 +28,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--churn", type=int, default=0,
+                    help="inject K distinct batch sizes (expected FAIL "
+                         "naming the culprit arg)")
     args = ap.parse_args()
 
     import numpy as np
@@ -36,16 +46,17 @@ def main():
                 nn.Dense(4))
     net.initialize()
     net.hybridize()
-    x = mx.nd.array(np.random.RandomState(0).rand(8, 10).astype("float32"))
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(8, 10).astype("float32"))
 
     failures = []
     for mode in ("infer", "train"):
-        def step():
+        def step(inp=x):
             if mode == "infer":
-                net(x).wait_to_read()
+                net(inp).wait_to_read()
             else:
                 with autograd.record():
-                    out = net(x)
+                    out = net(inp)
                 out.backward()
 
         step()  # first step may trace — that's the one allowed compile
@@ -53,6 +64,11 @@ def main():
                     if k.endswith("_trace")}
         for i in range(args.steps - 1):
             step()
+        if mode == "infer" and args.churn:
+            # deliberate shape churn: every distinct batch size is a
+            # fresh program unless shape buckets absorb it
+            for k in range(args.churn):
+                step(mx.nd.array(rng.rand(9 + k, 10).astype("float32")))
         after = {k: v for k, v in profiler.stats().items()
                  if k.endswith("_trace")}
         grew = {k: (baseline.get(k, 0), v) for k, v in after.items()
@@ -64,6 +80,15 @@ def main():
         for mode, grew in failures:
             print("FAIL: %s hot path retraced after step 1: %s"
                   % (mode, grew), file=sys.stderr)
+        culprits = mx.inspect.blame_summary().most_common(5)
+        if culprits:
+            print("top retrace-blame culprits (mx.inspect):",
+                  file=sys.stderr)
+            for blame, count in culprits:
+                print("  %dx %s" % (count, blame), file=sys.stderr)
+        else:
+            print("no retrace blame recorded (first-ever compiles, or "
+                  "MXTPU_INSPECT=0)", file=sys.stderr)
         return 1
     print("OK: no retrace after step 1 (stats: %s)"
           % {k: v for k, v in profiler.stats().items() if "_trace" in k})

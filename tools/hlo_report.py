@@ -1,25 +1,32 @@
 #!/usr/bin/env python
-"""Static attribution of the fused train program's compiled HLO.
+"""Static attribution of a compiled train program's optimized HLO.
 
-Complements tools/profile_train.py (wall-clock phase attribution): this
-dumps what XLA actually compiled for the SAME ResNet-50 fused train
-program bench.py times — convolution count/dtypes/shapes, explicit
-transpose/copy ops that survived fusion, fusion kind histogram, XLA's
-own FLOP estimate (cost_analysis) vs the 12.3 GFLOP/img analytic
-number, and the peak memory analysis. Use it to decide whether an MFU
-gap is layout traffic (transposes/copies), dtype promotion (f32 convs
-under an amp scope), or genuine conv inefficiency (small spatial dims /
-channel counts vs the 128x128 MXU).
+Built on the `mx.inspect` program registry: the fused train program of
+ANY Module/HybridBlock is AOT-lowered, compiled, registered, and
+reported — convolution count/dtypes/shapes, explicit transpose/copy
+ops that survived fusion, fusion-kind histogram, XLA's own FLOP
+estimate (cost_analysis) and the peak memory analysis.  For the
+ResNet models the report also compares against the 12.3 GFLOP/img
+analytic number.  Use it to decide whether an MFU gap is layout
+traffic (transposes/copies), dtype promotion (f32 convs under an amp
+scope), or genuine kernel inefficiency vs the 128x128 MXU.
+
+Models: any `gluon.model_zoo.vision` name (resnet50_v1, resnet18_v1,
+mobilenet1.0, ...), the built-in ``mlp`` (2-layer,
+``--in-dim``/``--hidden``), or ``--symbol-json FILE`` for a graph
+exported by `HybridBlock.export` / `Symbol.save` (data shape from
+``--batch``/``--data-shape``).
 
 Usage:  python tools/hlo_report.py --batch 128 --dtype bfloat16 --spp 2
-        JAX_PLATFORMS=cpu python tools/hlo_report.py --batch 8 --image 64
+        JAX_PLATFORMS=cpu python tools/hlo_report.py --model mlp --batch 8
+        JAX_PLATFORMS=cpu python tools/hlo_report.py \
+            --symbol-json net-symbol.json --data-shape 4,3,32,32
 """
 import argparse
-import collections
 import json
 import os
-import re
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
@@ -29,158 +36,134 @@ import numpy as np
 TRAIN_GFLOP_PER_IMG_224 = 12.3
 
 
-def build(batch, image, dtype, spp):
+def _build_net(args):
+    """The model's head symbol + data shape for one batch."""
     import mxtpu as mx
     from mxtpu import sym
-    from mxtpu.fused_train import FusedTrainLoop
-    from mxtpu.gluon.model_zoo import vision
-    from mxtpu.io.io import DataBatch
+    from mxtpu.gluon import nn
 
+    if args.model == "mlp":
+        net = nn.HybridSequential(prefix="mlp_")
+        with net.name_scope():
+            net.add(nn.Dense(args.hidden, activation="relu"),
+                    nn.Dense(args.classes))
+        data_shape = (args.batch, args.in_dim)
+    else:
+        from mxtpu.gluon.model_zoo import vision
+
+        net = vision.get_model(args.model, classes=args.classes)
+        data_shape = (args.batch, 3, args.image, args.image)
     ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
-    with mx.amp.scope(dtype if dtype != "float32" else None):
-        net = vision.resnet50_v1(classes=1000)
-        net.initialize(ctx=ctx)
-        x_trace = mx.nd.zeros((batch, 3, image, image), ctx=ctx)
-        out_sym, _, _ = net._trace_symbol(x_trace)
-        softmax = sym.SoftmaxOutput(data=out_sym,
-                                    label=sym.Variable("softmax_label"),
-                                    name="softmax")
-        mod = mx.mod.Module(softmax, data_names=("data0",),
+    net.initialize(ctx=ctx)
+    x_trace = mx.nd.zeros(data_shape, ctx=ctx)
+    out_sym, _, _ = net._trace_symbol(x_trace)
+    softmax = sym.SoftmaxOutput(data=out_sym,
+                                label=sym.Variable("softmax_label"),
+                                name="softmax")
+    return softmax, data_shape
+
+
+def _load_symbol(args):
+    import mxtpu as mx
+    from mxtpu import sym
+
+    graph = mx.sym.load(args.symbol_json)
+    shape = tuple(int(s) for s in args.data_shape.split(",") if s)
+    if not shape:
+        shape = (args.batch, args.in_dim)
+    head = graph if "softmax" in graph.name.lower() else \
+        sym.SoftmaxOutput(data=graph, label=sym.Variable("softmax_label"),
+                          name="softmax")
+    return head, shape
+
+
+def build(args):
+    """Bind the model's fused train program and register its compiled
+    form in the mx.inspect registry (no training step runs)."""
+    import mxtpu as mx
+    from mxtpu.fused_train import FusedTrainLoop
+    from mxtpu.io.io import DataBatch
+    from mxtpu import amp
+
+    with amp.scope(args.dtype if args.dtype != "float32" else None):
+        if args.symbol_json:
+            softmax, data_shape = _load_symbol(args)
+        else:
+            softmax, data_shape = _build_net(args)
+        data_name = softmax.list_arguments()[0]
+        mod = mx.mod.Module(softmax, data_names=(data_name,),
                             label_names=("softmax_label",))
-        mod.bind(data_shapes=[("data0", (batch, 3, image, image))],
-                 label_shapes=[("softmax_label", (batch,))])
+        mod.bind(data_shapes=[(data_name, data_shape)],
+                 label_shapes=[("softmax_label", (data_shape[0],))])
         mod.init_params()
         mod.init_optimizer(optimizer="sgd",
                            optimizer_params={"learning_rate": 0.01,
                                              "momentum": 0.9})
-    loop = FusedTrainLoop(mod, steps_per_program=spp)
+    loop = FusedTrainLoop(mod, steps_per_program=args.spp)
     rng = np.random.RandomState(0)
     batches = [DataBatch(
-        data=[mx.nd.array(rng.rand(batch, 3, image, image)
-                          .astype(np.float32), ctx=ctx)],
-        label=[mx.nd.array(rng.randint(0, 1000, batch)
-                           .astype(np.float32), ctx=ctx)])
-        for _ in range(spp)]
+        data=[mx.nd.array(rng.rand(*data_shape).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, args.classes, data_shape[0])
+                           .astype(np.float32))])
+        for _ in range(args.spp)]
     stacked = loop.stack_batches(batches)
-    return loop, stacked
-
-
-def analyze_text(hlo):
-    """Histogram the optimized HLO: op kinds, conv dtypes/shapes,
-    surviving transposes/copies (layout traffic XLA could not fuse).
-
-    Ops inside `%fused_*` computation bodies are excluded — a transpose
-    folded into a fusion costs no extra HBM round-trip; only top-level
-    (entry / while-body / conditional) instructions are materialized."""
-    ops = collections.Counter()
-    convs = []
-    transposes = []
-    copies = 0
-    in_fusion_body = False
-    for line in hlo.splitlines():
-        s = line.strip()
-        if s.endswith("{") and "(" in s:  # computation header
-            name = s.lstrip("%").split()[0]
-            in_fusion_body = name.startswith(("fused_", "%fused_")) \
-                or ".fused" in name
-            continue
-        if s == "}":
-            in_fusion_body = False
-            continue
-        if in_fusion_body:
-            continue
-        m = re.match(r"\S+\s+=\s+(\w+)\[([\d,]*)\]\S*\s+(\S+?)\(", s)
-        if not m:
-            continue
-        dtype, shape, op = m.group(1), m.group(2), m.group(3)
-        ops[op] += 1
-        if op == "convolution":
-            convs.append((dtype, shape,
-                          ("window=" + re.search(r"window={([^}]*)}", s)
-                           .group(1)) if "window={" in s else ""))
-        elif op == "transpose":
-            transposes.append((dtype, shape))
-        elif op == "copy":
-            copies += 1
-    return ops, convs, transposes, copies
+    # AOT: lower + compile WITHOUT running, then hand the executable to
+    # the registry (the same record run_stacked would populate)
+    t0 = time.perf_counter()
+    compiled = loop.lower_stacked(stacked).compile()
+    loop._insp.record_aot("train", stacked, compiled,
+                          time.perf_counter() - t0)
+    return loop
 
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50_v1",
+                    help="gluon model_zoo name, or 'mlp'")
+    ap.add_argument("--symbol-json", default="",
+                    help="report an exported symbol instead of --model")
+    ap.add_argument("--data-shape", default="",
+                    help="comma shape for --symbol-json data input")
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--in-dim", type=int, default=64,
+                    help="mlp input features")
+    ap.add_argument("--hidden", type=int, default=32,
+                    help="mlp hidden width")
+    ap.add_argument("--classes", type=int, default=1000)
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--spp", type=int, default=2)
     ap.add_argument("--dump", default="",
                     help="also write full optimized HLO text here")
     args = ap.parse_args()
+    if args.model == "mlp" and args.classes == 1000:
+        args.classes = 10
 
-    loop, stacked = build(args.batch, args.image, args.dtype, args.spp)
-    compiled = loop.lower_stacked(stacked).compile()
-    hlo = compiled.as_text()
+    import mxtpu as mx
+
+    # this tool IS the inspector's CLI: a disabled registry
+    # (MXTPU_INSPECT=0 in the caller's env) would leave it nothing to
+    # report on
+    mx.inspect.enable(True)
+    loop = build(args)
+    report = mx.inspect.report(loop._insp, kind="train")
+    report["config"] = {"model": args.symbol_json or args.model,
+                        "batch": args.batch, "image": args.image,
+                        "dtype": args.dtype, "spp": args.spp}
     if args.dump:
         with open(args.dump, "w") as f:
-            f.write(hlo)
+            f.write(mx.inspect.hlo(loop._insp.name, kind="train"))
 
-    ops, convs, transposes, copies = analyze_text(hlo)
-    cost = {}
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        cost = {k: ca[k] for k in ("flops", "bytes accessed",
-                                   "transcendentals")
-                if k in ca}
-    except Exception as e:
-        cost = {"error": str(e)[:200]}
-    mem = {}
-    try:
-        ma = compiled.memory_analysis()
-        mem = {
-            "argument_mb": round(ma.argument_size_in_bytes / 2**20, 1),
-            "output_mb": round(ma.output_size_in_bytes / 2**20, 1),
-            "temp_mb": round(ma.temp_size_in_bytes / 2**20, 1),
-            # the fused program donates (params, opt-state, aux), so the
-            # outputs alias those argument buffers — peak is args+temps,
-            # NOT args+outputs+temps (outputs would double-count)
-            "peak_mb_args_plus_temp": round(
-                (ma.argument_size_in_bytes + ma.temp_size_in_bytes)
-                / 2**20, 1),
-        }
-    except Exception as e:
-        mem = {"error": str(e)[:200]}
-
-    images = args.batch * args.spp
-    analytic_gflop = images * TRAIN_GFLOP_PER_IMG_224 \
-        * (args.image / 224.0) ** 2
-    conv_dtypes = collections.Counter(d for d, _, _ in convs)
-    t_bytes = 0
-    dt_size = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
-               "pred": 1, "s8": 1, "u8": 1}
-    for d, shape in transposes:
-        n = 1
-        for s in shape.split(","):
-            if s:
-                n *= int(s)
-        t_bytes += n * dt_size.get(d, 4)
-
-    report = {
-        "config": {"batch": args.batch, "image": args.image,
-                   "dtype": args.dtype, "spp": args.spp},
-        "op_histogram_top": dict(ops.most_common(15)),
-        "n_convolutions": len(convs),
-        "conv_dtypes": dict(conv_dtypes),
-        "n_transposes_surviving": len(transposes),
-        "transpose_traffic_mb": round(t_bytes / 2**20, 1),
-        "n_copies_surviving": copies,
-        "xla_cost_analysis": cost,
-        "analytic_gflop_per_program": round(analytic_gflop, 1),
-        "memory": mem,
-    }
-    if "flops" in cost:
-        report["xla_vs_analytic_flops"] = round(
-            float(cost["flops"]) / (analytic_gflop * 1e9), 3)
-    print(json.dumps(report, indent=1))
+    flops = (report.get("cost") or {}).get("flops")
+    if args.model.startswith("resnet") and not args.symbol_json:
+        images = args.batch * args.spp
+        analytic_gflop = images * TRAIN_GFLOP_PER_IMG_224 \
+            * (args.image / 224.0) ** 2
+        report["analytic_gflop_per_program"] = round(analytic_gflop, 1)
+        if flops:
+            report["xla_vs_analytic_flops"] = round(
+                float(flops) / (analytic_gflop * 1e9), 3)
+    print(json.dumps(report, indent=1, default=str))
 
 
 if __name__ == "__main__":
