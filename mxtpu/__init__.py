@@ -106,6 +106,7 @@ from . import callback
 from . import profiler
 from . import telemetry
 from . import inspect
+from . import health
 from . import resilience
 from . import monitor
 from . import visualization

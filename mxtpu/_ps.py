@@ -626,6 +626,10 @@ class Scheduler(object):
         aggregate = _telemetry.aggregate_stats(
             s.get("stats") for s in nodes.values())
         return {"nodes": nodes, "aggregate": aggregate,
+                # training-health rollup over the heartbeat-shipped
+                # snapshots: anomaly counts + first non-finite blame
+                # per node (anomaly events ride the same heartbeats)
+                "health": _telemetry.health_rollup(nodes),
                 "gen": gen, "dead": dead}
 
     def _register(self, msg):

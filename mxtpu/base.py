@@ -20,6 +20,7 @@ __all__ = [
     "KVStoreTimeoutError",
     "PSConnectError",
     "ServerDiedError",
+    "MemoryExhaustedError",
     "string_types",
     "numeric_types",
     "integer_types",
@@ -64,6 +65,21 @@ class ServerDiedError(MXNetError):
     subclass: retrying cannot fix a dead server without a replica, so
     the resilience layer propagates this immediately instead of
     spinning until the retry deadline."""
+
+
+class MemoryExhaustedError(MXNetError, MemoryError):
+    """Device HBM exhausted (XLA ``RESOURCE_EXHAUSTED``), re-raised by
+    ``mxtpu.health.oom_scope`` with a forensic ``report`` attached:
+    per-program peak/argument/temp bytes from the `mx.inspect`
+    registry (programs are named by layer/block, so memory attributes
+    to model parts), device allocator stats, and the top live buffers.
+    Subclasses MemoryError so generic OOM handling still recognizes
+    it; retrying is pointless, so the resilience retry layer does NOT
+    treat it as transient."""
+
+    def __init__(self, msg: str, report: Optional[dict] = None):
+        super().__init__(msg)
+        self.report = report or {}
 
 string_types = (str,)
 numeric_types = (float, int, np.generic)

@@ -24,12 +24,16 @@ import numpy as np
 from .base import MXNetError
 from . import autograd as _ag
 from . import compile_cache as _cc
+from . import health as _health
 from .context import current_context
 from .executor import _build_graph_fn
 from .ndarray.ndarray import NDArray
 from .symbol.symbol import Symbol
 
 __all__ = ["CachedOp"]
+
+_OOM_CALL = _health.oom_scope("cachedop")
+_OOM_FUSED = _health.oom_scope("cachedop:fused")
 
 _DATA_NAME_RE = re.compile(r"^data\d*$")
 
@@ -47,6 +51,12 @@ class CachedOp(object):
         self._arg_names = sym.list_arguments()
         self._aux_names = sym.list_auxiliary_states()
         self._n_outputs = len(sym.list_outputs())
+        from . import amp as _amp
+
+        # the compute-dtype policy _build_graph_fn bakes in below —
+        # remembered so a health diagnosis re-executes under the SAME
+        # casts this op compiled with
+        self._amp_dtype = _amp.get_compute_dtype()
 
         infer_fn = _build_graph_fn(sym, self._arg_names, self._aux_names,
                                    is_train=False)
@@ -131,6 +141,11 @@ class CachedOp(object):
 
     def __call__(self, args: Sequence[NDArray],
                  aux_arrays: Sequence[NDArray] = ()):
+        with _OOM_CALL:
+            return self._call_impl(args, aux_arrays)
+
+    def _call_impl(self, args: Sequence[NDArray],
+                   aux_arrays: Sequence[NDArray] = ()):
         if len(args) != len(self._arg_names):
             raise MXNetError("CachedOp expects %d args (%s), got %d"
                              % (len(self._arg_names), self._arg_names,
@@ -143,6 +158,17 @@ class CachedOp(object):
         ctx = args[0].ctx if args else current_context()
         training = _ag.is_training()
         recording = _ag.is_recording()
+        if training and _health.want_context():
+            # NaN-provenance context for the gluon Trainer path: hold
+            # the NDArray wrappers (aux write-back updates them in
+            # place) so a non-finite grad detected at trainer.step can
+            # re-execute this dispatch and name the first bad layer.
+            # want_context(): stop paying once the per-process
+            # diagnosis budget is spent
+            _health.register_context("cachedop", self._symbol,
+                                     self._arg_names, self._aux_names,
+                                     list(args), list(aux_arrays),
+                                     key, self._amp_dtype)
 
         if recording:
             tok = self._track_sig("train" if training else "infer", flat)
@@ -354,6 +380,12 @@ class CachedOp(object):
     def call_fused(self, args: Sequence[NDArray],
                    aux_arrays: Sequence[NDArray] = (),
                    stacked_idx: Sequence[int] = ()):
+        with _OOM_FUSED:
+            return self._call_fused_impl(args, aux_arrays, stacked_idx)
+
+    def _call_fused_impl(self, args: Sequence[NDArray],
+                         aux_arrays: Sequence[NDArray] = (),
+                         stacked_idx: Sequence[int] = ()):
         """Forward-only inference over K batches in ONE device program.
 
         Each arg whose index is in ``stacked_idx`` carries a leading K

@@ -36,8 +36,14 @@ from .base import MXNetError, np_dtype
 from .context import Context, current_context
 from .ndarray.ndarray import NDArray
 from .symbol.symbol import Symbol, _topo_order
+from . import health as _health
 
 __all__ = ["Executor"]
+
+# reusable (stateless) HBM-forensics guards — one per dispatch surface,
+# so the hot path pays one `with` and no allocation
+_OOM_FWD = _health.oom_scope("executor")
+_OOM_BWD = _health.oom_scope("executor:backward")
 
 _BN_OPS = {"BatchNorm", "BatchNorm_v1", "_contrib_SyncBatchNorm"}
 
@@ -413,6 +419,12 @@ class Executor(object):
         return self._forward_impl(is_train, **kwargs)
 
     def _forward_impl(self, is_train: bool = False, **kwargs):
+        # HBM forensics: a RESOURCE_EXHAUSTED escaping any dispatch
+        # below re-raises as MemoryExhaustedError + attribution report
+        with _OOM_FWD:
+            return self._forward_dispatch(is_train, **kwargs)
+
+    def _forward_dispatch(self, is_train: bool = False, **kwargs):
         from . import compile_cache as _cc
         from . import profiler as _prof
 
@@ -438,6 +450,17 @@ class Executor(object):
             dst._set_jax(src._data.astype(dst.dtype)
                          if src.dtype != dst.dtype else src._data)
         key = self._key()
+        if is_train and self._diff_idx and _health.want_context():
+            # NaN-provenance context: the NDArray wrappers (not raw jax
+            # buffers — aux donation would kill those) + this step's
+            # RNG key, so a later non-finite detection can re-execute
+            # THIS dispatch eagerly and blame the first offending
+            # layer.  want_context() = enabled AND diagnosis budget
+            # left, so spent processes stop paying for capture
+            _health.register_context("executor", self._symbol,
+                                     self._arg_names, self._aux_names,
+                                     self.arg_arrays, self.aux_arrays,
+                                     key, self._amp_dtype)
         self._last_key = key  # reused by explicit-ograd backward so the
         # gradients see the SAME dropout/random masks as these outputs
         # when donating, the pre-step aux buffers die inside the jit
@@ -626,6 +649,10 @@ class Executor(object):
         return self
 
     def backward(self, out_grads=None):
+        with _OOM_BWD:
+            return self._backward_impl(out_grads)
+
+    def _backward_impl(self, out_grads=None):
         if not self._diff_idx:
             return
         if out_grads is None:

@@ -29,7 +29,9 @@ Usage (single-device Module, local/absent kvstore)::
     loop = FusedTrainLoop(module, steps_per_program=8)
     for chunk in chunks_of(batches, 8):
         outputs = loop.run(chunk)          # ONE dispatch, 8 steps
-    loop.finalize()                        # publish params/opt state
+    loop.finalize()  # publish params/opt state + drain the deferred
+                     # health read (guard-off non-finite detection for
+                     # the LAST chunk happens here — do call it)
 """
 from __future__ import annotations
 
@@ -40,9 +42,12 @@ import numpy as np
 from .base import MXNetError
 from .executor import _build_graph_fn
 from .ndarray.ndarray import NDArray
+from . import health as _health
 from . import resilience as _res
 
 __all__ = ["FusedTrainLoop"]
+
+_OOM_RUN = _health.oom_scope("fused_train")
 
 
 class FusedTrainLoop(object):
@@ -150,6 +155,16 @@ class FusedTrainLoop(object):
         # lr schedule stays aligned with wall steps).
         self._guard = _res.BadStepGuard(site="fused_train") \
             if _res.max_bad_steps() > 0 else None
+        # health observatory (mx.health): even without the guard, the
+        # scanned program carries per-step grad finiteness + the global
+        # grad norm out (one fused reduction — the always-on cheap
+        # mode).  Guard armed => flags are read synchronously (the
+        # skip/abort contract needs them NOW); guard off => the flags
+        # are read one chunk LATER so the loop never stalls on them.
+        self._track_health = self._guard is not None or _health.enabled()
+        self._stats_on = _health.enabled() and _health.stats_every() > 0
+        self._stats_count = 0
+        self._pending_health = None  # (t0, key, stack, bad_dev, gn_dev)
 
         self._jit_program = jax.jit(self._make_program(),
                                     donate_argnums=(0, 1, 2))
@@ -182,6 +197,8 @@ class FusedTrainLoop(object):
         step = self._scan_step.step
         collect = self._collect
         guard_on = self._guard is not None
+        track_health = self._track_health
+        stats_on = self._stats_on
 
         def program(p_vals, s_tree, aux_vals, fixed_vals, base_key, t0,
                     data_stack, lr_rows):
@@ -205,20 +222,36 @@ class FusedTrainLoop(object):
                 zaux = [jnp.zeros_like(a) for a in aux_new]
                 (grads,) = vjp((ones, zaux))
                 new_p, new_s = step(p, s, grads, lr_row)
-                if guard_on:
+                if track_health:
+                    # in-graph grad health: finiteness + global l2 norm
+                    # in the same fused reductions (a norm overflow is
+                    # folded into the flag so isfinite(sq) can't mask a
+                    # per-element NaN)
+                    sq = jnp.float32(0.0)
                     ok = jnp.bool_(True)
+                    if stats_on:
+                        lnorms = []
                     for g in grads:
-                        ok = ok & jnp.isfinite(g).all()
-                    # non-finite step: keep params, opt state AND aux
-                    # (a blown-up forward poisons BN stats too)
-                    new_p = [jnp.where(ok, a, b)
-                             for a, b in zip(new_p, p)]
-                    new_s = jax.tree_util.tree_map(
-                        lambda a, b: jnp.where(ok, a, b), new_s, s)
-                    aux_new = [jnp.where(ok, a, b)
-                               for a, b in zip(aux_new, aux)]
+                        g32 = g.astype(jnp.float32)
+                        gsq = jnp.sum(jnp.square(g32))
+                        sq = sq + gsq
+                        ok = ok & jnp.isfinite(g32).all()
+                        if stats_on:
+                            lnorms.append(jnp.sqrt(gsq))
+                    ok = ok & jnp.isfinite(sq)
+                    if guard_on:
+                        # non-finite step: keep params, opt state AND
+                        # aux (a blown-up forward poisons BN stats too)
+                        new_p = [jnp.where(ok, a, b)
+                                 for a, b in zip(new_p, p)]
+                        new_s = jax.tree_util.tree_map(
+                            lambda a, b: jnp.where(ok, a, b), new_s, s)
+                        aux_new = [jnp.where(ok, a, b)
+                                   for a, b in zip(aux_new, aux)]
                     ys = {"outs": tuple(outs) if collect else (),
-                          "bad": ~ok}
+                          "bad": ~ok, "gnorm": jnp.sqrt(sq)}
+                    if stats_on:
+                        ys["lnorms"] = tuple(lnorms)
                 else:
                     ys = tuple(outs) if collect else ()
                 return (new_p, new_s, aux_new, t + 1), ys
@@ -296,6 +329,7 @@ class FusedTrainLoop(object):
         from . import inspect as _insp_mod
 
         K = self._K
+        t_base = self._t
         base_key = _rnd._next_key() if self._exec._has_rng \
             else jax.random.PRNGKey(0)
         tok = _insp_mod.track_compile(
@@ -304,13 +338,31 @@ class FusedTrainLoop(object):
             arg_names=[self._arg_names[i] for i in self._data_idx])
         prog_args = self._program_args(data_stack, base_key)
         t0 = _time.monotonic()
-        p, s, aux, outs = self._jit_program(*prog_args)
+        with _OOM_RUN:
+            p, s, aux, outs = self._jit_program(*prog_args)
         if tok is not None:
             tok.done(self._jit_program, prog_args)
-        bad_flags = None
-        if self._guard is not None:
-            bad_flags = np.asarray(outs["bad"])
+        bad_flags = gnorms = lnorms = prev_health = None
+        if self._track_health:
+            bad_dev, gn_dev = outs["bad"], outs["gnorm"]
+            lnorms = outs.get("lnorms")
             outs = outs["outs"]
+            if self._guard is not None:
+                # guard armed: the skip/abort contract needs the flags
+                # NOW (synchronous read — the PR 2 behavior)
+                bad_flags = np.asarray(bad_dev)
+                gnorms = np.asarray(gn_dev)
+            else:
+                # guard off: defer the host read one chunk — by the
+                # next run these scalars are long since materialized,
+                # so the loop never stalls on its own health check.
+                # The batch stacks are held ONLY while a diagnosis
+                # could still run (bounded by MXTPU_HEALTH_MAX_DIAG).
+                prev_health = self._pending_health
+                self._pending_health = (
+                    t_base, base_key,
+                    data_stack if _health.want_context() else None,
+                    bad_dev, gn_dev)
         self._p_vals, self._s_tree, self._aux_vals = p, s, aux
         self._t += K
         self._optimizer.commit_scan_steps(self._opt_indices, K)
@@ -319,19 +371,100 @@ class FusedTrainLoop(object):
         # is the second dim of the staged (K, batch, ...) stacks
         batch = int(data_stack[0].shape[1]) \
             if data_stack and getattr(data_stack[0], "ndim", 0) > 1 else 0
+        skipped_n = int(bad_flags.sum()) if bad_flags is not None else None
         _tel.record_step(batch_size=batch, n=K,
                          duration=_time.monotonic() - t0,
-                         site="fused_train")
+                         site="fused_train", skipped_n=skipped_n,
+                         grad_norm=float(gnorms[-1])
+                         if gnorms is not None else None)
+        if self._stats_on and lnorms is not None:
+            self._maybe_emit_stats(lnorms)
         if bad_flags is not None:
             # state is already published (skipped steps kept the old
-            # buffers in-program); now account per-step health and
-            # abort on too many CONSECUTIVE skips
+            # buffers in-program); blame the FIRST bad step, then
+            # account per-step health and abort on too many
+            # CONSECUTIVE skips
+            if bad_flags.any():
+                k = int(np.argmax(bad_flags))
+                _health.on_nonfinite(
+                    "fused_train", gnorm=float(gnorms[k]),
+                    ctx=self._diag_ctx(data_stack, base_key, t_base, k))
+            for gn, bad in zip(gnorms, bad_flags):
+                if not bad:
+                    _health.observe_grad_norm(float(gn))
             for bad in bad_flags:
                 self._guard.record(not bool(bad))
+        elif prev_health is not None:
+            self._check_pending(prev_health)
         if self._collect:
             ctx = self._exec._ctx
             return [NDArray(o, ctx=ctx, _committed=True) for o in outs]
         return None
+
+    # -- health hooks -----------------------------------------------------
+    def _diag_ctx(self, data_stack, base_key, t_base: int, k: int):
+        """Diagnosis context for scanned step ``k`` of a chunk: the
+        exact batch slice and RNG key that step saw, with the CURRENT
+        params/aux standing in for the mid-scan values (donation
+        consumed those; with the guard on, skipped steps kept the
+        pre-divergence buffers, so the stand-in is close)."""
+        import jax
+
+        ex = self._exec
+        full = [None] * len(self._arg_names)
+        for j, i in enumerate(self._diff_idx):
+            full[i] = self._p_vals[j]
+        for i in self._fixed_idx:
+            full[i] = ex.arg_arrays[i]
+        for j, i in enumerate(self._data_idx):
+            full[i] = data_stack[j][k]
+        key = jax.random.fold_in(base_key, t_base + k)
+        return ("fused_train", ex._symbol, self._arg_names,
+                ex._aux_names, full, list(ex.aux_arrays), key,
+                ex._amp_dtype)
+
+    def _check_pending(self, pending) -> None:
+        """Read the PREVIOUS chunk's deferred health scalars (ready by
+        now — their program finished before this chunk dispatched)."""
+        t_base, base_key, stack, bad_dev, gn_dev = pending
+        try:
+            bad = np.asarray(bad_dev)
+            gn = np.asarray(gn_dev)
+        except Exception:
+            return
+        if bad.any():
+            k = int(np.argmax(bad))
+            ctx = self._diag_ctx(stack, base_key, t_base, k) \
+                if stack is not None else None
+            _health.on_nonfinite("fused_train", gnorm=float(gn[k]),
+                                 ctx=ctx)
+        else:
+            for v in gn:
+                _health.observe_grad_norm(float(v))
+
+    def _maybe_emit_stats(self, lnorms) -> None:
+        """Opt-in per-layer stat streaming on the
+        ``MXTPU_HEALTH_STATS_EVERY`` cadence (counted in CHUNKS — each
+        run is K wall steps): grad norms come from the scanned program
+        (last step of the chunk), param norms from one fused reduction
+        over the published params."""
+        n = _health.stats_every()
+        if n <= 0:
+            return
+        self._stats_count += 1
+        if self._stats_count % n:
+            return
+        names = [self._arg_names[i] for i in self._diff_idx]
+        pn = _health.layer_norms(self._p_vals)
+        try:
+            opt = self._optimizer
+            lr = opt.lr if opt.lr_scheduler is None \
+                else opt.lr_scheduler(opt.num_update)
+            scale = abs(float(lr) * float(opt.rescale_grad))
+        except Exception:
+            scale = 1.0
+        _health.emit_stats(names, pn, [l[-1] for l in lnorms],
+                           scale=scale, site="fused_train")
 
     def run(self, batches: Sequence[Any]):
         """Stage K DataBatches and run them as one program."""
@@ -350,5 +483,10 @@ class FusedTrainLoop(object):
 
     def finalize(self):
         """Alias kept for symmetry with reference Trainer APIs; state is
-        already published after every run()."""
+        already published after every run().  Also drains the deferred
+        health read so the LAST chunk's non-finite steps still get
+        blamed."""
+        pending, self._pending_health = self._pending_health, None
+        if pending is not None:
+            self._check_pending(pending)
         self._publish()

@@ -175,6 +175,23 @@ class DataLoader(object):
         return self._batchify_fn([self._dataset[i] for i in indices])
 
     def __iter__(self):
+        # input-wait gauge (mx.health / docs/observability.md): time
+        # from the consumer ASKING for the next batch (this generator
+        # resuming) to the batch being ready — the host-input wait that
+        # separates "pipeline-bound" from "device-bound" step time
+        from ... import telemetry as _tel
+
+        it = self._iter_impl()
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            _tel.record_input_wait(time.perf_counter() - t0)
+            yield batch
+
+    def _iter_impl(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 # inline path: full retry policy on transient faults

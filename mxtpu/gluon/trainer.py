@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 from ..base import MXNetError
+from .. import health as _health
 from .. import optimizer as opt_mod
 from .. import resilience as _res
 from .. import telemetry as _tel
@@ -140,25 +141,54 @@ class Trainer(object):
             # check BEFORE the allreduce: with update_on_kvstore the
             # push itself applies the update, so a post-allreduce check
             # would come too late to skip anything (and a non-finite
-            # local grad makes the merged grad non-finite anyway)
+            # local grad makes the merged grad non-finite anyway).
+            # ONE fused finiteness+norm program over the whole grad
+            # tree (mx.health) replaces the old per-array sync loop.
             if self._bad_step_guard is None:
                 self._bad_step_guard = _res.BadStepGuard(site="trainer")
-            if self._bad_step_guard.record(self._grads_finite()):
+            finite, gnorm = _health.grad_check(self._grad_vals())
+            if not finite:
+                # provenance first (the blame record + flight dump must
+                # exist even if the guard aborts on this step)
+                _health.on_nonfinite("trainer", gnorm=gnorm)
+            if self._bad_step_guard.record(finite):
                 # still a wall step: the telemetry stream records it as
-                # skipped so the non-finite count stays per-step honest
+                # skipped — with the grad norm and step id, so a burst
+                # is diagnosable post-hoc from the flight recorder
                 _tel.record_step(batch_size=batch_size, skipped=True,
-                                 site="trainer")
+                                 site="trainer", grad_norm=gnorm)
                 return  # skip allreduce + update entirely
+            _health.observe_grad_norm(gnorm)
+        else:
+            # guard off: deferred no-stall grad monitoring on the
+            # MXTPU_HEALTH_CHECK_EVERY cadence
+            _health.monitor_grads("trainer", self._grad_vals)
         self._allreduce_grads()
+        # opt-in per-layer grad/param-norm streaming (before the update
+        # so |Δw|/|w| pairs this step's grads with its pre-step params)
+        _health.maybe_stream_stats(
+            self._stats_triple, site="trainer",
+            scale=abs(self.learning_rate * self._optimizer.rescale_grad))
         self._update(ignore_stale_grad)
         _tel.record_step(batch_size=batch_size, site="trainer")
 
-    def _grads_finite(self):
-        grads = []
+    def _grad_vals(self):
+        vals = []
         for param in self._params:
             if param.grad_req != "null" and param._data is not None:
-                grads.extend(g._data for g in param.list_grad())
-        return _res.all_finite(grads)
+                vals.extend(g._data for g in param.list_grad())
+        return vals
+
+    def _stats_triple(self):
+        """(names, param vals, grad vals) for health stat streaming
+        (first device replica — the others hold the same values)."""
+        names, ps, gs = [], [], []
+        for param in self._params:
+            if param.grad_req != "null" and param._data is not None:
+                names.append(param.name)
+                ps.append(param.list_data()[0]._data)
+                gs.append(param.list_grad()[0]._data)
+        return names, ps, gs
 
     def allreduce_grads(self):
         if not self._kv_initialized:

@@ -1,0 +1,786 @@
+"""Training-health observatory: NaN provenance, OOM forensics, watchdog.
+
+`mxtpu/telemetry.py` watches the *systems* axis (step latency, counters,
+flight records) and `mxtpu/inspect.py` the *compile* axis (programs,
+retrace blame).  This module watches the **model** axis — the questions
+an on-call engineer actually asks when a run goes sideways:
+
+  * **Numerics provenance** — which layer produced the first NaN/Inf?
+    The cheap always-on mode computes loss / global-grad-norm
+    finiteness *in-graph* (one fused reduction program — never the old
+    one-sync-per-array loop) and reads the scalar on a DEFERRED
+    schedule so the training loop never stalls on it.  On first
+    detection a one-shot **diagnostic re-execution** walks the NNVM
+    graph eagerly, node by node (the same walk
+    ``executor._build_graph_fn`` traces, including the AMP casts and
+    the per-node RNG folding), checks every node output with
+    ``isfinite`` and blames the FIRST offender: a telemetry ``anomaly``
+    event, a ``health_nonfinite::<layer>`` counter, an entry in
+    :func:`report`, and a flight record all name the layer.  All three
+    dispatch paths participate: ``gluon.Trainer`` (CachedOp),
+    ``Module.update`` (Executor) and ``FusedTrainLoop`` (whose scanned
+    carry already computes per-step finiteness in-graph; it now also
+    carries the global grad norm out).
+
+  * **In-graph tensor-stat streaming** — ``MXTPU_HEALTH_STATS_EVERY=N``
+    (default 0 = off) computes per-layer grad/param norms and an
+    update-ratio estimate in ONE fused program every N steps, emitted
+    as telemetry ``tensor_stats`` records (rendered as chrome-trace
+    counter tracks by ``telemetry.merge_dir``) and summarized by
+    :func:`report`.  Opt-in and retrace-free when off: the training
+    programs are untouched (`tests/test_health.py` asserts the
+    compiled-signature count is identical).
+
+  * **HBM/OOM forensics** — every dispatch site runs under
+    :func:`oom_scope`: an XLA ``RESOURCE_EXHAUSTED`` is re-raised as
+    the typed :class:`~mxtpu.base.MemoryExhaustedError` carrying a
+    forensic report — per-program peak/argument/temp bytes from the
+    `mx.inspect` registry's ``memory_analysis`` (programs are named by
+    layer/block, so the report attributes HBM to model parts), device
+    allocator stats, and the top live buffers — and a flight record is
+    dumped before the raise.
+
+  * **Anomaly watchdog** — rolling-window detectors over the loss,
+    global grad norm and step time (spike vs the window median) emit
+    typed ``anomaly`` telemetry events, which ship on the scheduler
+    heartbeats into the ``kv.telemetry()`` cluster view and roll up in
+    ``launch.py --telemetry-dir``'s ``cluster.json``.
+
+Cost discipline (`tools/check_health.py` asserts <10us/step): the
+always-on per-step path is HOST bookkeeping only — a deque append, a
+cached-median compare, and (on cadence steps) reading an
+already-materialized device scalar.  The grad-health *program* runs
+synchronously only when the ``MXTPU_MAX_BAD_STEPS`` guard is armed
+(where it replaces N per-array syncs with one dispatch — strictly
+cheaper than PR 2's loop); otherwise it is dispatched every
+``MXTPU_HEALTH_CHECK_EVERY`` (16) steps and its scalar is read on the
+NEXT cadence step, by which time it is long since ready (no stall).
+The expensive paths — diagnostic re-execution, OOM report, stat
+streaming — run only on detection or cadence.  ``MXTPU_HEALTH=0``
+turns every hook into one bool check and adds ZERO records.
+
+See `docs/observability.md` §Training health for the blame workflow,
+the stat schema, and an OOM report example.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .base import MemoryExhaustedError, getenv, getenv_bool, getenv_int
+
+__all__ = [
+    "MemoryExhaustedError",
+    "enabled",
+    "enable",
+    "check_every",
+    "stats_every",
+    "grad_check",
+    "monitor_grads",
+    "register_context",
+    "on_nonfinite",
+    "observe_loss",
+    "observe_grad_norm",
+    "observe_step",
+    "maybe_stream_stats",
+    "stream_stats",
+    "emit_stats",
+    "layer_norms",
+    "want_context",
+    "oom_scope",
+    "is_oom",
+    "memory_report",
+    "report",
+    "reset",
+]
+
+_ENABLED = getenv_bool("MXTPU_HEALTH", True)
+_WINDOW = max(8, getenv_int("MXTPU_HEALTH_WINDOW", 64))
+# spike factors vs the rolling-window median (0 disables a detector)
+_LOSS_SPIKE = float(getenv("MXTPU_HEALTH_LOSS_SPIKE", "8") or 8)
+_GRAD_SPIKE = float(getenv("MXTPU_HEALTH_GRAD_SPIKE", "8") or 8)
+_STEP_SPIKE = float(getenv("MXTPU_HEALTH_STEP_SPIKE", "4") or 4)
+# at most this many one-shot diagnostic re-executions per process (each
+# walks the graph eagerly — milliseconds; a diverged run would
+# otherwise re-diagnose every step of the burst)
+_MAX_DIAG = max(1, getenv_int("MXTPU_HEALTH_MAX_DIAG", 4))
+
+_lock = threading.RLock()
+
+
+def enabled() -> bool:
+    """Health layer on?  ``MXTPU_HEALTH=0`` opts out entirely."""
+    return _ENABLED
+
+
+def enable(on: bool = True) -> None:
+    """Flip the health layer at runtime (tests / embedding)."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def check_every() -> int:
+    """Deferred grad-finiteness cadence (``MXTPU_HEALTH_CHECK_EVERY``,
+    default 16) used when the bad-step guard is not armed; 0 disables
+    the deferred monitor.  Read from the environment per call (sub-us)
+    so tests and embedders can retune a live process."""
+    return max(0, getenv_int("MXTPU_HEALTH_CHECK_EVERY", 16))
+
+
+def stats_every() -> int:
+    """Per-layer tensor-stat streaming cadence
+    (``MXTPU_HEALTH_STATS_EVERY``); 0 (default) = off."""
+    return max(0, getenv_int("MXTPU_HEALTH_STATS_EVERY", 0))
+
+
+class _Detector(object):
+    """Rolling-window spike detector: value > factor * window median.
+    Median is refreshed every ``_REFRESH`` appends (sorting 64 floats
+    per step would be measurable; a slightly stale median is not)."""
+
+    _REFRESH = 8
+    __slots__ = ("name", "factor", "window", "_median", "_since",
+                 "_last_fired", "fired")
+
+    def __init__(self, name: str, factor: float):
+        self.name = name
+        self.factor = factor
+        self.window: collections.deque = collections.deque(maxlen=_WINDOW)
+        self._median: Optional[float] = None
+        self._since = 0
+        self._last_fired = -10**9
+        self.fired = 0
+
+    def observe(self, value: float, step: int) -> Optional[float]:
+        """Append one observation; returns the violated median when the
+        value spikes (and arms a one-window cooldown), else None."""
+        spike = None
+        med = self._median
+        if (med is not None and self.factor > 0
+                and len(self.window) >= self._REFRESH
+                and value > self.factor * med and med > 0
+                and step - self._last_fired >= _WINDOW // 2):
+            self._last_fired = step
+            self.fired += 1
+            spike = med
+        self.window.append(value)
+        self._since += 1
+        if self._since >= self._REFRESH or med is None:
+            self._since = 0
+            s = sorted(self.window)
+            self._median = s[len(s) // 2]
+        return spike
+
+
+class _State(object):
+    def __init__(self):
+        self.loss = _Detector("loss_spike", _LOSS_SPIKE)
+        self.grad = _Detector("grad_explosion", _GRAD_SPIKE)
+        self.step_time = _Detector("step_time_regression", _STEP_SPIKE)
+        self.nonfinite: List[Dict[str, Any]] = []   # blame records
+        self.anomalies: List[Dict[str, Any]] = []   # watchdog firings
+        self.last_stats: Optional[Dict[str, Any]] = None
+        self.last_ctx: Optional[Tuple] = None       # diagnosis context
+        self.pending = None                         # in-flight (finite, norm)
+        self.pending_step = 0
+        self.monitor_count = 0
+        self.stats_count = 0
+        self.diagnoses = 0
+        self.last_bad_step = -10**9
+
+
+_STATE = _State()
+
+
+def reset() -> None:
+    """Drop all health state (tests)."""
+    global _STATE
+    with _lock:
+        _STATE = _State()
+
+
+# ---------------------------------------------------------------------------
+# In-graph grad health (finiteness + global norm in ONE program)
+# ---------------------------------------------------------------------------
+
+_GRAD_JIT = [None]
+
+
+def _grad_health_fn():
+    """fn(grads) -> (all_finite bool scalar, global l2 norm).  ONE
+    fused XLA program over the whole gradient pytree — replaces the
+    one-sync-per-array host loop the PR 2 guard used.  jax caches
+    compilations per input structure, so every distinct model compiles
+    this once."""
+    if _GRAD_JIT[0] is None:
+        import jax
+        import jax.numpy as jnp
+
+        def fn(gs):
+            sq = jnp.float32(0.0)
+            ok = jnp.bool_(True)
+            for g in jax.tree_util.tree_leaves(gs):
+                g32 = g.astype(jnp.float32)
+                sq = sq + jnp.sum(jnp.square(g32))
+                ok = ok & jnp.isfinite(g32).all()
+            # a norm overflow is itself a non-finiteness signal: fold it
+            # in so isfinite(sq) alone can't mask a per-element NaN
+            return ok & jnp.isfinite(sq), jnp.sqrt(sq)
+
+        _GRAD_JIT[0] = jax.jit(fn)
+    return _GRAD_JIT[0]
+
+
+def grad_check(grads) -> Tuple[bool, float]:
+    """Synchronous grad health: (all finite, global l2 norm) via the
+    one-program in-graph check.  Blocks on the device scalar — only
+    call on guard-armed paths (the PR 2 contract; the deferred
+    :func:`monitor_grads` is the no-stall variant)."""
+    vals = [g for g in grads if g is not None]
+    if not vals:
+        return True, 0.0
+    finite, norm = _grad_health_fn()(vals)
+    return bool(finite), float(norm)
+
+
+def monitor_grads(site: str, grads_fn: Callable[[], list]) -> None:
+    """Deferred always-on grad monitoring (guard OFF): every
+    ``MXTPU_HEALTH_CHECK_EVERY`` steps dispatch the in-graph health
+    program and read the PREVIOUS dispatch's scalar — by then it is
+    long since materialized, so the read never stalls the loop.
+    Non-cadence steps cost one counter bump.  On a non-finite reading,
+    :func:`on_nonfinite` runs the one-shot provenance diagnosis."""
+    if not _ENABLED:
+        return
+    every = check_every()
+    if every <= 0:
+        return
+    st = _STATE
+    st.monitor_count += 1
+    if st.monitor_count % every:
+        return
+    pending, pstep = st.pending, st.pending_step
+    st.pending = None
+    try:
+        vals = [g for g in grads_fn() if g is not None]
+        if vals:
+            st.pending = _grad_health_fn()(vals)
+            st.pending_step = _current_step()
+    except Exception:
+        st.pending = None
+    if pending is not None:
+        try:
+            finite, norm = bool(pending[0]), float(pending[1])
+        except Exception:
+            return
+        if not finite:
+            on_nonfinite(site, gnorm=norm, step=pstep)
+        else:
+            observe_grad_norm(norm, step=pstep)
+
+
+def _current_step() -> int:
+    from . import telemetry as _tel
+
+    return _tel.current_step()
+
+
+# ---------------------------------------------------------------------------
+# NaN provenance: diagnosis context + one-shot re-execution
+# ---------------------------------------------------------------------------
+
+def register_context(site: str, symbol, arg_names: Sequence[str],
+                     aux_names: Sequence[str], arg_vals, aux_vals,
+                     key, amp_dtype=None) -> None:
+    """Remember the latest training dispatch so a later non-finite
+    detection can re-execute it diagnostically.  Values may be raw jax
+    arrays or NDArray wrappers — wrappers are unwrapped (``._data``) at
+    DIAGNOSIS time, so a donated buffer (the executor's aux donation
+    kills the pre-step jax arrays) resolves to the live replacement
+    instead of a deleted array.  Per-step cost: two list builds."""
+    if not _ENABLED:
+        return
+    _STATE.last_ctx = (site, symbol, arg_names, aux_names,
+                       list(arg_vals), list(aux_vals), key, amp_dtype)
+
+
+def want_context() -> bool:
+    """Should dispatch sites still pay to capture/hold a diagnosis
+    context?  False once the per-process diagnosis budget
+    (``MXTPU_HEALTH_MAX_DIAG``) is spent — lets `FusedTrainLoop` drop
+    its held batch stacks instead of pinning HBM for diagnoses that
+    will never run."""
+    return _ENABLED and _STATE.diagnoses < _MAX_DIAG
+
+
+def _is_bad(v) -> bool:
+    """True when an array holds a NaN/Inf (host read — diagnosis only).
+    Non-float dtypes are finite by construction."""
+    import jax.numpy as jnp
+
+    try:
+        if not hasattr(v, "dtype") or \
+                not jnp.issubdtype(v.dtype, jnp.inexact):
+            return False
+        return not bool(jnp.isfinite(v).all())
+    except Exception:
+        return False
+
+
+def _unwrap(v):
+    """NDArray wrapper -> live jax array (see register_context)."""
+    return getattr(v, "_data", v)
+
+
+def diagnose(symbol, arg_names: Sequence[str], aux_names: Sequence[str],
+             arg_vals, aux_vals, key,
+             amp_dtype=None) -> Optional[Dict[str, Any]]:
+    """One-shot diagnostic re-execution: walk the NNVM graph EAGERLY in
+    topological order — the exact walk ``executor._build_graph_fn``
+    traces, AMP casts and RNG folding included — checking every value
+    with ``isfinite`` and stopping at the first offender.  Returns
+    ``{"layer", "op", "origin"}`` (origin ``input`` = a graph input /
+    parameter arrived non-finite; ``op`` = this node produced NaN/Inf
+    from finite inputs) or None when the whole forward is finite (the
+    non-finiteness arose in the backward pass only)."""
+    import jax
+
+    from . import amp as _amp
+    from .symbol.symbol import _topo_order
+
+    nodes = _topo_order(symbol._outputs)
+    arg_pos = {n: i for i, n in enumerate(arg_names)}
+    aux_pos = {n: i for i, n in enumerate(aux_names)}
+    env: Dict[Tuple[int, int], Any] = {}
+    rng_i = 0
+    with _amp.scope(amp_dtype):
+        for node in nodes:
+            if node.is_variable:
+                if node.is_aux:
+                    val = _unwrap(aux_vals[aux_pos[node.name]])
+                else:
+                    val = _unwrap(arg_vals[arg_pos[node.name]])
+                env[(id(node), 0)] = val
+                if _is_bad(val):
+                    return {"layer": node.name, "op": "variable",
+                            "origin": "input"}
+                continue
+            invals = [env[(id(inode), idx)] for inode, idx in node.inputs]
+            if amp_dtype is not None:
+                invals = _amp.cast_op_inputs(node.op.name, invals,
+                                             amp_dtype)
+            attrs = dict(node.attrs)
+            if node.op.train_aware:
+                attrs["is_train"] = True
+            try:
+                if node.op.needs_rng:
+                    sub = jax.random.fold_in(key, rng_i)
+                    rng_i += 1
+                    out = node.op.fn(sub, *invals, **attrs)
+                else:
+                    out = node.op.fn(*invals, **attrs)
+            except Exception as e:
+                # the op itself failing eagerly is its own diagnosis
+                return {"layer": node.name, "op": node.op.name,
+                        "origin": "error:%s" % str(e)[:120]}
+            if not isinstance(out, tuple):
+                out = (out,)
+            n_vis = node.op.n_outputs(node.attrs)
+            if len(out) > n_vis and node.attrs.get("sub_aux"):
+                out = out[:n_vis]
+            for i, o in enumerate(out):
+                env[(id(node), i)] = o
+            for o in out:
+                if _is_bad(o):
+                    return {"layer": node.name, "op": node.op.name,
+                            "origin": "op"}
+    return None
+
+
+def on_nonfinite(site: str, gnorm: Optional[float] = None,
+                 step: Optional[int] = None,
+                 ctx: Optional[Tuple] = None) -> Optional[Dict[str, Any]]:
+    """A non-finite loss/grad was detected at ``site``.  Runs the
+    one-shot provenance diagnosis (first detection of a burst only,
+    bounded by ``MXTPU_HEALTH_MAX_DIAG``), emits the ``anomaly``
+    telemetry event + ``health_nonfinite::<layer>`` counter, records
+    the blame for :func:`report`, and dumps a flight record.  Returns
+    the blame record (or None when disabled)."""
+    if not _ENABLED:
+        return None
+    from . import profiler as _prof
+    from . import telemetry as _tel
+
+    if step is None:
+        step = _current_step()
+    st = _STATE
+    with _lock:
+        new_burst = step > st.last_bad_step + 1
+        st.last_bad_step = max(st.last_bad_step, step)
+        may_diagnose = new_burst and st.diagnoses < _MAX_DIAG
+        if may_diagnose:
+            st.diagnoses += 1
+    _prof.inc_stat("health_nonfinite_steps")
+    blame = None
+    if may_diagnose:
+        use = ctx if ctx is not None else st.last_ctx
+        if use is not None:
+            c_site, symbol, argn, auxn, argv, auxv, key, ampd = use
+            try:
+                t0 = time.perf_counter()
+                blame = diagnose(symbol, argn, auxn, argv, auxv, key,
+                                 amp_dtype=ampd)
+                _prof.inc_stat("health_diagnoses")
+                if blame is None:
+                    # forward clean: the backward produced the
+                    # non-finite values (e.g. an exploding vjp)
+                    blame = {"layer": "(backward)", "op": "vjp",
+                             "origin": "backward"}
+                blame["site"] = site
+                blame["step"] = step
+                blame["diag_s"] = round(time.perf_counter() - t0, 4)
+                if gnorm is not None:
+                    blame["grad_norm"] = float(gnorm)
+            except Exception as e:  # diagnosis is best-effort
+                blame = {"layer": None, "op": None, "site": site,
+                         "step": step, "origin": "diag_error",
+                         "error": str(e)[:200]}
+    layer = (blame or {}).get("layer")
+    if layer:
+        _prof.inc_stat("health_nonfinite::%s" % layer)
+    rec = {"atype": "nonfinite", "site": site, "step": step}
+    if gnorm is not None:
+        rec["grad_norm"] = float(gnorm)
+    if layer:
+        rec["layer"] = layer
+        rec["origin"] = blame.get("origin")
+    _tel.record("anomaly", **rec)
+    if blame is not None:
+        with _lock:
+            st.nonfinite.append(blame)
+        _tel.dump_flight(
+            "nonfinite", "site=%s step=%s layer=%s origin=%s"
+            % (site, step, layer, blame.get("origin")))
+    return blame
+
+
+# ---------------------------------------------------------------------------
+# Anomaly watchdog
+# ---------------------------------------------------------------------------
+
+def _fire(detector: _Detector, value: float, median: float,
+          step: int, site: str) -> None:
+    from . import profiler as _prof
+    from . import telemetry as _tel
+
+    _prof.inc_stat("health_anomaly::%s" % detector.name)
+    rec = {"atype": detector.name, "value": round(float(value), 6),
+           "median": round(float(median), 6), "step": step, "site": site}
+    _tel.record("anomaly", **rec)
+    with _lock:
+        _STATE.anomalies.append(rec)
+
+
+def observe_loss(value, step: Optional[int] = None,
+                 site: str = "train") -> None:
+    """Feed one loss observation to the watchdog.  NaN/Inf losses route
+    to :func:`on_nonfinite`; a finite loss above
+    ``MXTPU_HEALTH_LOSS_SPIKE`` x the rolling median fires a
+    ``loss_spike`` anomaly."""
+    if not _ENABLED:
+        return
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return
+    if step is None:
+        step = _current_step()
+    if v != v or v in (float("inf"), float("-inf")):
+        on_nonfinite(site, step=step)
+        return
+    med = _STATE.loss.observe(v, step)
+    if med is not None:
+        _fire(_STATE.loss, v, med, step, site)
+
+
+def observe_grad_norm(value: float, step: Optional[int] = None,
+                      site: str = "train") -> None:
+    """Feed one global-grad-norm observation (``grad_explosion``
+    detector).  Called by the guard/monitor paths automatically."""
+    if not _ENABLED:
+        return
+    if step is None:
+        step = _current_step()
+    med = _STATE.grad.observe(float(value), step)
+    if med is not None:
+        _fire(_STATE.grad, value, med, step, site)
+
+
+def observe_step(step: int, dur_s: float, site: str = "train") -> None:
+    """Feed one step duration (``step_time_regression`` straggler
+    detector).  Wired into ``telemetry.record_step`` — the always-on
+    per-step host path; keep it allocation-light."""
+    if not _ENABLED or dur_s <= 0:
+        return
+    med = _STATE.step_time.observe(dur_s, step)
+    if med is not None:
+        _fire(_STATE.step_time, dur_s, med, step, site)
+
+
+# ---------------------------------------------------------------------------
+# In-graph tensor-stat streaming
+# ---------------------------------------------------------------------------
+
+_STATS_JIT = [None]
+
+
+def _stats_fn():
+    """fn(params, grads) -> (param_norms, grad_norms): per-layer l2
+    norms in ONE fused program (host reads K scalars on cadence steps
+    only)."""
+    if _STATS_JIT[0] is None:
+        import jax
+        import jax.numpy as jnp
+
+        def fn(ps, gs):
+            def norm(a):
+                return jnp.sqrt(jnp.sum(
+                    jnp.square(a.astype(jnp.float32))))
+
+            return [norm(p) for p in ps], [norm(g) for g in gs]
+
+        _STATS_JIT[0] = jax.jit(fn)
+    return _STATS_JIT[0]
+
+
+_NORMS_JIT = [None]
+
+
+def layer_norms(vals):
+    """Per-array l2 norms in ONE fused program (device scalars; jax
+    caches the compilation per input structure).  `FusedTrainLoop`
+    pairs these param norms with the grad norms its scanned program
+    already carried out."""
+    if _NORMS_JIT[0] is None:
+        import jax
+        import jax.numpy as jnp
+
+        def fn(vs):
+            return [jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32))))
+                    for v in vs]
+
+        _NORMS_JIT[0] = jax.jit(fn)
+    return _NORMS_JIT[0](list(vals))
+
+
+def maybe_stream_stats(pairs_fn: Callable[[], Tuple[List[str], list, list]],
+                       scale: float = 1.0, site: str = "train") -> None:
+    """Cadence gate for :func:`stream_stats`: every
+    ``MXTPU_HEALTH_STATS_EVERY`` calls, build the (names, params,
+    grads) triple via ``pairs_fn`` and stream the per-layer stats.
+    Off-cadence cost: one counter bump."""
+    n = stats_every()
+    if not _ENABLED or n <= 0:
+        return
+    st = _STATE
+    st.stats_count += 1
+    if st.stats_count % n:
+        return
+    try:
+        names, params, grads = pairs_fn()
+    except Exception:
+        return
+    stream_stats(names, params, grads, scale=scale, site=site)
+
+
+def stream_stats(names: Sequence[str], params, grads,
+                 scale: float = 1.0, site: str = "train") -> None:
+    """Compute per-layer param/grad norms in-graph and emit ONE
+    ``tensor_stats`` telemetry record::
+
+        {"kind": "tensor_stats", "step": N, "site": ...,
+         "stats": {layer: {"param_norm", "grad_norm", "update_ratio"}}}
+
+    ``update_ratio`` estimates |Δw|/|w| as ``scale * grad_norm /
+    param_norm`` (exact for plain SGD where scale = lr * rescale_grad;
+    an upper-bound proxy for adaptive optimizers).  ``merge_dir``
+    renders these as chrome-trace counter tracks."""
+    if not _ENABLED:
+        return
+    try:
+        pn, gn = _stats_fn()(list(params), list(grads))
+    except Exception:
+        return
+    emit_stats(names, pn, gn, scale=scale, site=site)
+
+
+def emit_stats(names: Sequence[str], param_norms, grad_norms,
+               scale: float = 1.0, site: str = "train",
+               step: Optional[int] = None) -> None:
+    """Emit one ``tensor_stats`` record from PRE-COMPUTED per-layer
+    norms (device scalars or floats).  :func:`stream_stats` feeds this
+    after its own in-graph reduction; ``FusedTrainLoop`` feeds it
+    directly with norms its scanned program already carried out."""
+    if not _ENABLED:
+        return
+    from . import profiler as _prof
+    from . import telemetry as _tel
+
+    stats: Dict[str, Dict[str, float]] = {}
+    for name, p, g in zip(names, param_norms, grad_norms):
+        p, g = float(p), float(g)
+        stats[name] = {
+            "param_norm": round(p, 6),
+            "grad_norm": round(g, 6),
+            "update_ratio": round(abs(scale) * g / (p + 1e-12), 8),
+        }
+        if g != g:  # per-layer NaN watch rides the stream for free
+            _prof.inc_stat("health_nonfinite::%s" % name)
+    if step is None:
+        step = _current_step()
+    _tel.record("tensor_stats", step=step, site=site, stats=stats)
+    _prof.inc_stat("health_stats_emitted")
+    with _lock:
+        _STATE.last_stats = {"step": step, "site": site, "stats": stats}
+    # NOT fed to the grad_explosion detector: the guard/monitor paths
+    # already observe the global norm for these same steps, and a
+    # second, differently-scaled sample (first-replica, post-allreduce)
+    # would pollute the rolling median
+
+
+# ---------------------------------------------------------------------------
+# HBM/OOM forensics
+# ---------------------------------------------------------------------------
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM ")
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Does this exception look like an XLA/PJRT memory exhaustion?"""
+    if isinstance(exc, MemoryExhaustedError):
+        return False  # already typed + reported
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def memory_report(top: int = 8) -> Dict[str, Any]:
+    """Forensic HBM snapshot: per-program peak/argument/temp bytes from
+    the `mx.inspect` registry (programs are keyed ``site:block-name``,
+    so the rows attribute memory to model parts), device allocator
+    stats, and the ``top`` largest live buffers."""
+    out: Dict[str, Any] = {"ts": time.time()}
+    programs = []
+    try:
+        from . import inspect as _insp
+
+        for rec in _insp.programs(analyze=True):
+            programs.append({
+                "program": rec.get("name"), "site": rec.get("site"),
+                "peak_bytes": rec.get("peak_bytes", 0),
+                "argument_bytes": rec.get("argument_bytes", 0),
+                "temp_bytes": rec.get("temp_bytes", 0),
+                "output_bytes": rec.get("output_bytes", 0),
+            })
+        programs.sort(key=lambda r: -(r["peak_bytes"] or 0))
+    except Exception as e:
+        out["registry_error"] = str(e)[:200]
+    out["programs"] = programs
+    try:
+        import jax
+
+        devs = {}
+        for dev in jax.local_devices():
+            try:
+                stats = getattr(dev, "memory_stats", lambda: None)()
+            except Exception:
+                stats = None
+            if stats:
+                devs[str(dev)] = {
+                    k: int(v) for k, v in stats.items()
+                    if isinstance(v, (int, float)) and "bytes" in k}
+        out["device_memory"] = devs
+        bufs = sorted(jax.live_arrays(), key=lambda a: -int(a.nbytes))
+        out["top_live_buffers"] = [
+            {"shape": tuple(a.shape), "dtype": str(a.dtype),
+             "mbytes": round(int(a.nbytes) / 2**20, 3)}
+            for a in bufs[:top]]
+        out["live_bytes_total"] = sum(int(a.nbytes) for a in bufs)
+    except Exception as e:
+        out["device_error"] = str(e)[:200]
+    return out
+
+
+def _raise_memory_error(site: str, exc: BaseException) -> None:
+    from . import profiler as _prof
+    from . import telemetry as _tel
+
+    _prof.inc_stat("health_oom")
+    rep = memory_report()
+    rep["site"] = site
+    rep["xla_error"] = str(exc)[:1000]
+    contributors = ", ".join(
+        "%s=%.1fMB" % (p["program"], (p["peak_bytes"] or 0) / 2**20)
+        for p in rep.get("programs", [])[:4]) or "none registered"
+    _tel.record("anomaly", atype="oom", site=site,
+                step=_current_step(),
+                top_program=(rep.get("programs") or [{}])[0]
+                .get("program"))
+    _tel.dump_flight("oom", "site=%s top=[%s]" % (site, contributors))
+    raise MemoryExhaustedError(
+        "device memory exhausted at %r — per-program peak bytes "
+        "(mx.inspect memory_analysis): [%s]; see .report for device "
+        "stats and top live buffers.  Original: %s"
+        % (site, contributors, str(exc)[:300]), report=rep) from exc
+
+
+class oom_scope(object):
+    """Zero-cost-on-success guard around a dispatch site: an XLA
+    ``RESOURCE_EXHAUSTED`` escaping the block is re-raised as the typed
+    :class:`MemoryExhaustedError` carrying :func:`memory_report`
+    (flight record dumped first).  Other exceptions pass through
+    untouched."""
+
+    __slots__ = ("site",)
+
+    def __init__(self, site: str):
+        self.site = site
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, etype, exc, tb):
+        if exc is not None and _ENABLED and is_oom(exc):
+            _raise_memory_error(self.site, exc)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Reporting
+# ---------------------------------------------------------------------------
+
+def report() -> Dict[str, Any]:
+    """The training-health summary of this process: non-finite blame
+    records (first-NaN layer provenance), watchdog anomaly firings,
+    detector medians, the latest streamed tensor stats, and the
+    ``health_*`` counter snapshot."""
+    from . import profiler as _prof
+
+    with _lock:
+        st = _STATE
+        out = {
+            "enabled": _ENABLED,
+            "nonfinite": list(st.nonfinite),
+            "anomalies": list(st.anomalies),
+            "detectors": {
+                d.name: {"n": len(d.window), "median": d._median,
+                         "fired": d.fired}
+                for d in (st.loss, st.grad, st.step_time)},
+            "tensor_stats": st.last_stats,
+            "diagnoses": st.diagnoses,
+        }
+    out["counters"] = {k: v for k, v in _prof.stats().items()
+                       if k.startswith("health_")}
+    return out

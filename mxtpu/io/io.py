@@ -93,7 +93,17 @@ class DataIter(object):
         raise StopIteration
 
     def __next__(self):
-        return self.next()
+        # input-wait gauge: nested iterators call `.next()` directly,
+        # so only the OUTERMOST (protocol-driven) hop records — no
+        # double counting (see telemetry.record_input_wait)
+        import time as _time
+
+        from .. import telemetry as _tel
+
+        t0 = _time.perf_counter()
+        batch = self.next()
+        _tel.record_input_wait(_time.perf_counter() - t0)
+        return batch
 
     def iter_next(self):
         return False

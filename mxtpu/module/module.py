@@ -19,6 +19,7 @@ from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
                      _update_params_on_kvstore, load_checkpoint,
                      load_latest as _load_latest_checkpoint,
                      save_checkpoint)
+from .. import health as _health
 from .. import resilience as _res
 from ..ndarray.ndarray import NDArray, zeros
 from .. import optimizer as opt_mod
@@ -417,6 +418,15 @@ class Module(BaseModule):
             raise MXNetError("init_optimizer() first")
         from .. import telemetry as _tel
 
+        # deferred no-stall grad health on the Executor path; detection
+        # re-executes the context the executor registered on its last
+        # train dispatch.  Runs regardless of MXTPU_MAX_BAD_STEPS: the
+        # Module path has no bad-step guard of its own (the Trainer /
+        # FusedTrainLoop guards do not cover it), so arming the guard
+        # must not silently turn monitoring OFF here.
+        _health.monitor_grads("module", self._grad_vals)
+        _health.maybe_stream_stats(self._stats_triple, site="module",
+                                   scale=self._update_scale())
         self._params_dirty = True
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
@@ -432,6 +442,39 @@ class Module(BaseModule):
                            param_names=self._exec_group.param_names)
         _tel.record_step(batch_size=self._exec_group.batch_size,
                          site="module")
+
+    def _grad_vals(self):
+        return [g._data
+                for glist in self._exec_group.grad_arrays
+                for g in glist if g is not None]
+
+    def _update_scale(self) -> float:
+        """lr x rescale_grad — makes the streamed update_ratio a real
+        |Δw|/|w| estimate for plain SGD (best-effort; 1.0 when the
+        optimizer hides its schedule)."""
+        try:
+            opt = self._optimizer
+            lr = opt.lr if opt.lr_scheduler is None \
+                else opt.lr_scheduler(opt.num_update)
+            return abs(float(lr) * float(opt.rescale_grad))
+        except Exception:
+            return 1.0
+
+    def _stats_triple(self):
+        """(names, param vals, grad vals) for health stat streaming
+        (first device replica)."""
+        g = self._exec_group
+        # param_arrays/grad_arrays skip param names absent from the
+        # graph args — mirror that filter so the zip stays aligned
+        pnames = [n for n in g.param_names if n in g.arg_names]
+        names, ps, gs = [], [], []
+        for name, parr, garr in zip(pnames, g.param_arrays,
+                                    g.grad_arrays):
+            if garr and garr[0] is not None:
+                names.append(name)
+                ps.append(parr[0]._data)
+                gs.append(garr[0]._data)
+        return names, ps, gs
 
     def get_outputs(self, merge_multi_context=True):
         return self._exec_group.get_outputs(merge_multi_context)

@@ -58,7 +58,7 @@ import time
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from .base import MXNetError, getenv, getenv_int
+from .base import MXNetError, MemoryExhaustedError, getenv, getenv_int
 
 __all__ = [
     "FAULT_SITES",
@@ -238,11 +238,13 @@ def maybe_fault(site: str, detail: str = "") -> None:
 #: ``KVStoreTimeoutError``, a ``TimeoutError`` subclass).
 TRANSIENT_ERRORS: Tuple[type, ...] = (InjectedFault, OSError)
 
-#: OSError subclasses no amount of retrying fixes — these propagate
-#: immediately and UNWRAPPED, preserving callers' exception contracts
-#: (e.g. probing a missing checkpoint must still see FileNotFoundError).
+#: Errors no amount of retrying fixes — these propagate immediately
+#: and UNWRAPPED, preserving callers' exception contracts (e.g. probing
+#: a missing checkpoint must still see FileNotFoundError; an HBM
+#: exhaustion re-dispatching identically will exhaust again).
 PERMANENT_ERRORS: Tuple[type, ...] = (FileNotFoundError, IsADirectoryError,
-                                      NotADirectoryError, PermissionError)
+                                      NotADirectoryError, PermissionError,
+                                      MemoryExhaustedError)
 
 _BACKOFF_CAP = 2.0
 _retry_rng = _random.Random(0x5EED)

@@ -80,8 +80,10 @@ __all__ = [
     "identity",
     "record",
     "record_step",
+    "record_input_wait",
     "current_step",
     "events",
+    "health_rollup",
     "clear",
     "metrics",
     "snapshot",
@@ -105,16 +107,21 @@ __all__ = [
 #: change (death declared / re-rank / rejoin); ``checkpoint`` = a
 #: manifest committed; ``monitor`` = a Monitor tensor stat; ``timeout``
 #: = a dist kvstore exchange expired; ``flight`` = a flight dump fired.
+#: (``anomaly`` = the training-health layer, `mxtpu/health.py`,
+#: detected a non-finite step, loss/grad spike, step-time regression
+#: or OOM; ``tensor_stats`` = one in-graph per-layer grad/param-norm
+#: sample at the ``MXTPU_HEALTH_STATS_EVERY`` cadence, rendered as
+#: chrome-trace counter tracks by :func:`merge_dir`.)
 EVENT_KINDS = ("step", "compile", "kvstore", "kvstore_round", "retry",
                "failover", "membership", "checkpoint", "monitor",
-               "timeout", "flight")
+               "timeout", "flight", "anomaly", "tensor_stats")
 
 #: ``profiler.stats()`` keys that are point-in-time gauges, not
 #: additive counters: cluster aggregation takes their MAX, and counter
 #: reconciliation (`tools/check_telemetry.py`) excludes them from the
 #: sum-of-roles check.
 GAUGE_STATS = ("step_time_us_last", "device_mem_watermark_bytes",
-               "kvstore_round_last")
+               "kvstore_round_last", "input_wait_us_last")
 
 # RLock, NOT Lock: the flight recorder's signal handler snapshots
 # state on whatever thread the signal lands on — if that thread was
@@ -146,7 +153,9 @@ _IDENTITY = {
 
 # per-step metric accumulators (under _lock)
 _METRICS = {"steps": 0, "examples": 0.0, "dt_sum": 0.0, "dt_last": 0.0,
-            "last_t": None, "nonfinite": 0, "mem_watermark": 0}
+            "last_t": None, "nonfinite": 0, "mem_watermark": 0,
+            "input_waits": 0, "input_wait_sum": 0.0,
+            "input_wait_last": 0.0}
 
 
 def enabled() -> bool:
@@ -202,13 +211,19 @@ def record(kind: str, **fields) -> Optional[Dict[str, Any]]:
 
 def record_step(batch_size: int = 0, n: int = 1,
                 duration: Optional[float] = None,
-                skipped: bool = False, site: str = "train") -> int:
+                skipped: bool = False, site: str = "train",
+                grad_norm: Optional[float] = None,
+                skipped_n: Optional[int] = None) -> int:
     """Account one training step (or ``n`` fused steps) and emit a
     ``step`` record.  ``duration`` defaults to the wall time since the
     previous call — the full iteration time including data/forward/
     backward, measured with NO device sync.  ``skipped`` marks a
-    non-finite-grad step the trainer dropped.  Returns the step id
-    (the correlation id monitor/kvstore records share)."""
+    non-finite-grad step the trainer dropped (``skipped_n`` = how many
+    of the ``n`` fused steps were dropped); ``grad_norm`` attaches the
+    global gradient norm when a producer already has it in hand (the
+    health layer's one-program check), making skipped-step bursts
+    diagnosable from the flight recorder.  Returns the step id (the
+    correlation id monitor/kvstore records share)."""
     if not _ENABLED:
         return 0
     now = time.monotonic()
@@ -222,8 +237,10 @@ def record_step(batch_size: int = 0, n: int = 1,
         _METRICS["examples"] += float(batch_size) * n
         _METRICS["dt_sum"] += duration
         _METRICS["dt_last"] = duration / max(1, n)
-        if skipped:
-            _METRICS["nonfinite"] += n
+        if skipped_n is None:
+            skipped_n = n if skipped else 0
+        if skipped_n:
+            _METRICS["nonfinite"] += skipped_n
         dt_last = _METRICS["dt_last"]
     from . import profiler as _prof
 
@@ -233,10 +250,38 @@ def record_step(batch_size: int = 0, n: int = 1,
     _prof.set_stat("step_time_us_last", int(dt_last * 1e6))
     record("step", step=step_id, n=n, batch=int(batch_size),
            dur_s=round(duration, 6), site=site,
-           skipped=True if skipped else None)
+           skipped=True if skipped_n else None,
+           skipped_n=skipped_n if skipped_n and n > 1 else None,
+           grad_norm=round(float(grad_norm), 6)
+           if grad_norm is not None else None)
+    # step-time straggler/regression watchdog (mxtpu/health.py): a
+    # deque append + cached-median compare — stays on the <10us/step
+    # always-on budget tools/check_health.py asserts
+    from . import health as _health
+
+    _health.observe_step(step_id, dt_last, site=site)
     if step_id == n or (step_id % _MEM_SAMPLE_EVERY) < n:
         _sample_device_mem()
     return step_id
+
+
+def record_input_wait(dur_s: float) -> None:
+    """Account one host-input wait: the wall time the training loop
+    spent BLOCKED waiting for the data pipeline to hand over the next
+    batch (DataLoader / DataIter ``__next__``).  Always-on gauge
+    (``input_wait_us_last`` in `profiler.stats()`) + running totals in
+    :func:`metrics` — this is what attributes an input-bound step-time
+    gap (the 911us/step dispatch gap in BENCH_r05) to the pipeline
+    instead of the device."""
+    if not _ENABLED:
+        return
+    with _lock:
+        _METRICS["input_waits"] += 1
+        _METRICS["input_wait_sum"] += dur_s
+        _METRICS["input_wait_last"] = dur_s
+    from . import profiler as _prof
+
+    _prof.set_stat("input_wait_us_last", int(dur_s * 1e6))
 
 
 _last_mem_sample = [0.0]
@@ -303,7 +348,9 @@ def clear() -> None:
     _RING.clear()
     with _lock:
         _METRICS.update(steps=0, examples=0.0, dt_sum=0.0, dt_last=0.0,
-                        last_t=None, nonfinite=0, mem_watermark=0)
+                        last_t=None, nonfinite=0, mem_watermark=0,
+                        input_waits=0, input_wait_sum=0.0,
+                        input_wait_last=0.0)
 
 
 def metrics() -> Dict[str, Any]:
@@ -321,6 +368,14 @@ def metrics() -> Dict[str, Any]:
             if dt_sum > 0 else 0.0,
             "nonfinite_steps": _METRICS["nonfinite"],
             "device_mem_watermark_bytes": _METRICS["mem_watermark"],
+            "input_waits": _METRICS["input_waits"],
+            "input_wait_last_s": _METRICS["input_wait_last"],
+            "input_wait_avg_s": _METRICS["input_wait_sum"]
+            / max(1, _METRICS["input_waits"]),
+            # the attribution ratio ROADMAP item 3 wants: what share
+            # of wall time went to WAITING on host input
+            "input_wait_frac": (_METRICS["input_wait_sum"] / dt_sum)
+            if dt_sum > 0 else 0.0,
         }
 
 
@@ -348,6 +403,34 @@ def hb_payload() -> Optional[Dict[str, Any]]:
     if not _ENABLED:
         return None
     return snapshot(max_events=_HB_EVENTS)
+
+
+def health_rollup(snaps: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold per-node snapshots into the training-health cluster view:
+    per-node anomaly counts (``health_*`` counters) and the FIRST
+    non-finite blame each node reported (from its ``anomaly`` events).
+    Shared by ``merge_dir``'s cluster.json and the scheduler's
+    ``kv.telemetry()`` view."""
+    per_node: Dict[str, int] = {}
+    first_nonfinite: Dict[str, Dict[str, Any]] = {}
+    for key, snap in snaps.items():
+        stats = snap.get("stats") or {}
+        n = sum(v for k, v in stats.items()
+                if k.startswith("health_anomaly::"))
+        n += int(stats.get("health_nonfinite_steps", 0))
+        n += int(stats.get("health_oom", 0))
+        if n:
+            per_node[key] = n
+        for ev in snap.get("events", []):
+            if ev.get("kind") == "anomaly" and \
+                    ev.get("atype") == "nonfinite" and ev.get("layer"):
+                first_nonfinite[key] = {
+                    "layer": ev.get("layer"), "step": ev.get("step"),
+                    "origin": ev.get("origin"), "site": ev.get("site")}
+                break
+    return {"anomaly_total": sum(per_node.values()),
+            "per_node_anomalies": per_node,
+            "first_nonfinite": first_nonfinite}
 
 
 def aggregate_stats(stat_dicts) -> Dict[str, int]:
@@ -387,6 +470,23 @@ def _thread_stacks() -> Dict[str, List[str]]:
     return out
 
 
+def _json_safe(obj):
+    """Replace non-finite floats with strings so the written file is
+    STRICT JSON.  Diverged runs stamp NaN/Inf grad norms into their
+    step/anomaly/blame records — exactly the artifacts a post-mortem
+    opens — and python's default ``json.dump`` would emit the bare
+    ``NaN`` token, which chrome://tracing / ``JSON.parse`` reject
+    wholesale."""
+    if isinstance(obj, float):
+        return obj if obj == obj and abs(obj) != float("inf") \
+            else repr(obj)  # 'nan' / 'inf' / '-inf'
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    return obj
+
+
 def _write_json(path: str, payload: Dict[str, Any]) -> Optional[str]:
     """Atomic write (temp + fsync + rename via resilience) so a crash
     mid-dump never leaves a truncated file a post-mortem tool would
@@ -396,7 +496,8 @@ def _write_json(path: str, payload: Dict[str, Any]) -> Optional[str]:
         from .resilience import atomic_write
 
         with atomic_write(path, "w") as f:
-            json.dump(payload, f, default=str)
+            json.dump(_json_safe(payload), f, default=str,
+                      allow_nan=False)
     except Exception:
         return None
     return path
@@ -630,6 +731,17 @@ def _events_to_chrome(snap: Dict[str, Any], t0: float) -> List[Dict]:
         args = {k: v for k, v in ev.items()
                 if k not in ("kind", "ts", "pid", "role", "rank")}
         dur = ev.get("dur_s")
+        if ev.get("kind") == "tensor_stats":
+            # per-layer grad-norm counter tracks: one ph="C" series per
+            # layer, so chrome://tracing plots the norm trajectories
+            # next to the step spans
+            for layer, st in sorted((ev.get("stats") or {}).items()):
+                out.append({"name": "grad_norm/%s" % layer,
+                            "cat": "health", "ph": "C", "ts": ts_us,
+                            "pid": pid, "tid": 0,
+                            "args": {"grad_norm":
+                                     st.get("grad_norm", 0.0)}})
+            continue
         if ev.get("kind") == "step" and dur:
             # the record's ts is the step's END; when the start would
             # fall before the merged origin, clip the DURATION too so
@@ -795,6 +907,9 @@ def merge_dir(directory: str, out_trace: str = "merged_trace.json",
         "per_rank_compile_s": per_rank_compile,
         "compile_total": aggregate.get("inspect_compiles", 0),
         "recompile_total": aggregate.get("inspect_recompiles", 0),
+        # training-health rollup (mx.health): per-rank anomaly counts
+        # and the first non-finite blame, next to the compile/step rows
+        "health": health_rollup(snaps),
         "flights": flights,
     }
     _write_json(os.path.join(directory, out_cluster), cluster)

@@ -1,0 +1,522 @@
+"""Training-health observatory tests (`mxtpu/health.py`,
+`docs/observability.md` §Training health): NaN provenance on all three
+dispatch paths, in-graph tensor-stat streaming, OOM forensics, anomaly
+watchdog, disabled mode.  The end-to-end CI contract (flight record,
+overhead budget) is guarded by `tools/check_health.py` via
+`tests/test_tools.py`."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, health, profiler, sym, telemetry
+from mxtpu.base import MemoryExhaustedError
+from mxtpu.gluon import nn, loss as gloss, Trainer
+from mxtpu.io.io import DataBatch
+
+
+@pytest.fixture(autouse=True)
+def _clean_health():
+    profiler.reset_stats()
+    telemetry.clear()
+    telemetry.set_identity("local", 0)
+    health.reset()
+    health.enable(True)
+    yield
+    health.reset()
+    health.enable(True)
+    telemetry.clear()
+
+
+def _gluon_net():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"),
+                nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def _gluon_step(net, trainer, rng, bs=8):
+    l2 = gloss.L2Loss()
+    x = mx.nd.array(rng.rand(bs, 10).astype("float32"))
+    y = mx.nd.array(rng.rand(bs, 4).astype("float32"))
+    with autograd.record():
+        loss = l2(net(x), y)
+    loss.backward()
+    trainer.step(bs)
+    return loss
+
+
+def _poison(param):
+    param.set_data(mx.nd.array(
+        np.full(param.shape, np.nan, dtype="float32")))
+
+
+def _mlp_module(batch=8):
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    x = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    x = sym.Activation(data=x, act_type="relu", name="relu1")
+    x = sym.FullyConnected(data=x, num_hidden=4, name="fc2")
+    out = sym.SoftmaxOutput(data=x, label=label, name="softmax")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (batch, 10))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    return mod
+
+
+def _module_batch(rng, batch=8):
+    return DataBatch(
+        data=[mx.nd.array(rng.rand(batch, 10).astype("float32"))],
+        label=[mx.nd.array(rng.randint(0, 4, (batch,))
+                           .astype("float32"))])
+
+
+# ---------------------------------------------------------------------------
+# NaN provenance — the three dispatch paths
+# ---------------------------------------------------------------------------
+
+def test_trainer_cachedop_path_blames_layer(monkeypatch):
+    """Guard-armed gluon Trainer (CachedOp dispatch): a NaN planted in
+    dense1's weight is blamed to that exact layer in health.report(),
+    the anomaly event and the health_nonfinite::<layer> counter."""
+    monkeypatch.setenv("MXTPU_MAX_BAD_STEPS", "4")
+    net = _gluon_net()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05})
+    rng = np.random.RandomState(0)
+    _gluon_step(net, trainer, rng)
+    _poison(net[1].weight)
+    _gluon_step(net, trainer, rng)
+    layer = net[1].weight.name
+    rep = health.report()
+    assert [b for b in rep["nonfinite"] if b["layer"] == layer], rep
+    evs = [e for e in telemetry.events("anomaly")
+           if e.get("atype") == "nonfinite"]
+    assert evs and evs[0]["layer"] == layer and evs[0]["origin"] == "input"
+    assert profiler.stats().get("health_nonfinite::%s" % layer) == 1
+    # the skipped step record carries the grad norm + step id
+    skipped = [e for e in telemetry.events("step") if e.get("skipped")]
+    assert skipped and "grad_norm" in skipped[0] and "step" in skipped[0]
+
+
+def test_trainer_blames_op_origin_on_overflow(monkeypatch):
+    """Finite-but-huge weights overflow dense1's matmul IN the forward:
+    the blame names the layer NODE with origin 'op' (NaN/Inf born
+    there, not fed in).  dense0 feeds ~1e21 activations into 1e20
+    weights, so dense1's output is the first inf while every input to
+    it is still finite."""
+    monkeypatch.setenv("MXTPU_MAX_BAD_STEPS", "4")
+    net = _gluon_net()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05})
+    rng = np.random.RandomState(0)
+    _gluon_step(net, trainer, rng)
+    for blk in (net[0], net[1]):
+        blk.weight.set_data(mx.nd.array(
+            np.full(blk.weight.shape, 1e20, dtype="float32")))
+    _gluon_step(net, trainer, rng)
+    evs = [e for e in telemetry.events("anomaly")
+           if e.get("atype") == "nonfinite"]
+    assert evs, telemetry.events("anomaly")
+    assert "dense1" in evs[0]["layer"]
+    assert evs[0]["origin"] == "op"
+
+
+def test_executor_ctx_blames_exact_layer():
+    """Executor dispatch path: the context registered on the train
+    forward lets a detection name the exact poisoned layer."""
+    import jax.numpy as jnp
+
+    mod = _mlp_module()
+    ex = mod._exec_group.execs[0]
+    ex.arg_dict["fc2_weight"]._set_jax(jnp.asarray(
+        np.full(ex.arg_dict["fc2_weight"].shape, np.nan, "float32")))
+    rng = np.random.RandomState(0)
+    mod.forward(_module_batch(rng), is_train=True)
+    mod.backward()
+    finite, norm = health.grad_check(
+        [g._data for g in ex.grad_arrays if g is not None])
+    assert not finite
+    blame = health.on_nonfinite("executor", gnorm=norm)
+    assert blame["layer"] == "fc2_weight" and blame["origin"] == "input"
+
+
+def test_module_executor_path_detects(monkeypatch):
+    """Module (Executor dispatch) real loop, guard OFF: the deferred
+    MXTPU_HEALTH_CHECK_EVERY monitor detects the NaN one cadence step
+    later through the executor-registered context.  With no guard the
+    first NaN update has already poisoned EVERY weight by diagnosis
+    time, so the blame deterministically lands on the first poisoned
+    variable in topo order (fc1_weight) — upstream of the fc2_weight
+    we planted, which is exactly what the state then looks like."""
+    monkeypatch.delenv("MXTPU_MAX_BAD_STEPS", raising=False)
+    monkeypatch.setenv("MXTPU_HEALTH_CHECK_EVERY", "1")
+    mod = _mlp_module()
+    rng = np.random.RandomState(0)
+    arg, aux = mod.get_params()
+    arg = {k: v for k, v in arg.items()}
+    arg["fc2_weight"] = mx.nd.array(
+        np.full(arg["fc2_weight"].shape, np.nan, dtype="float32"))
+    mod.set_params(arg, aux, force_init=True)
+    for _ in range(3):  # deferred read lands on the NEXT cadence step
+        b = _module_batch(rng)
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+    rep = health.report()
+    assert [x for x in rep["nonfinite"]
+            if x["layer"] == "fc1_weight" and x["site"] == "module"], rep
+
+
+def test_fused_path_blames_layer(monkeypatch):
+    """FusedTrainLoop (scanned dispatch), guard armed: the in-carry
+    finiteness flags mark every step bad and the blame re-execution
+    names the poisoned weight."""
+    monkeypatch.setenv("MXTPU_MAX_BAD_STEPS", "8")
+    mod = _mlp_module()
+    rng = np.random.RandomState(1)
+    arg, aux = mod.get_params()
+    arg = {k: v for k, v in arg.items()}
+    arg["fc1_weight"] = mx.nd.array(
+        np.full(arg["fc1_weight"].shape, np.nan, dtype="float32"))
+    mod.set_params(arg, aux, force_init=True)
+    loop = mx.FusedTrainLoop(mod, steps_per_program=3)
+    loop.run([_module_batch(rng) for _ in range(3)])
+    rep = health.report()
+    assert [x for x in rep["nonfinite"]
+            if x["layer"] == "fc1_weight"
+            and x["site"] == "fused_train"], rep
+    # the fused step record carries skipped_n + grad_norm
+    (ev,) = telemetry.events("step")
+    assert ev["skipped"] and ev["skipped_n"] == 3 and "grad_norm" in ev
+
+
+def test_fused_deferred_detection_no_guard(monkeypatch):
+    """Guard OFF: the fused loop still detects — flags read one chunk
+    later (or at finalize) without stalling the loop."""
+    monkeypatch.delenv("MXTPU_MAX_BAD_STEPS", raising=False)
+    mod = _mlp_module()
+    rng = np.random.RandomState(1)
+    arg, aux = mod.get_params()
+    arg = {k: v for k, v in arg.items()}
+    arg["fc1_weight"] = mx.nd.array(
+        np.full(arg["fc1_weight"].shape, np.nan, dtype="float32"))
+    mod.set_params(arg, aux, force_init=True)
+    loop = mx.FusedTrainLoop(mod, steps_per_program=2)
+    loop.run([_module_batch(rng) for _ in range(2)])
+    assert not health.report()["nonfinite"]  # deferred: not yet read
+    loop.finalize()
+    rep = health.report()
+    assert [x for x in rep["nonfinite"] if x["layer"] == "fc1_weight"], rep
+
+
+def test_diagnosis_is_one_shot_per_burst(monkeypatch):
+    """A burst of consecutive bad steps diagnoses ONCE (the counter
+    ticks per step, the graph walk does not re-run)."""
+    monkeypatch.setenv("MXTPU_MAX_BAD_STEPS", "10")
+    net = _gluon_net()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05})
+    rng = np.random.RandomState(0)
+    _gluon_step(net, trainer, rng)
+    _poison(net[1].weight)
+    for _ in range(3):
+        _gluon_step(net, trainer, rng)
+    rep = health.report()
+    assert rep["diagnoses"] == 1
+    assert profiler.stats()["health_nonfinite_steps"] == 3
+
+
+# ---------------------------------------------------------------------------
+# tensor-stat streaming
+# ---------------------------------------------------------------------------
+
+def test_stats_cadence_and_schema(monkeypatch):
+    monkeypatch.setenv("MXTPU_HEALTH_STATS_EVERY", "2")
+    net = _gluon_net()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05})
+    rng = np.random.RandomState(0)
+    for _ in range(4):
+        _gluon_step(net, trainer, rng)
+    evs = telemetry.events("tensor_stats")
+    assert len(evs) == 2, evs
+    stats = evs[-1]["stats"]
+    assert any("dense1" in k for k in stats)
+    row = next(iter(stats.values()))
+    assert set(row) == {"param_norm", "grad_norm", "update_ratio"}
+    assert row["param_norm"] > 0
+    assert profiler.stats()["health_stats_emitted"] == 2
+    assert health.report()["tensor_stats"]["stats"] is stats or True
+
+
+def test_fused_stats_cadence(monkeypatch):
+    monkeypatch.setenv("MXTPU_HEALTH_STATS_EVERY", "2")
+    mod = _mlp_module()
+    rng = np.random.RandomState(2)
+    loop = mx.FusedTrainLoop(mod, steps_per_program=2)
+    for _ in range(4):  # 4 chunks -> cadence hits twice
+        loop.run([_module_batch(rng) for _ in range(2)])
+    evs = telemetry.events("tensor_stats")
+    assert len(evs) == 2, evs
+    assert any("fc1" in k for k in evs[-1]["stats"])
+
+
+def test_stats_off_no_retrace_and_no_records():
+    """Stat streaming disabled (default): zero tensor_stats records
+    and the SAME compiled-signature count as a health-off run — the
+    training programs are untouched."""
+    def run_and_count():
+        mx.inspect.reset()
+        net = _gluon_net()
+        trainer = Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.05})
+        rng = np.random.RandomState(0)
+        for _ in range(2):
+            _gluon_step(net, trainer, rng)
+        return sum(len(p["signatures"]) for p in
+                   mx.inspect.programs(analyze=False))
+
+    n_health_on = run_and_count()
+    health.enable(False)
+    try:
+        n_health_off = run_and_count()
+    finally:
+        health.enable(True)
+    assert n_health_on == n_health_off
+    assert not telemetry.events("tensor_stats")
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+def test_oom_scope_types_and_attributes(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_TELEMETRY_DIR", str(tmp_path))
+    # populate the inspect registry so the report can attribute bytes
+    net = _gluon_net()
+    net(mx.nd.array(np.random.rand(4, 10).astype("float32")))
+    with pytest.raises(MemoryExhaustedError) as ei:
+        with health.oom_scope("unit"):
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                "allocate 9663676416 bytes.")
+    err = ei.value
+    assert isinstance(err, MemoryError)  # generic handlers still match
+    rep = err.report
+    assert rep["site"] == "unit"
+    assert rep["programs"], rep
+    top = rep["programs"][0]
+    assert top["program"] and top["peak_bytes"] > 0
+    assert "RESOURCE_EXHAUSTED" in rep["xla_error"]
+    # top live buffers + device stats best-effort present on CPU jax
+    assert "top_live_buffers" in rep or "device_error" in rep
+    # anomaly event + counter + flight record
+    evs = [e for e in telemetry.events("anomaly")
+           if e.get("atype") == "oom"]
+    assert evs and evs[0]["site"] == "unit"
+    assert profiler.stats()["health_oom"] == 1
+    flights = [f for f in os.listdir(str(tmp_path))
+               if f.startswith("flight_")]
+    assert flights
+    with open(os.path.join(str(tmp_path), flights[0])) as fh:
+        assert json.load(fh)["reason"] == "oom"
+
+
+def test_oom_scope_passes_other_errors_through():
+    with pytest.raises(ValueError):
+        with health.oom_scope("unit"):
+            raise ValueError("not an oom")
+    assert not telemetry.events("anomaly")
+
+
+def test_memory_exhausted_not_retried():
+    """The resilience retry layer must treat MemoryExhaustedError as
+    permanent (retrying an OOM is pointless)."""
+    from mxtpu import resilience as _res
+
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise MemoryExhaustedError("device memory exhausted")
+
+    with pytest.raises(MemoryExhaustedError):
+        _res.run_with_retry("compile", boom)
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# anomaly watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_fires_on_loss_spike():
+    for i in range(20):
+        health.observe_loss(1.0 + 0.01 * i, step=i)
+    health.observe_loss(500.0, step=20)
+    evs = [e for e in telemetry.events("anomaly")
+           if e.get("atype") == "loss_spike"]
+    assert evs and evs[0]["value"] == 500.0 and evs[0]["median"] > 0
+    assert health.report()["detectors"]["loss_spike"]["fired"] == 1
+    assert profiler.stats()["health_anomaly::loss_spike"] == 1
+
+
+def test_watchdog_step_time_regression():
+    for i in range(20):
+        health.observe_step(i, 0.01)
+    health.observe_step(20, 0.5)  # 50x the median
+    evs = [e for e in telemetry.events("anomaly")
+           if e.get("atype") == "step_time_regression"]
+    assert evs, telemetry.events("anomaly")
+
+
+def test_watchdog_cooldown_bounds_burst():
+    for i in range(20):
+        health.observe_loss(1.0, step=i)
+    for i in range(20, 30):  # 10 consecutive spikes, one window
+        health.observe_loss(100.0, step=i)
+    fired = health.report()["detectors"]["loss_spike"]["fired"]
+    assert fired == 1, fired
+
+
+def test_nan_loss_routes_to_nonfinite():
+    health.observe_loss(float("nan"), step=3)
+    evs = [e for e in telemetry.events("anomaly")
+           if e.get("atype") == "nonfinite"]
+    assert evs and evs[0]["step"] == 3
+
+
+# ---------------------------------------------------------------------------
+# disabled mode
+# ---------------------------------------------------------------------------
+
+def test_disabled_mode_adds_zero_records():
+    health.enable(False)
+    for i in range(20):
+        health.observe_loss(1.0, step=i)
+    health.observe_loss(500.0, step=20)
+    health.observe_step(21, 99.0)
+    health.on_nonfinite("unit", gnorm=float("nan"))
+    with pytest.raises(RuntimeError):  # raw error passes through
+        with health.oom_scope("unit"):
+            raise RuntimeError("RESOURCE_EXHAUSTED: OOM")
+    assert telemetry.events("anomaly") == []
+    assert not [k for k in profiler.stats() if k.startswith("health_")]
+    rep = health.report()
+    assert rep["nonfinite"] == [] and rep["anomalies"] == []
+
+
+# ---------------------------------------------------------------------------
+# grad health primitives + input-wait gauge + cluster rollup
+# ---------------------------------------------------------------------------
+
+def test_grad_check_one_program():
+    import jax.numpy as jnp
+
+    ok, norm = health.grad_check([jnp.ones((4,)), 2 * jnp.ones((3,))])
+    assert ok and norm == pytest.approx((4 + 12) ** 0.5)
+    bad, _ = health.grad_check([jnp.array([1.0, float("nan")])])
+    assert not bad
+    assert health.grad_check([]) == (True, 0.0)
+
+
+def test_monitor_grads_deferred_detection(monkeypatch):
+    monkeypatch.setenv("MXTPU_HEALTH_CHECK_EVERY", "1")
+    import jax.numpy as jnp
+
+    bad = [jnp.array([float("nan")])]
+    health.monitor_grads("unit", lambda: bad)   # dispatch 1 (pending)
+    assert not telemetry.events("anomaly")
+    health.monitor_grads("unit", lambda: bad)   # reads dispatch 1
+    evs = [e for e in telemetry.events("anomaly")
+           if e.get("atype") == "nonfinite"]
+    assert evs and evs[0]["site"] == "unit"
+
+
+def test_input_wait_gauge():
+    from mxtpu.gluon.data import ArrayDataset, DataLoader
+
+    ds = ArrayDataset(np.random.rand(32, 4).astype("float32"),
+                      np.arange(32).astype("float32"))
+    for _ in DataLoader(ds, batch_size=8):
+        pass
+    m = telemetry.metrics()
+    assert m["input_waits"] == 4
+    assert m["input_wait_avg_s"] > 0
+    assert profiler.stats()["input_wait_us_last"] >= 0
+
+
+def test_health_rollup_and_cluster_merge(tmp_path):
+    snaps = {
+        "worker0": {
+            "stats": {"health_anomaly::loss_spike": 2,
+                      "health_nonfinite_steps": 1},
+            "events": [
+                {"kind": "anomaly", "atype": "loss_spike", "step": 3},
+                {"kind": "anomaly", "atype": "nonfinite", "step": 5,
+                 "layer": "fc1_weight", "origin": "input",
+                 "site": "trainer"},
+            ]},
+        "worker1": {"stats": {"steps": 4}, "events": []},
+    }
+    roll = telemetry.health_rollup(snaps)
+    assert roll["anomaly_total"] == 3
+    assert roll["per_node_anomalies"] == {"worker0": 3}
+    assert roll["first_nonfinite"]["worker0"]["layer"] == "fc1_weight"
+    # the same rollup lands in launch.py's cluster.json via merge_dir
+    for key, snap in snaps.items():
+        snap = dict(snap, role=key[:-1], rank=int(key[-1]),
+                    pid=100 + int(key[-1]), ts=1000.0)
+        with open(os.path.join(str(tmp_path),
+                               "telemetry_%s.json" % key), "w") as fh:
+            json.dump(snap, fh)
+    cluster = telemetry.merge_dir(str(tmp_path))
+    assert cluster["health"]["anomaly_total"] == 3
+    assert cluster["health"]["first_nonfinite"]["worker0"]["layer"] \
+        == "fc1_weight"
+
+
+def test_written_json_is_strict_despite_nan(tmp_path, monkeypatch):
+    """Diverged runs stamp NaN grad norms into their records; the
+    written flight/telemetry artifacts must still be STRICT JSON
+    (chrome://tracing and JSON.parse reject the bare NaN token)."""
+    monkeypatch.setenv("MXTPU_TELEMETRY_DIR", str(tmp_path))
+    telemetry.record("anomaly", atype="nonfinite",
+                     grad_norm=float("nan"), step=1)
+    path = telemetry.dump_flight("unit", "strict-json test")
+    with open(path) as fh:
+        raw = fh.read()
+
+    def boom(tok):
+        raise AssertionError("non-strict JSON token %r" % tok)
+
+    fl = json.loads(raw, parse_constant=boom)
+    ev = [e for e in fl["events"] if e.get("kind") == "anomaly"][0]
+    assert ev["grad_norm"] == "nan"  # stringified, not dropped
+
+
+def test_tensor_stats_render_as_counter_tracks(tmp_path):
+    snap = {"role": "worker", "rank": 0, "pid": 1, "ts": 1000.0,
+            "stats": {}, "metrics": {},
+            "events": [{"kind": "tensor_stats", "ts": 1000.5, "step": 1,
+                        "stats": {"fc1_weight": {"param_norm": 1.0,
+                                                 "grad_norm": 0.25,
+                                                 "update_ratio": 0.01}}}]}
+    with open(os.path.join(str(tmp_path), "telemetry_worker0.json"),
+              "w") as fh:
+        json.dump(snap, fh)
+    telemetry.merge_dir(str(tmp_path))
+    with open(os.path.join(str(tmp_path), "merged_trace.json")) as fh:
+        trace = json.load(fh)
+    tracks = [e for e in trace["traceEvents"]
+              if e.get("ph") == "C" and "fc1_weight" in e.get("name", "")]
+    assert tracks and tracks[0]["args"]["grad_norm"] == 0.25

@@ -82,6 +82,16 @@ def test_check_inspect_guard():
     assert "check_inspect OK" in out
 
 
+def test_check_health_guard():
+    """tools/check_health.py: a NaN injected at a named mid-model
+    layer must be blamed to that layer in health.report(), the
+    telemetry anomaly event AND the flight record; the injected steps
+    skip with grad norms on their records; the always-on per-step
+    health path must stay under its 10us budget."""
+    out = _run(["tools/check_health.py"])
+    assert "check_health OK" in out
+
+
 def test_check_resilience_guard():
     """tools/check_resilience.py: a short fault-injected training run
     (compile-fail + kvstore-pull-fail + checkpoint-fail + SIGTERM +
