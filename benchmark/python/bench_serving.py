@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Closed-loop serving load generator: throughput AT a p99 budget.
+
+Raw tok/s (or img/s) is the wrong serving metric — a server that
+doubles throughput by letting p99 run away is worse, not better.  This
+bench reports what the ROADMAP's serving axis asks for: the highest
+SUSTAINED throughput whose client-observed p99 stays inside
+``--p99-budget-ms``, found by ramping closed-loop concurrency
+(1, 2, 4, ... up to ``--max-concurrency``) and holding each stage for
+``--duration`` seconds.  Closed-loop: each client issues its next
+request only after the previous one returns, so offered load tracks
+delivered load and the queue cannot run away on its own.
+
+Two targets:
+
+  * in-process (default): an `mx.serve.Server` hosting a bucket-warmed
+    MLP, driven through `Server.infer` — measures the micro-batcher +
+    compiled-program stack without HTTP overhead;
+  * ``--endpoints host:port,...``: a live replica fleet via the
+    failover `mx.serve.Client` — measures the full wire path
+    (what `tools/check_serving.py` chaos-tests).
+
+Latency comes from `telemetry.Histogram` (one fresh histogram per
+stage — the same primitive the server's own SLO layer uses), and each
+stage also reports the server-side batch-occupancy and queue-depth
+gauges from ``mx.telemetry.metrics()``.
+
+Example::
+
+    python benchmark/python/bench_serving.py --p99-budget-ms 100
+    python benchmark/python/bench_serving.py \\
+        --endpoints 127.0.0.1:8080,127.0.0.1:8081 --json out.json
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, ROOT)
+
+SAMPLE = (32,)
+
+
+def build_model(width=64):
+    import mxtpu as mx
+    from mxtpu.gluon import nn
+
+    mx.random.seed(3)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(width, activation="relu"),
+                nn.Dense(width, activation="relu"), nn.Dense(8))
+    net.initialize(mx.initializer.Xavier(rnd_type="uniform"))
+    net.hybridize()
+    return net
+
+
+def run_stage(predict, concurrency, duration, max_rows, hist):
+    """One closed-loop stage: ``concurrency`` clients, each issuing
+    its next request only after the last returned.  Returns
+    (requests, rows, errors, wall_s)."""
+    import numpy as np
+
+    stop = time.monotonic() + duration
+    counts = [0] * concurrency
+    rows = [0] * concurrency
+    errors = [0] * concurrency
+
+    def client(i):
+        rng = np.random.RandomState(100 + i)
+        while time.monotonic() < stop:
+            n = int(rng.randint(1, max_rows + 1))
+            x = rng.rand(n, *SAMPLE).astype("float32")
+            t0 = time.monotonic()
+            try:
+                predict(x)
+            except Exception:
+                errors[i] += 1
+                continue
+            hist.record(time.monotonic() - t0)
+            counts[i] += 1
+            rows[i] += n
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sum(counts), sum(rows), sum(errors), time.monotonic() - t0
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="seconds per concurrency stage")
+    ap.add_argument("--max-concurrency", type=int, default=16)
+    ap.add_argument("--max-rows", type=int, default=4,
+                    help="max rows per request (ragged 1..N)")
+    ap.add_argument("--p99-budget-ms", type=float, default=200.0)
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--width", type=int, default=64)
+    ap.add_argument("--endpoints", default=None,
+                    help="host:port,... — drive a live fleet instead "
+                         "of an in-process server")
+    ap.add_argument("--model", default="mlp",
+                    help="model name on the fleet (--endpoints mode)")
+    ap.add_argument("--json", default=None, help="write results here")
+    args = ap.parse_args()
+
+    import mxtpu as mx
+    from mxtpu import telemetry
+
+    server = None
+    if args.endpoints:
+        eps = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+        assert mx.serve.wait_ready(eps, 60), "fleet not ready"
+        client = mx.serve.Client(eps)
+        model = args.model
+
+        def predict(x):
+            return client.predict(model, x)
+        target = "fleet %s" % eps
+    else:
+        server = mx.serve.Server(max_batch=args.max_batch)
+        server.add_model("mlp", build_model(args.width),
+                         input_shape=SAMPLE)
+        server.start()
+
+        def predict(x):
+            return server.infer("mlp", x)
+        target = "in-process server (max_batch=%d, buckets warmed)" \
+            % args.max_batch
+
+    print("bench_serving: closed-loop ramp against %s" % target)
+    print("stage  conc   req/s   rows/s  p50ms  p95ms  p99ms  "
+          "occup%  qdepth  ok")
+    stages = []
+    sustained = None
+    c = 1
+    while c <= args.max_concurrency:
+        hist = telemetry.Histogram(low=1e-5, high=1e3)
+        nreq, nrows, nerr, wall = run_stage(
+            predict, c, args.duration, args.max_rows, hist)
+        snap = hist.snapshot()
+        m = telemetry.metrics().get("serve", {})
+        stage = {
+            "concurrency": c,
+            "requests_per_s": nreq / wall,
+            "rows_per_s": nrows / wall,
+            "errors": nerr,
+            "p50_ms": snap["p50"] * 1e3,
+            "p95_ms": snap["p95"] * 1e3,
+            "p99_ms": snap["p99"] * 1e3,
+            "batch_occupancy_pct": m.get("batch_occupancy_pct", -1),
+            "queue_depth": m.get("queue_depth", -1),
+        }
+        stages.append(stage)
+        within = snap["p99"] * 1e3 <= args.p99_budget_ms and nerr == 0
+        print("%5d %5d %7.1f %8.1f %6.1f %6.1f %6.1f %7.1f %7d  %s"
+              % (c, c, stage["requests_per_s"], stage["rows_per_s"],
+                 stage["p50_ms"], stage["p95_ms"], stage["p99_ms"],
+                 stage["batch_occupancy_pct"], stage["queue_depth"],
+                 "yes" if within else "NO"))
+        if within:
+            if sustained is None or stage["rows_per_s"] > \
+                    sustained["rows_per_s"]:
+                sustained = stage
+        else:
+            break  # past the knee: higher concurrency only gets worse
+        c *= 2
+
+    if sustained:
+        print("bench_serving: SUSTAINED %.1f rows/s (%.1f req/s) at "
+              "p99 %.1fms within the %.0fms budget (concurrency %d)"
+              % (sustained["rows_per_s"], sustained["requests_per_s"],
+                 sustained["p99_ms"], args.p99_budget_ms,
+                 sustained["concurrency"]))
+    else:
+        print("bench_serving: NO stage met the %.0fms p99 budget"
+              % args.p99_budget_ms)
+
+    if server is not None:
+        server.close()
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"p99_budget_ms": args.p99_budget_ms,
+                       "sample_shape": SAMPLE,
+                       "stages": stages,
+                       "sustained": sustained}, f, indent=2)
+        print("bench_serving: wrote %s" % args.json)
+    return 0 if sustained else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

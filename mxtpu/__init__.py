@@ -115,6 +115,7 @@ from . import module as mod
 from . import rnn
 from . import image
 from . import gluon
+from . import serve
 from . import fused_train
 from .fused_train import FusedTrainLoop
 from . import contrib

@@ -21,6 +21,7 @@ __all__ = [
     "PSConnectError",
     "ServerDiedError",
     "MemoryExhaustedError",
+    "RequestShedError",
     "string_types",
     "numeric_types",
     "integer_types",
@@ -80,6 +81,21 @@ class MemoryExhaustedError(MXNetError, MemoryError):
     def __init__(self, msg: str, report: Optional[dict] = None):
         super().__init__(msg)
         self.report = report or {}
+
+
+class RequestShedError(MXNetError):
+    """`mx.serve` admission control rejected a request — the tenant's
+    queue cap is full, the server is draining, or the load-shedding
+    policy dropped it to protect the SLO of admitted work.  Shedding
+    is a DELIBERATE overload response, not a fault: clients should
+    back off (or fail over to another replica), so this is neither an
+    OSError (resilience would spin retrying a full queue) nor a bare
+    crash.  ``reason`` is one of ``"queue_full"``, ``"draining"``,
+    ``"timeout"``, ``"overload"``."""
+
+    def __init__(self, msg: str, reason: str = "overload"):
+        super().__init__(msg)
+        self.reason = reason
 
 string_types = (str,)
 numeric_types = (float, int, np.generic)

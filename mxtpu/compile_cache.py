@@ -47,6 +47,7 @@ __all__ = [
     "set_bucket_policy",
     "get_bucket_policy",
     "bucket_batch",
+    "bucket_set",
     "bucketing_enabled",
     "donation_enabled",
     "pad_leading",
@@ -290,6 +291,20 @@ def bucket_batch(n: int, spec: Optional[str] = None) -> int:
         if size >= n:
             return size
     return n
+
+
+def bucket_set(cap: int, spec: Optional[str] = None) -> List[int]:
+    """The FULL set of bucket sizes the policy can produce for batches
+    of 1..cap, ascending — the signatures a serving replica AOT-warms
+    so its steady state compiles nothing (``mx.serve`` warms exactly
+    this set per model).  Under ``pow2`` and cap 32 that is
+    [1, 2, 4, 8, 16, 32]; ``mult:N`` gives the multiples of N up to
+    cap; ``fixed:...`` the listed sizes that fit."""
+    if spec is None:
+        spec = get_bucket_policy() or "pow2"
+    cap = max(1, int(cap))
+    sizes = sorted({bucket_batch(n, spec) for n in range(1, cap + 1)})
+    return [s for s in sizes if s <= cap] or [cap]
 
 
 def pad_leading(val, target: int):

@@ -100,9 +100,12 @@ __all__ = [
 #: ``compile_cache.aot_compile``); ``kvstore_push``/``kvstore_pull``
 #: fire inside every KVStore backend's per-key push/pull;
 #: ``dataloader`` fires in the batch fetch (parent, thread and forked
-#: worker paths); ``checkpoint`` fires in checkpoint/optimizer-state IO.
+#: worker paths); ``checkpoint`` fires in checkpoint/optimizer-state
+#: IO; ``serve`` fires in the `mx.serve` micro-batcher's model
+#: dispatch (the serving analog of the training chokepoints — a
+#: transient dispatch failure is retried, never a failed request).
 FAULT_SITES = ("compile", "kvstore_push", "kvstore_pull", "dataloader",
-               "checkpoint")
+               "checkpoint", "serve")
 
 _ALIASES = {
     "compile_cache": "compile",

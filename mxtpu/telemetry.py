@@ -97,6 +97,11 @@ __all__ = [
     "merge_dir",
     "merge_traces",
     "Speedometer",
+    "Histogram",
+    "histogram",
+    "histograms",
+    "register_metrics_provider",
+    "unregister_metrics_provider",
 ]
 
 #: The typed record vocabulary.  ``step`` = one (or K fused) training
@@ -114,14 +119,16 @@ __all__ = [
 #: chrome-trace counter tracks by :func:`merge_dir`.)
 EVENT_KINDS = ("step", "compile", "kvstore", "kvstore_round", "retry",
                "failover", "membership", "checkpoint", "monitor",
-               "timeout", "flight", "anomaly", "tensor_stats")
+               "timeout", "flight", "anomaly", "tensor_stats", "serve")
 
 #: ``profiler.stats()`` keys that are point-in-time gauges, not
 #: additive counters: cluster aggregation takes their MAX, and counter
 #: reconciliation (`tools/check_telemetry.py`) excludes them from the
 #: sum-of-roles check.
 GAUGE_STATS = ("step_time_us_last", "device_mem_watermark_bytes",
-               "kvstore_round_last", "input_wait_us_last")
+               "kvstore_round_last", "input_wait_us_last",
+               "serve_queue_depth", "serve_inflight",
+               "serve_batch_occupancy_pct", "serve_max_batch")
 
 # RLock, NOT Lock: the flight recorder's signal handler snapshots
 # state on whatever thread the signal lands on — if that thread was
@@ -344,16 +351,211 @@ def events(kind: Optional[str] = None) -> List[Dict[str, Any]]:
 
 
 def clear() -> None:
-    """Drop all ring records and reset the step metrics (tests)."""
+    """Drop all ring records and reset the step metrics (tests).
+    Registered histograms are reset in place (the registry itself —
+    and any metrics providers — survive, matching counter behavior)."""
     _RING.clear()
     with _lock:
         _METRICS.update(steps=0, examples=0.0, dt_sum=0.0, dt_last=0.0,
                         last_t=None, nonfinite=0, mem_watermark=0,
                         input_waits=0, input_wait_sum=0.0,
                         input_wait_last=0.0)
+        for h in _HISTOGRAMS.values():
+            h.reset()
 
 
-def metrics() -> Dict[str, Any]:
+# ---------------------------------------------------------------------------
+# Streaming percentile histograms
+# ---------------------------------------------------------------------------
+
+class Histogram(object):
+    """Bounded streaming percentile histogram over log-spaced buckets.
+
+    Fixed memory (one int per bucket, ~170 buckets at the defaults),
+    O(1) :meth:`record`, thread-safe.  Buckets grow geometrically by
+    ``10**(1/bins_per_decade)`` from ``low`` to ``high`` (values
+    outside clamp into the under/overflow buckets), so any quantile is
+    answered within ~``(growth-1)/2`` relative error — ±7% at the
+    default 16 bins/decade, plenty for latency SLOs where the question
+    is "is p99 under 200ms", not "is p99 198.3ms or 198.4ms".
+
+    This is the serving SLO primitive: `mx.serve` keeps one per model
+    for request latency (p50/p95/p99 surfaced via :func:`metrics`),
+    and ``benchmark/python/bench_serving.py``'s closed-loop clients
+    feed the same class, so server-side and client-side latency
+    distributions are directly comparable.
+
+    Use the module-level :func:`histogram` get-or-create registry to
+    have a histogram's :meth:`snapshot` ride along in
+    :func:`metrics()["histograms"]` (and therefore in heartbeat
+    snapshots and ``telemetry_*.json`` dumps) automatically.
+    """
+
+    def __init__(self, low: float = 1e-6, high: float = 1e4,
+                 bins_per_decade: int = 16):
+        import math
+
+        if not (0 < low < high):
+            raise ValueError("need 0 < low < high, got %r, %r"
+                             % (low, high))
+        self.low = float(low)
+        self.high = float(high)
+        self._log_growth = math.log(10.0) / max(1, int(bins_per_decade))
+        # bucket 0 = underflow (<= low); last = overflow (>= high)
+        self.nbins = int(math.ceil(
+            math.log(high / low) / self._log_growth)) + 2
+        self._counts = [0] * self.nbins
+        # RLock for the same reason as the module _lock above: a
+        # flight-recorder signal landing inside record() must be able
+        # to snapshot() on the same thread (re-entry only reads, so a
+        # mid-update count is an acceptable crash-dump approximation)
+        self._hlock = threading.RLock()
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+
+    def reset(self) -> None:
+        with self._hlock:
+            self._counts = [0] * self.nbins
+            self.count = 0
+            self.total = 0.0
+            self.vmin = float("inf")
+            self.vmax = float("-inf")
+
+    def _index(self, v: float) -> int:
+        import math
+
+        if v <= self.low:
+            return 0
+        if math.isinf(v):  # int(log(inf)) would raise OverflowError
+            return self.nbins - 1
+        i = int(math.log(v / self.low) / self._log_growth) + 1
+        return i if i < self.nbins else self.nbins - 1
+
+    def record(self, value: float) -> None:
+        v = float(value)
+        if v != v:  # NaN would poison min/max and land nowhere sane
+            return
+        i = self._index(v)
+        if v == float("inf"):
+            v = self.high  # overflow bucket; keep total/vmax finite
+        elif v == float("-inf"):
+            v = self.low   # underflow bucket; keep total/vmin finite
+        with self._hlock:
+            self._counts[i] += 1
+            self.count += 1
+            self.total += v
+            if v < self.vmin:
+                self.vmin = v
+            if v > self.vmax:
+                self.vmax = v
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold another histogram of the SAME bucket layout into this
+        one (per-worker client histograms -> one run view)."""
+        if (other.low, other._log_growth, other.nbins) != \
+                (self.low, self._log_growth, self.nbins):
+            raise ValueError("cannot merge histograms with different "
+                             "bucket layouts")
+        # canonical lock order: a.merge(b) racing b.merge(a) would
+        # otherwise hold one lock each and deadlock waiting on the other
+        first, second = (self, other) if id(self) <= id(other) \
+            else (other, self)
+        with first._hlock:
+            with second._hlock:
+                for i, c in enumerate(other._counts):
+                    self._counts[i] += c
+                self.count += other.count
+                self.total += other.total
+                self.vmin = min(self.vmin, other.vmin)
+                self.vmax = max(self.vmax, other.vmax)
+        return self
+
+    def quantile(self, q: float) -> float:
+        """The q-quantile (0..1) as the geometric midpoint of the
+        bucket holding that rank, clamped to the observed [min, max].
+        0.0 when empty."""
+        import math
+
+        with self._hlock:
+            n = self.count
+            if n == 0:
+                return 0.0
+            rank = min(n - 1, max(0, int(math.ceil(q * n)) - 1))
+            acc = 0
+            idx = self.nbins - 1
+            for i, c in enumerate(self._counts):
+                acc += c
+                if acc > rank:
+                    idx = i
+                    break
+            vmin, vmax = self.vmin, self.vmax
+        if idx == 0:
+            est = self.low
+        else:
+            # bucket idx spans [low*g^(idx-1), low*g^idx)
+            est = self.low * math.exp(self._log_growth * (idx - 0.5))
+        return min(max(est, vmin), vmax)
+
+    def percentiles(self) -> Dict[str, float]:
+        return {"p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-safe summary: count/sum/avg/min/max + p50/p95/p99."""
+        with self._hlock:
+            n, tot = self.count, self.total
+            vmin, vmax = self.vmin, self.vmax
+        out = {"count": n, "sum": tot, "avg": tot / n if n else 0.0,
+               "min": vmin if n else 0.0, "max": vmax if n else 0.0}
+        out.update(self.percentiles())
+        return out
+
+
+_HISTOGRAMS: Dict[str, Histogram] = {}
+
+
+def histogram(name: str, low: float = 1e-6, high: float = 1e4,
+              bins_per_decade: int = 16) -> Histogram:
+    """Get-or-create the registered histogram ``name``.  Registered
+    histograms appear in :func:`metrics()["histograms"]` and reset
+    with :func:`clear`."""
+    with _lock:
+        h = _HISTOGRAMS.get(name)
+        if h is None:
+            h = _HISTOGRAMS[name] = Histogram(low, high, bins_per_decade)
+        return h
+
+
+def histograms() -> Dict[str, Dict[str, Any]]:
+    """Snapshots of every registered histogram, by name."""
+    with _lock:
+        hs = dict(_HISTOGRAMS)
+    return {name: h.snapshot() for name, h in sorted(hs.items())}
+
+
+# named callables merged into metrics() under their key — how a
+# subsystem (mx.serve) surfaces its live gauges without telemetry
+# importing it (the dependency points the other way)
+_METRIC_PROVIDERS: Dict[str, Callable[[], Dict[str, Any]]] = {}
+
+
+def register_metrics_provider(name: str,
+                              fn: Callable[[], Dict[str, Any]]) -> None:
+    """Merge ``fn()`` (a JSON-safe dict) into :func:`metrics` output
+    under key ``name``.  A provider that raises is reported as
+    ``{"error": ...}`` instead of breaking metrics()."""
+    with _lock:
+        _METRIC_PROVIDERS[name] = fn
+
+
+def unregister_metrics_provider(name: str) -> None:
+    with _lock:
+        _METRIC_PROVIDERS.pop(name, None)
+
+
+def _step_metrics() -> Dict[str, Any]:
     """Always-on per-step training metrics of THIS process: step
     count, latency (last/avg seconds), examples/sec over the run,
     non-finite steps skipped, device-memory watermark bytes."""
@@ -377,6 +579,25 @@ def metrics() -> Dict[str, Any]:
             "input_wait_frac": (_METRICS["input_wait_sum"] / dt_sum)
             if dt_sum > 0 else 0.0,
         }
+
+
+def metrics() -> Dict[str, Any]:
+    """Always-on metrics of THIS process: the per-step training block
+    (:func:`_step_metrics`), every registered :class:`Histogram`
+    snapshot under ``"histograms"``, and each registered metrics
+    provider's dict under its own key (`mx.serve` publishes its
+    queue-depth / batch-occupancy / SLO gauges this way)."""
+    out = _step_metrics()
+    if _HISTOGRAMS:
+        out["histograms"] = histograms()
+    with _lock:
+        providers = list(_METRIC_PROVIDERS.items())
+    for name, fn in providers:
+        try:
+            out[name] = fn()
+        except Exception as e:  # a broken provider must not take
+            out[name] = {"error": str(e)}  # metrics() down with it
+    return out
 
 
 def snapshot(max_events: Optional[int] = None) -> Dict[str, Any]:

@@ -8,11 +8,24 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-# dogfood the persistent compile cache (mxtpu/compile_cache.py): repeat
-# suite runs — and the many tests that spawn subprocesses re-compiling
-# the same tiny programs — hit the on-disk XLA cache instead of
-# recompiling.  Inherited by child processes via the environment.
-os.environ.setdefault("MXTPU_COMPILE_CACHE", "/tmp/mxtpu_test_xla_cache")
+# dogfood the persistent compile cache (mxtpu/compile_cache.py): the
+# many tests that spawn subprocesses re-compiling the same tiny
+# programs hit the on-disk XLA cache instead of recompiling (inherited
+# by child processes via the environment).  The dir is FRESH per suite
+# run, not shared across runs: jaxlib 0.4.37 can heap-corrupt
+# deserializing entries a PREVIOUS run wrote (the warm-cache flake
+# documented in docs/compile_cache.md that intermittently killed
+# test_fused_train/test_resilience) — a per-run dir keeps the
+# intra-run subprocess wins and removes the stale-entry poisoning
+# entirely.  Cleaned up at interpreter exit.
+if "MXTPU_COMPILE_CACHE" not in os.environ:
+    import atexit
+    import shutil
+    import tempfile
+
+    _cache_dir = tempfile.mkdtemp(prefix="mxtpu_test_xla_cache_")
+    os.environ["MXTPU_COMPILE_CACHE"] = _cache_dir
+    atexit.register(shutil.rmtree, _cache_dir, True)
 # CPU-only test subprocesses (kvstore launcher, example scripts) must not
 # dial the TPU tunnel at interpreter start — the pool sitecustomize keys
 # on this var, and a busy/cold tunnel turns every child's startup into

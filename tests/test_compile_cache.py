@@ -522,3 +522,64 @@ def test_persistent_cache_writes_are_atomic(tmp_path):
         mx.disable_persistent_cache()
         if os.environ.get("MXTPU_COMPILE_CACHE"):
             mx.enable_persistent_cache()
+
+
+# -- thread safety (serving workers share executables) ---------------------
+
+def test_cachedop_threaded_dispatch_bitwise_zero_extra_retraces(
+        pow2_buckets):
+    """N serving threads hammering ONE CachedOp concurrently: outputs
+    stay bitwise-identical to a serial dispatch, and the retrace
+    counters show EXACTLY one trace per bucket — a check-then-act race
+    on the seen-signature set (two threads both claiming a brand-new
+    bucket signature) would inflate them and trip
+    tools/check_retrace.py on a healthy server."""
+    import threading
+
+    net = _mlp(seed=4)
+    op = net._cached_op  # not built until first call/trace
+    x0 = mx.nd.array(np.zeros((1, 10), "float32"))
+    net(x0)  # build the cache; bucket-1 program traced here
+    op = net._cached_op
+    t0 = profiler.get_stat("cachedop_infer_trace")
+    rng = np.random.RandomState(0)
+    xs = {n: rng.rand(n, 10).astype("float32") for n in range(1, 9)}
+    expected = {}  # serial reference AFTER threads (order-free check)
+
+    barrier = threading.Barrier(8)
+    failures = []
+
+    def worker(tid):
+        barrier.wait()  # maximize signature-race pressure
+        for it in range(12):
+            n = 1 + (tid + it) % 8
+            out = net(mx.nd.array(xs[n])).asnumpy()
+            with lock:
+                got.setdefault(n, []).append(out)
+
+    lock = threading.Lock()
+    got = {}
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for n, x in xs.items():
+        expected[n] = net(mx.nd.array(x)).asnumpy()
+    for n, outs in got.items():
+        for out in outs:
+            if not np.array_equal(out, expected[n]):
+                failures.append(n)
+    assert not failures, "non-deterministic outputs for sizes %s" \
+        % sorted(set(failures))
+    # pow2 buckets for 1..8 = {1, 2, 4, 8}; bucket 1 traced before the
+    # threads started, so AT MOST 3 new traces — and not one more
+    traces = profiler.get_stat("cachedop_infer_trace") - t0
+    assert traces <= 3, ("concurrent dispatch inflated retraces: %d "
+                         "new traces for 3 new buckets" % traces)
+    # registry bookkeeping reconciles too (inspect.track_compile under
+    # the signature lock): hits + traces == dispatches
+    rec = op._insp
+    dispatches = 8 * 12 + 1 + len(xs)  # threads + build + reference
+    assert rec.compiles + rec.hits == dispatches

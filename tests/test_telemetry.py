@@ -498,3 +498,121 @@ def test_profiler_dump_is_merge_ready(tmp_path):
     assert any(e["name"] == "process_name" for e in metas)
     spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
     assert spans and all(e["pid"] == os.getpid() for e in spans)
+
+
+# -- streaming percentile histograms (the serving SLO primitive) -----------
+
+def test_histogram_quantiles_within_bucket_error():
+    """Percentiles off the log-bucketed histogram stay within the
+    documented relative error against exact order statistics."""
+    rng = np.random.RandomState(0)
+    vals = np.exp(rng.normal(-3.0, 1.0, size=4000))  # latency-shaped
+    h = telemetry.Histogram(low=1e-6, high=1e3)
+    for v in vals:
+        h.record(v)
+    vals.sort()
+    for q in (0.5, 0.95, 0.99):
+        exact = vals[int(q * len(vals)) - 1]
+        est = h.quantile(q)
+        assert abs(est - exact) / exact < 0.10, (q, est, exact)
+    p = h.percentiles()
+    assert p["p50"] <= p["p95"] <= p["p99"]
+    snap = h.snapshot()
+    assert snap["count"] == len(vals)
+    assert snap["min"] == pytest.approx(vals[0])
+    assert snap["max"] == pytest.approx(vals[-1])
+    assert snap["avg"] == pytest.approx(vals.mean(), rel=1e-6)
+    json.dumps(snap)  # heartbeat/flight-ready
+
+
+def test_histogram_bounded_and_clamped():
+    """Outliers land in the under/overflow buckets — memory stays
+    FIXED and quantiles stay inside the observed range."""
+    h = telemetry.Histogram(low=1e-3, high=1e2)
+    nbins = h.nbins
+    for v in (1e-9, 5e-9, 1e6, 2e6, 0.5):
+        h.record(v)
+    h.record(float("nan"))  # dropped, not poisoning min/max
+    h.record(float("inf"))   # overflow bucket, NOT an OverflowError
+    h.record(float("-inf"))  # underflow bucket
+    assert h.nbins == nbins and len(h._counts) == nbins
+    assert h.count == 7
+    assert h._counts[-1] >= 1 and h._counts[0] >= 1
+    import math
+    assert math.isfinite(h.total) and math.isfinite(h.vmax)
+    assert h.quantile(0.0) >= 1e-9
+    assert h.quantile(1.0) <= 2e6
+
+
+def test_histogram_thread_safe_and_mergeable():
+    h = telemetry.Histogram()
+    threads = [threading.Thread(
+        target=lambda s: [h.record(0.01 * (s + 1)) for _ in range(500)],
+        args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == 4000  # no lost increments
+    other = telemetry.Histogram()
+    other.record(123.0)
+    h.merge(other)
+    assert h.count == 4001 and h.vmax == 123.0
+    with pytest.raises(ValueError):
+        h.merge(telemetry.Histogram(low=1e-2))  # layout mismatch
+
+
+def test_histogram_merge_opposite_directions_no_deadlock():
+    """a.merge(b) racing b.merge(a) must not deadlock: the two bucket
+    locks are taken in canonical (id) order."""
+    import threading
+
+    a, b = telemetry.Histogram(), telemetry.Histogram()
+    for v in (0.01, 0.1):
+        a.record(v)
+        b.record(v)
+    done = []
+
+    def fold(x, y):
+        for _ in range(300):
+            x.merge(y)
+        done.append(1)
+
+    t1 = threading.Thread(target=fold, args=(a, b), daemon=True)
+    t2 = threading.Thread(target=fold, args=(b, a), daemon=True)
+    t1.start(); t2.start()
+    t1.join(30); t2.join(30)
+    assert len(done) == 2, "merge deadlocked"
+
+
+def test_histogram_registry_in_metrics_and_clear():
+    h = telemetry.histogram("t_reg_latency_s")
+    assert telemetry.histogram("t_reg_latency_s") is h  # get-or-create
+    h.record(0.02)
+    m = telemetry.metrics()
+    assert m["histograms"]["t_reg_latency_s"]["count"] == 1
+    assert m["histograms"]["t_reg_latency_s"]["p50"] > 0
+    telemetry.clear()  # resets contents, keeps registration
+    assert telemetry.histogram("t_reg_latency_s").count == 0
+    assert "t_reg_latency_s" in telemetry.histograms()
+
+
+def test_metrics_providers():
+    """Registered providers surface under their key; a broken provider
+    degrades to an error dict instead of breaking metrics()."""
+    telemetry.register_metrics_provider("prov_ok",
+                                        lambda: {"x": 1})
+
+    def boom():
+        raise RuntimeError("provider broke")
+
+    telemetry.register_metrics_provider("prov_bad", boom)
+    try:
+        m = telemetry.metrics()
+        assert m["prov_ok"] == {"x": 1}
+        assert "provider broke" in m["prov_bad"]["error"]
+        assert m["steps"] == 0  # the step block is intact
+    finally:
+        telemetry.unregister_metrics_provider("prov_ok")
+        telemetry.unregister_metrics_provider("prov_bad")
+    assert "prov_ok" not in telemetry.metrics()

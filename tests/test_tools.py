@@ -126,6 +126,19 @@ def test_check_telemetry_guard():
     assert "check_telemetry OK" in out
 
 
+def test_check_serving_guard():
+    """tools/check_serving.py: a REAL 2-replica `mx.serve` fleet
+    (launch.py --serve-replicas) under closed-loop load must survive a
+    SIGKILL of one replica mid-load with ZERO failed requests (client
+    failover replays them on the survivor), every output matching the
+    deterministic oracle, client p99 within budget, a clean SIGTERM
+    drain of the survivor, and a merged telemetry rollup that NAMES
+    the failover (see mxtpu/serve.py, docs/serving.md)."""
+    out = _run(["tools/check_serving.py", "--duration", "6"],
+               timeout=420)
+    assert "check_serving OK" in out
+
+
 @pytest.mark.slow
 def test_check_elastic_full_guard():
     """Full chaos gauntlet: SIGKILL one worker (respawned by

@@ -17,6 +17,14 @@ deaths when ``MXTPU_PS_REPLICATION=1`` failover is expected to absorb
 them; ``--pid-dir DIR`` writes one ``<role>-<i>.pid`` file per child
 so chaos harnesses (`tools/check_elastic.py`) can target a role.
 
+A third mode, ``--serve-replicas N``, launches a SERVING fleet
+instead of a PS training job: N identical role-``serve`` replicas of
+the command, each with its own rank/port env
+(``MXTPU_SERVE_RANK``/``MXTPU_SERVE_PORT``, fleet list in
+``MXTPU_SERVE_PORTS``), failure-honest with an
+``--allow-serve-failures`` chaos budget (see `docs/serving.md` and
+`tools/check_serving.py`).
+
 * ``local`` — all roles as local processes (development/tests);
 * ``ssh``  — roles distributed round-robin over ``--hostfile`` hosts
   via passwordless ssh (the reference's ssh tracker): scheduler runs on
@@ -51,7 +59,7 @@ def _free_port() -> int:
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-n", "--num-workers", type=int, default=0)
     ap.add_argument("-s", "--num-servers", type=int, default=None)
     ap.add_argument("--launcher", choices=["local", "ssh"],
                     default="local")
@@ -72,6 +80,19 @@ def main(argv=None):
     ap.add_argument("--pid-dir", default=None,
                     help="write <role>-<i>.pid per child (chaos "
                          "harness hook)")
+    ap.add_argument("--serve-replicas", type=int, default=0,
+                    metavar="N",
+                    help="SERVING mode: spawn N replicas of the "
+                         "command as role 'serve' (MXTPU_SERVE_RANK/"
+                         "_PORT per replica, MXTPU_SERVE_PORTS = the "
+                         "fleet) instead of a PS training job; see "
+                         "docs/serving.md")
+    ap.add_argument("--allow-serve-failures", type=int, default=0,
+                    metavar="N",
+                    help="tolerate N nonzero serve-replica exits "
+                         "(client failover absorbs them — the chaos "
+                         "contract tools/check_serving.py tests) "
+                         "instead of failing the launch")
     ap.add_argument("--telemetry-dir", default=None, metavar="DIR",
                     help="unified telemetry (docs/observability.md): "
                          "every role dumps telemetry_<role><rank>.json "
@@ -84,6 +105,10 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if not args.command:
         ap.error("no command given")
+    if args.serve_replicas > 0:
+        return _launch_serve(args)
+    if args.num_workers < 1:
+        ap.error("need -n/--num-workers >= 1 (or --serve-replicas)")
     ns = args.num_servers if args.num_servers is not None else args.num_workers
     if args.launcher == "ssh":
         if not args.hostfile:
@@ -183,6 +208,83 @@ def main(argv=None):
         for p in procs:
             try:
                 p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    if args.telemetry_dir:
+        _merge_telemetry(base, tdir)
+    return rc
+
+
+def _launch_serve(args):
+    """SERVING launcher: N identical replicas of the command, each a
+    role-``serve`` process with its own rank + port
+    (``MXTPU_SERVE_RANK``/``MXTPU_SERVE_PORT``) and the whole fleet's
+    port list in ``MXTPU_SERVE_PORTS`` — what a replica or a client
+    needs to build the failover endpoint set.  Failure-honest like the
+    PS launcher: a replica that dies nonzero fails the launch unless
+    ``--allow-serve-failures`` budget absorbs it (the chaos harness
+    SIGKILLs one on purpose).  SIGTERM to the launcher forwards to
+    the replicas, which DRAIN and exit 0 (`mx.serve.serve_forever`)."""
+    ports = [_free_port() for _ in range(args.serve_replicas)]
+    base = dict(os.environ)
+    base["MXTPU_SERVE_PORTS"] = ",".join(str(p) for p in ports)
+    if args.pid_dir:
+        os.makedirs(args.pid_dir, exist_ok=True)
+    if args.telemetry_dir:
+        tdir = os.path.abspath(args.telemetry_dir)
+        os.makedirs(tdir, exist_ok=True)
+        base["MXTPU_TELEMETRY_DIR"] = tdir
+
+    procs = []
+    for i in range(args.serve_replicas):
+        env = dict(base)
+        env["MXTPU_ROLE"] = "serve"
+        env["MXTPU_SERVE_RANK"] = str(i)
+        env["MXTPU_SERVE_PORT"] = str(ports[i])
+        p = subprocess.Popen(args.command, env=env)
+        procs.append(p)
+        if args.pid_dir:
+            with open(os.path.join(args.pid_dir,
+                                   "serve-%d.pid" % i), "w") as f:
+                f.write(str(p.pid))
+
+    rc = 0
+    budget = max(0, args.allow_serve_failures)
+
+    # the docstring's contract: SIGTERM to the launcher forwards to
+    # the replicas, which drain and exit 0.  Default disposition would
+    # kill the launcher mid-wait WITHOUT running the finally below —
+    # orphaned replicas, no telemetry merge.
+    def _on_term(signum, frame):
+        raise KeyboardInterrupt
+
+    prev_term = signal.signal(signal.SIGTERM, _on_term)
+    try:
+        for p in procs:
+            code = p.wait()
+            if code == 0:
+                continue
+            if budget > 0:
+                budget -= 1
+                print("launch.py: serve replica died (exit %d) — "
+                      "tolerated (%d allowed failure(s) left)"
+                      % (code, budget), file=sys.stderr, flush=True)
+            elif rc == 0:
+                rc = code if 0 < code < 256 else 1
+    except KeyboardInterrupt:
+        print("launch.py: interrupted — draining serve replicas",
+              file=sys.stderr, flush=True)
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        for p in procs:
+            try:
+                p.wait(timeout=15)
             except subprocess.TimeoutExpired:
                 p.kill()
     if args.telemetry_dir:
