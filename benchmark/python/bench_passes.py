@@ -1,0 +1,170 @@
+"""Graph-rewrite pass pipeline benchmark: bind/trace cost + graph size.
+
+Measures what `mxtpu.passes` (MXTPU_PASSES) buys at COMPILE time on
+the two flagship graph families:
+
+  * **resnet** — a gluon model-zoo conv net traced to its Symbol and
+    bound through Executor; with ``MXTPU_LAYOUT=nhwc`` the layout pass
+    additionally reports the graph-level transpose delta vs the
+    per-op ``MXTPU_CONV_LAYOUT`` form (lowered-StableHLO histogram).
+  * **transformer** — a symbol-level encoder block stack (QKV
+    projections, batch_dot attention, LayerNorm, GELU-ish elementwise
+    chains) — CSE/fusion-heavy territory.
+
+For each model, passes OFF vs ON (default set):
+
+  - bind+trace wall time (graph build through jit lower+compile of
+    the inference program; the pass pipeline itself is included in
+    the ON time, so the number is honest end-to-end)
+  - symbol node count before/after
+  - compiled-program fusion count (optimized-HLO histogram)
+
+Emits ONE JSON line (driver contract):
+  {"metric": "passes_bind_speedup", "value": <x>, "unit": "x",
+   "vs_baseline": <x>, "extra": {...}}
+("baseline" is passes-off, so vs_baseline == value; a value ~1.0 with
+large node reductions means the pipeline pays for itself at bind while
+shrinking what every later retrace has to walk.)
+
+Env knobs: MXTPU_BENCH_PASSES_NET (resnet18_v1), MXTPU_BENCH_PASSES_HW
+(32), MXTPU_BENCH_PASSES_BATCH (2), MXTPU_BENCH_PASSES_LAYERS (2,
+transformer depth), MXTPU_BENCH_PASSES_DMODEL (64).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+NET = os.environ.get("MXTPU_BENCH_PASSES_NET", "resnet18_v1")
+HW = int(os.environ.get("MXTPU_BENCH_PASSES_HW", "32"))
+BATCH = int(os.environ.get("MXTPU_BENCH_PASSES_BATCH", "2"))
+LAYERS = int(os.environ.get("MXTPU_BENCH_PASSES_LAYERS", "2"))
+DMODEL = int(os.environ.get("MXTPU_BENCH_PASSES_DMODEL", "64"))
+SEQ = int(os.environ.get("MXTPU_BENCH_PASSES_SEQ", "32"))
+
+
+def _resnet_symbol():
+    import mxtpu as mx
+    from mxtpu import sym
+    from mxtpu.gluon.model_zoo import vision
+
+    net = vision.get_model(NET, classes=10)
+    net.initialize(ctx=mx.cpu())
+    x = mx.nd.zeros((BATCH, 3, HW, HW))
+    out_sym, _, _ = net._trace_symbol(x)
+    data_name = out_sym.list_arguments()[0]  # trace names it data0
+    return out_sym, {data_name: (BATCH, 3, HW, HW)}
+
+
+def _transformer_symbol():
+    """Symbol-level encoder stack: the pass-pipeline stress shape
+    (duplicate projections for CSE, long elementwise chains for fuse,
+    scale constants for fold)."""
+    from mxtpu import sym
+
+    d, h = DMODEL, 4
+    x = sym.Variable("data")  # (B, T, d)
+    cur = x
+    for i in range(LAYERS):
+        p = "l%d_" % i
+        q = sym.FullyConnected(data=cur, num_hidden=d, flatten=False,
+                               name=p + "q")
+        k = sym.FullyConnected(data=cur, num_hidden=d, flatten=False,
+                               name=p + "k")
+        v = sym.FullyConnected(data=cur, num_hidden=d, flatten=False,
+                               name=p + "v")
+        att = sym.batch_dot(q, sym.SwapAxis(k, dim1=1, dim2=2),
+                            name=p + "qk")
+        att = sym.softmax(att * (1.0 / float(d // h) ** 0.5),
+                          axis=-1)
+        ctx_ = sym.batch_dot(att, v, name=p + "av")
+        proj = sym.FullyConnected(data=ctx_, num_hidden=d, flatten=False,
+                                  name=p + "proj")
+        cur = sym.LayerNorm(data=cur + proj, name=p + "ln1")
+        ff = sym.FullyConnected(data=cur, num_hidden=4 * d, flatten=False,
+                                name=p + "ff1")
+        # gelu-ish elementwise chain (tanh approximation): fuse fodder
+        ff = 0.5 * ff * (1.0 + sym.tanh(
+            0.7978845608 * (ff + 0.044715 * ff * ff * ff)))
+        ff = sym.FullyConnected(data=ff, num_hidden=d, flatten=False,
+                                name=p + "ff2")
+        cur = sym.LayerNorm(data=cur + ff, name=p + "ln2")
+    return cur, {"data": (BATCH, SEQ, d)}
+
+
+def _bind_once(symbol, shapes, spec):
+    """Bind + force the inference compile; returns (wall_s, executor)."""
+    import numpy as np
+
+    import mxtpu as mx
+    import mxtpu.passes as P
+
+    t0 = time.perf_counter()
+    with P.scope(spec):
+        ex = symbol.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    ex.forward(**{n: mx.nd.array(np.zeros(s, "float32"))
+                  for n, s in shapes.items()})
+    return time.perf_counter() - t0, ex
+
+
+def _fusions(ex):
+    import mxtpu as mx
+
+    try:
+        si = ex._insp.latest_sig()
+        return mx.inspect.hlo_histogram(si.hlo_text()).get("n_fusions")
+    except Exception:
+        return None
+
+
+def bench_model(tag, build):
+    import mxtpu.passes as P
+
+    symbol, shapes = build()
+    _, report = symbol.optimize(passes="default", return_report=True)
+    _bind_once(symbol, shapes, "off")  # warmup: jax/XLA cold-start out
+    t_off, ex_off = _bind_once(symbol, shapes, "off")
+    t_on, ex_on = _bind_once(symbol, shapes, "default")
+    row = {
+        "model": tag,
+        "bind_s_off": round(t_off, 3),
+        "bind_s_on": round(t_on, 3),
+        "bind_speedup": round(t_off / t_on, 3) if t_on else None,
+        "nodes_before": report["nodes_before"],
+        "nodes_after": report["nodes_after"],
+        "per_pass": {p["pass"]: {k: v for k, v in p.items()
+                                 if k in ("wall_us", "identity_removed",
+                                          "folded", "cse_merged",
+                                          "chains", "nodes_fused")}
+                     for p in report["passes"]},
+        "fusions_off": _fusions(ex_off),
+        "fusions_on": _fusions(ex_on),
+    }
+    return row
+
+
+def main():
+    rows = [bench_model("resnet", _resnet_symbol),
+            bench_model("transformer", _transformer_symbol)]
+    speedups = [r["bind_speedup"] for r in rows if r["bind_speedup"]]
+    value = round(sum(speedups) / len(speedups), 3) if speedups else 0.0
+    print(json.dumps({
+        "metric": "passes_bind_speedup",
+        "value": value,
+        "unit": "x",
+        "vs_baseline": value,
+        "extra": {"models": rows,
+                  "net": NET, "hw": HW, "batch": BATCH,
+                  "node_reduction": {
+                      r["model"]: "%d->%d" % (r["nodes_before"],
+                                              r["nodes_after"])
+                      for r in rows}},
+    }))
+
+
+if __name__ == "__main__":
+    main()

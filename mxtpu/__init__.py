@@ -80,6 +80,7 @@ from . import libinfo                         # capability report
 from .executor import Executor
 from .cached_op import CachedOp
 from . import subgraph
+from . import passes
 from . import amp
 from . import control_flow
 # reference API surface: mx.nd.contrib.foreach / mx.sym.contrib.foreach

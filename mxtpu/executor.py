@@ -98,19 +98,42 @@ def _build_graph_fn(symbol: Symbol, arg_names: List[str],
 
     The AMP compute-dtype policy (`mxtpu/amp.py`) is captured HERE, at
     graph-build time: per-op casts are baked into the traced function
-    so XLA fuses them into neighboring kernels."""
+    so XLA fuses them into neighboring kernels.
+
+    The graph-rewrite pass pipeline (`mxtpu/passes`, MXTPU_PASSES) also
+    runs HERE, ahead of tracing — this is the one choke point every
+    compile path funnels through (Executor bind, CachedOp, the
+    FusedTrainLoop scan body, control-flow subgraph lowering, health
+    re-execution), so a pass-optimized graph is what XLA sees
+    everywhere, uniformly.  RNG identity is pinned to the ORIGINAL
+    graph first (ensure_rng_ids) so rewrites can never renumber the
+    per-node fold_in keys of dropout-style ops."""
     import jax
 
     from . import amp as _amp
     from . import inspect as _insp
+    from . import passes as _passes
 
     compute_dtype = _amp.get_compute_dtype()
-    nodes = _topo_order(symbol._outputs)
+    _passes.ensure_rng_ids(symbol)
+    graph, _pass_report = _passes.optimize_for_build(symbol)
+    nodes = _topo_order(graph._outputs)
     arg_pos = {n: i for i, n in enumerate(arg_names)}
     aux_pos = {n: i for i, n in enumerate(aux_names)}
+    # stable RNG ids: assigned on the original graph in topo order (so
+    # the unoptimized numbering is bitwise the legacy rng_i counter)
+    # and carried through clones by ext_attrs
+    rng_ids = {}
+    rng_seq = 0
+    for n in nodes:
+        if not n.is_variable and n.op.needs_rng:
+            rng_ids[id(n)] = _passes.rng_id_of(n, rng_seq)
+            rng_seq += 1
     # layer attribution (MXTPU_INSPECT_SCOPES, default on): each node
     # executes under jax.named_scope(node name), so HLO op metadata
     # and jax.profiler device traces resolve back to model layers.
+    # A pass-fused elementwise chain traces under its ONE (terminal)
+    # name, so inspect attributes the whole region as one layer.
     # Trace-time only — zero runtime cost in the compiled program.
     if _insp.scopes_enabled():
         node_scope = {id(n): _insp.scope_name(n.name) for n in nodes
@@ -121,7 +144,6 @@ def _build_graph_fn(symbol: Symbol, arg_names: List[str],
     def graph_fn_impl(arg_vals, aux_vals, key):
         env: Dict[Tuple[int, int], Any] = {}
         aux_new = list(aux_vals)
-        rng_i = 0
         # re-assert the captured policy for the duration of the trace so
         # nested graph builds (control-flow subgraphs constructed while
         # tracing) inherit it even if the thread-local changed since bind
@@ -135,7 +157,10 @@ def _build_graph_fn(symbol: Symbol, arg_names: List[str],
                     continue
                 invals = [env[(id(inode), idx)]
                           for inode, idx in node.inputs]
-                if compute_dtype is not None:
+                # amp_inline ops (pass-fused chains) apply the per-op
+                # cast policy member-wise inside their own fn
+                if compute_dtype is not None \
+                        and not getattr(node.op, "amp_inline", False):
                     invals = _amp.cast_op_inputs(node.op.name, invals,
                                                  compute_dtype)
                 attrs = dict(node.attrs)
@@ -144,8 +169,7 @@ def _build_graph_fn(symbol: Symbol, arg_names: List[str],
                 scope = jax.named_scope(node_scope[id(node)]) \
                     if node_scope is not None else contextlib.nullcontext()
                 if node.op.needs_rng:
-                    sub = jax.random.fold_in(key, rng_i)
-                    rng_i += 1
+                    sub = jax.random.fold_in(key, rng_ids[id(node)])
                     with scope:
                         out = node.op.fn(sub, *invals, **attrs)
                 else:
@@ -181,7 +205,7 @@ def _build_graph_fn(symbol: Symbol, arg_names: List[str],
                             p = aux_pos[aux_node.name]
                             aux_new[p] = momentum * aux_new[p] + \
                                 (1.0 - momentum) * batch_stat
-            outputs = [env[(id(n), i)] for n, i in symbol._outputs]
+            outputs = [env[(id(n), i)] for n, i in graph._outputs]
         return outputs, aux_new
 
     # the mirror/remat hook lives HERE so every consumer of the training

@@ -348,8 +348,15 @@ def diagnose(symbol, arg_names: Sequence[str], aux_names: Sequence[str],
     import jax
 
     from . import amp as _amp
+    from .passes.graph import ensure_rng_ids, rng_id_of
     from .symbol.symbol import _topo_order
 
+    # same stable per-node RNG identity as _build_graph_fn: the
+    # compiled program folds each node's __rng_id__ (pass rewrites
+    # never renumber), so this eager walk must fold the SAME ids or
+    # the diagnosis would draw different dropout masks than the step
+    # it is explaining
+    ensure_rng_ids(symbol)
     nodes = _topo_order(symbol._outputs)
     arg_pos = {n: i for i, n in enumerate(arg_names)}
     aux_pos = {n: i for i, n in enumerate(aux_names)}
@@ -376,7 +383,7 @@ def diagnose(symbol, arg_names: Sequence[str], aux_names: Sequence[str],
                 attrs["is_train"] = True
             try:
                 if node.op.needs_rng:
-                    sub = jax.random.fold_in(key, rng_i)
+                    sub = jax.random.fold_in(key, rng_id_of(node, rng_i))
                     rng_i += 1
                     out = node.op.fn(sub, *invals, **attrs)
                 else:

@@ -385,6 +385,11 @@ class ProgramRecord(object):
         self.name = name
         self.created = time.time()
         self.arg_names: Optional[List[str]] = None
+        # graph-rewrite provenance (mxtpu.passes report) of the symbol
+        # this program lowered — set by program() when the pass
+        # pipeline optimized the graph, so "this fusion created this
+        # HLO region" is answerable from the registry
+        self.pass_report: Optional[Dict[str, Any]] = None
         self.hits = 0          # unlocked bump: the <10us hot path
         self.compiles = 0      # dispatch-path compiles (ticks *_trace)
         self.aot_compiles = 0  # warmup/AOT builds (ticks *_warmup)
@@ -437,10 +442,18 @@ class ProgramRecord(object):
         # flops/peak_bytes/compile_s are pre-created at 0 and later
         # BACKFILLED by assignment only: the dict is already in the
         # telemetry ring, and growing it there would race concurrent
-        # heartbeat/flight serialization (dict-changed-size errors)
+        # heartbeat/flight serialization (dict-changed-size errors).
+        # `passes` (graph-rewrite provenance, e.g. "dce,cse,fuse:34->21")
+        # is complete at record time — never backfilled.
+        pass_prov = None
+        if self.pass_report is not None:
+            from . import passes as _passes
+
+            pass_prov = _passes.provenance_summary(self.pass_report)
         ev = _tel.record("compile", site=site, step=_tel.current_step(),
                          program=self.name, variant=kind, flops=0.0,
-                         peak_bytes=0, compile_s=0.0, blame=blame)
+                         peak_bytes=0, compile_s=0.0, blame=blame,
+                         passes=pass_prov)
         if not _ENABLED:
             return None
         _prof.inc_stat("inspect_compiles")
@@ -500,6 +513,10 @@ class ProgramRecord(object):
         blames = [s.blame for s in sig_infos if s.blame]
         if blames:
             d["blame"] = blames
+        if self.pass_report is not None:
+            from . import passes as _passes
+
+            d["passes"] = _passes.provenance_summary(self.pass_report)
         if analyze and sig_infos:
             analysis = sig_infos[-1].analyze()
             d.update({k: v for k, v in analysis.items() if k != "error"})
@@ -580,6 +597,18 @@ def program(site: str, name: str,
             rec.arg_names = list(arg_names)
         if head is not None:
             rec._sym_head = head
+    if symbol is not None:
+        # pass provenance: the registering site just built its graph
+        # fns through _build_graph_fn, so the optimizer cache holds the
+        # report for exactly this graph (None when passes are off)
+        try:
+            from . import passes as _passes
+
+            prov = _passes.provenance_for(symbol)
+            if prov is not None:
+                rec.pass_report = prov
+        except Exception:
+            pass
     return rec
 
 
@@ -739,13 +768,71 @@ _DT_SIZE = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
             "u8": 1}
 
 
+_STABLEHLO_RE = re.compile(
+    r"=\s+(?:stablehlo|mhlo|chlo)\.(\w+)")
+_STABLEHLO_RESULT_RE = re.compile(
+    r"->\s*tensor<((?:\d+x)*)(\w+)>\s*$")
+
+
+def _stablehlo_histogram(text: str) -> Dict[str, Any]:
+    """Histogram a LOWERED (pre-optimization) StableHLO dump — the
+    graph-level truth before XLA fusion/cancellation runs.  This is
+    what makes layout deltas CI-checkable on CPU, where the optimized
+    HLO fuses every transpose away regardless of how many the graph
+    emitted (the TPU backend materializes them; see ROADMAP item 2)."""
+    ops: "collections.Counter" = collections.Counter()
+    convs = []
+    transposes = []
+    copies = 0
+    for line in text.splitlines():
+        m = _STABLEHLO_RE.search(line)
+        if not m:
+            continue
+        op = m.group(1)
+        ops[op] += 1
+        rm = _STABLEHLO_RESULT_RE.search(line.strip())
+        dtype = rm.group(2) if rm else "f32"
+        shape = rm.group(1).rstrip("x").replace("x", ",") if rm else ""
+        if op == "convolution":
+            convs.append((dtype, shape, ""))
+        elif op == "transpose":
+            transposes.append((dtype, shape))
+        elif op == "copy":
+            copies += 1
+    t_bytes = 0
+    for d, shape in transposes:
+        n = 1
+        for dim in shape.split(","):
+            if dim:
+                n *= int(dim)
+        t_bytes += n * _DT_SIZE.get(d, 4)
+    return {
+        "op_histogram_top": dict(ops.most_common(15)),
+        "n_convolutions": len(convs),
+        "conv_dtypes": dict(collections.Counter(d for d, _, _ in convs)),
+        "convolutions": convs[:32],
+        "n_transposes_surviving": len(transposes),
+        "transpose_traffic_mb": round(t_bytes / 2**20, 2),
+        "n_copies_surviving": copies,
+        "n_fusions": 0,
+        "dialect": "stablehlo",
+    }
+
+
 def hlo_histogram(hlo_text: str) -> Dict[str, Any]:
     """Histogram an optimized-HLO dump: op kinds, conv dtypes/shapes,
     transposes/copies that SURVIVED fusion (= materialized layout
     traffic).  Ops inside ``%fused_*`` computation bodies are excluded
     — a transpose folded into a fusion costs no extra HBM round trip;
     only top-level (entry / while-body / conditional) instructions
-    materialize."""
+    materialize.
+
+    Also accepts LOWERED StableHLO text (``jit(...).lower().as_text()``)
+    and histograms the PRE-optimization graph instead — there
+    ``n_transposes_surviving`` counts what the graph emitted, before
+    XLA cancellation (the layout pass's graph-level feedback signal)."""
+    if "stablehlo." in hlo_text or "mhlo." in hlo_text:
+        return _stablehlo_histogram(hlo_text)
     ops: "collections.Counter" = collections.Counter()
     convs = []
     transposes = []
@@ -882,6 +969,8 @@ def report(name_or_record=None, kind: Optional[str] = None) -> Dict[str, Any]:
     blames = [s.blame for s in rec.sigs.values() if s.blame]
     if blames:
         out["blame"] = blames
+    if rec.pass_report is not None:
+        out["pass_report"] = rec.pass_report
     try:
         out.update(hlo_histogram(si.hlo_text()))
     except Exception as e:

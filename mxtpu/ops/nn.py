@@ -103,14 +103,22 @@ def _norm_tuple(v, n, default):
 def _convolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
                  pad=(), num_filter=0, num_group=1, no_bias=False,
                  workspace=1024, layout=None, cudnn_tune=None, cudnn_off=False):
+    """``layout="NHWC"`` runs NATIVELY channels-last: data/output are
+    NHWC while the weight stays OIHW (this build's gluon blocks always
+    allocate OIHW) — the form the `mxtpu.passes` layout pass emits so
+    one transpose pair brackets a whole conv region instead of every
+    op inserting its own (the per-op MXTPU_CONV_LAYOUT behavior)."""
     lax = _jax().lax
     ns = len(kernel)
     stride = _norm_tuple(stride, ns, 1)
     dilate = _norm_tuple(dilate, ns, 1)
     pad = _norm_tuple(pad, ns, 0)
-    cl = _channels_last()
+    # native: caller hands/receives channels-last directly; cl without
+    # native is the per-op MXTPU_CONV_LAYOUT form (wrap here, per op)
+    native = str(layout or "").upper() == "N" + _SPATIAL[ns] + "C"
+    cl = native or _channels_last()
     if cl:
-        lhs = _to_cl(data, ns)
+        lhs = data if native else _to_cl(data, ns)
         rhs = weight.transpose(tuple(range(2, 2 + ns)) + (1, 0))  # spIO
         dn = lax.conv_dimension_numbers(lhs.shape, rhs.shape,
                                         _conv_dnums_cl(ns))
@@ -130,7 +138,7 @@ def _convolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
     if not no_bias and maybe_bias:
         out = out + (maybe_bias[0] if cl
                      else maybe_bias[0].reshape((1, -1) + (1,) * ns))
-    return _from_cl(out, ns) if cl else out
+    return _from_cl(out, ns) if cl and not native else out
 
 
 @register("Deconvolution")
@@ -199,12 +207,17 @@ def _pool_pads(in_sz, k, s, p, convention):
 def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
              pad=(), pooling_convention="valid", count_include_pad=True,
              p_value=2, cudnn_off=False, layout=None):
+    """``layout`` ending in ``C`` (NHWC/NWC/NDHWC) pools natively
+    channels-last — emitted by the `mxtpu.passes` layout pass; the
+    NCHW-family values gluon always sends select the default path."""
     lax = _jax().lax
     jnp = _jnp()
     nd = data.ndim
     ns = nd - 2
+    cl = bool(layout) and str(layout).upper() == \
+        "N" + _SPATIAL.get(ns, "?") + "C"
     if global_pool:
-        axes = tuple(range(2, nd))
+        axes = tuple(range(1, nd - 1)) if cl else tuple(range(2, nd))
         if pool_type == "max":
             return jnp.max(data, axis=axes, keepdims=True)
         if pool_type in ("avg", "sum"):
@@ -218,13 +231,21 @@ def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
     kernel = tuple(kernel)
     stride = _norm_tuple(stride, ns, 1)
     pad = _norm_tuple(pad, ns, 0)
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    pads = [(0, 0), (0, 0)] + [
-        _pool_pads(data.shape[2 + i], kernel[i], stride[i], pad[i],
+    # only where the channel dim sits differs between the layouts
+    sp0 = 1 if cl else 2  # first spatial dim position
+    spatial_pads = [
+        _pool_pads(data.shape[sp0 + i], kernel[i], stride[i], pad[i],
                    pooling_convention)
         for i in range(ns)
     ]
+    if cl:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = [(0, 0)] + spatial_pads + [(0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = [(0, 0), (0, 0)] + spatial_pads
     # NOTE: init values must be python scalars so lax.reduce_window
     # specializes to reduce_window_max/add primitives (which carry the
     # autodiff rules); a traced init array kills differentiability.

@@ -1,0 +1,115 @@
+"""Dead-node elimination and common-subexpression elimination.
+
+In this IR liveness IS reachability — ``_topo_order`` walks from the
+heads, so a node no input edge or head references never executes.  DCE
+therefore has two jobs: forward identity nodes (``_copy``/``identity``)
+past themselves so their producers connect straight to their consumers,
+and let the final reachability sweep (implicit in every topo walk)
+drop whatever the other passes orphaned.
+
+CSE hashes every node by (op name, canonicalized attrs, resolved input
+entries) and redirects duplicates to the first occurrence.  Variables
+dedupe by (name, is_aux) — the executor maps them positionally by
+name, so two variable nodes with one name are the same argument slot.
+Excluded: ``needs_rng`` ops (two dropouts with identical inputs draw
+DIFFERENT masks via their stable ``__rng_id__`` — merging would change
+semantics), ``mutate_inputs`` ops, ``train_aware`` ops (BatchNorm-
+family aux write-back is a side channel: shared-weight BNs over one
+tensor each push a momentum step into the SAME aux slot, so merging
+would halve the update), fused group nodes (each carries a distinct
+closure under one shared op name), and any node whose attrs refuse
+canonicalization (control-flow ops holding subgraph Symbols compare by
+identity, which never collides).  Folded constants dedupe by VALUE
+(shape/dtype/bytes), not closure identity.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from ..ops.registry import canonical_attrs
+from ..symbol.symbol import Symbol, _topo_order
+from .core import GraphPass
+from .graph import rewrite_entries
+
+__all__ = ["DeadNodePass", "CSEPass"]
+
+_IDENTITY_OPS = ("_copy",)  # aliases (identity) resolve to this OpDef name
+
+
+class DeadNodePass(GraphPass):
+    name = "dce"
+
+    def run(self, symbol: Symbol) -> Dict[str, Any]:
+        heads = {(id(n), i) for n, i in symbol._outputs}
+        mapping: Dict[Tuple[int, int], Tuple] = {}
+        removed = 0
+        for n in _topo_order(symbol._outputs):
+            if n.is_variable:
+                continue
+            # head identity nodes are kept so the graph's output names
+            # survive (Symbol.optimize users read list_outputs)
+            if n.op.name in _IDENTITY_OPS and n.inputs \
+                    and (id(n), 0) not in heads:
+                mapping[(id(n), 0)] = n.inputs[0]
+                removed += 1
+        if mapping:
+            rewrite_entries(symbol, mapping)
+        return {"identity_removed": removed}
+
+
+def _const_key(node) -> Tuple:
+    vals = node.op.const_values
+    return ("_pass_const",
+            tuple((tuple(v.shape), v.dtype.str, v.tobytes()) for v in vals))
+
+
+class CSEPass(GraphPass):
+    name = "cse"
+
+    def run(self, symbol: Symbol) -> Dict[str, Any]:
+        rep: Dict[int, Any] = {}     # id(node) -> representative node
+        table: Dict[Tuple, Any] = {}
+        mapping: Dict[Tuple[int, int], Tuple] = {}
+        merged = 0
+        # graph heads are never merged AWAY (they may be a merge
+        # target): redirecting a head entry to a differently-named
+        # representative would rename list_outputs() under
+        # Symbol.optimize users.  XLA dedups the duplicate compute
+        # inside the program anyway.
+        head_ids = {id(n) for n, _ in symbol._outputs}
+        for n in _topo_order(symbol._outputs):
+            if n.is_variable:
+                key = ("var", n.name, bool(n.is_aux))
+            elif n.op.needs_rng or n.op.mutate_inputs \
+                    or n.op.train_aware \
+                    or getattr(n.op, "no_cse", False):
+                # train_aware ops can carry side channels the key can't
+                # see: two shared-weight BatchNorms over one tensor each
+                # apply a momentum step to the SAME aux slot — merging
+                # them would halve the update
+                rep[id(n)] = n
+                continue
+            elif n.op.name == "_pass_const":
+                key = _const_key(n)
+            else:
+                try:
+                    ak = canonical_attrs(n.attrs)
+                    key = (n.op.name, ak,
+                           tuple((id(rep.get(id(i), i)), x)
+                                 for i, x in n.inputs))
+                    hash(key)
+                except TypeError:
+                    rep[id(n)] = n
+                    continue
+            r = table.get(key)
+            if r is None or (id(n) in head_ids and not n.is_variable):
+                table.setdefault(key, n)
+                rep[id(n)] = n
+            else:
+                rep[id(n)] = r
+                merged += 1
+                for i in range(n.num_outputs()):
+                    mapping[(id(n), i)] = (r, i)
+        if mapping:
+            rewrite_entries(symbol, mapping)
+        return {"cse_merged": merged}

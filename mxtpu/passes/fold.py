@@ -1,0 +1,160 @@
+"""Constant folding: evaluate constant-only subgraphs once at bind time.
+
+Roots are input-less initializer ops (``_zeros``/``_ones``/``_full``/
+``_arange``/``_eye``); constness propagates through a whitelist of
+elementwise and shape ops whose results are bit-identical whether
+computed eagerly (here, per-op jit on the default backend) or inside
+the whole-graph XLA program — i.e. NO cross-element reductions, whose
+accumulation order may differ between fused and standalone lowerings.
+``train_aware``/``needs_rng``/``mutate_inputs`` ops and anything off
+the whitelist stop propagation.
+
+The fold frontier — constant nodes with a non-constant consumer (or a
+graph head) — is replaced by :func:`~mxtpu.passes.graph.make_const_node`
+nodes carrying the evaluated numpy values; interior constant nodes
+become unreachable and vanish.  Results above ``MXTPU_FOLD_MAX_BYTES``
+(default 1 MiB) are left in the graph: embedding a giant literal in
+the program buys nothing over letting XLA materialize it.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ..base import getenv_int
+from ..symbol.symbol import Symbol, _topo_order
+from .core import GraphPass
+from .graph import consumer_map, make_const_node, rewrite_entries
+
+__all__ = ["ConstantFoldPass"]
+
+# input-less deterministic constant sources
+_CONST_SOURCES = frozenset({"_zeros", "_ones", "_full", "_arange", "_eye"})
+
+# constness-propagating ops: elementwise + pure shape/layout rearranges
+# (NO reductions — see module doc)
+_FOLD_PROP = frozenset({
+    # unary elementwise
+    "abs", "cbrt", "ceil", "cos", "cosh", "degrees", "erf", "exp",
+    "expm1", "fix", "floor", "log", "log10", "log1p", "log2",
+    "logical_not", "negative", "radians", "rcbrt", "reciprocal", "rint",
+    "round", "rsqrt", "sign", "sin", "sinh", "sqrt", "square", "tan",
+    "tanh", "trunc", "arccos", "arccosh", "arcsin", "arcsinh", "arctan",
+    "arctanh", "relu", "sigmoid", "hard_sigmoid", "softsign",
+    "Activation", "clip", "smooth_l1", "_copy", "Cast", "zeros_like",
+    "ones_like", "BlockGrad", "make_loss",
+    # binary / n-ary elementwise
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "_grad_add", "_hypot", "_power", "_maximum", "_minimum", "_mod",
+    "_equal", "_not_equal", "_greater", "_greater_equal", "_lesser",
+    "_lesser_equal", "_logical_and", "_logical_or", "_logical_xor",
+    "add_n",
+    # broadcast binary
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_mod", "broadcast_power", "broadcast_hypot",
+    "broadcast_maximum", "broadcast_minimum", "broadcast_equal",
+    "broadcast_not_equal", "broadcast_greater", "broadcast_greater_equal",
+    "broadcast_lesser", "broadcast_lesser_equal", "broadcast_logical_and",
+    "broadcast_logical_or", "broadcast_logical_xor",
+    # scalar ops
+    "_plus_scalar", "_minus_scalar", "_rminus_scalar", "_mul_scalar",
+    "_div_scalar", "_rdiv_scalar", "_mod_scalar", "_rmod_scalar",
+    "_power_scalar", "_rpower_scalar", "_hypot_scalar", "_maximum_scalar",
+    "_minimum_scalar", "_equal_scalar", "_not_equal_scalar",
+    "_greater_scalar", "_greater_equal_scalar", "_lesser_scalar",
+    "_lesser_equal_scalar", "_logical_and_scalar", "_logical_or_scalar",
+    "_logical_xor_scalar",
+    # shape / rearrange
+    "Reshape", "Flatten", "transpose", "expand_dims", "squeeze",
+    "SwapAxis", "moveaxis", "slice", "slice_axis", "reverse", "stack",
+    "Concat", "repeat", "tile", "broadcast_axis", "broadcast_to",
+    "where",
+})
+
+
+class ConstantFoldPass(GraphPass):
+    name = "fold"
+
+    def run(self, symbol: Symbol) -> Dict[str, Any]:
+        from .. import amp as _amp
+
+        order = _topo_order(symbol._outputs)
+        # cheap pre-scan: no constant roots means nothing can fold —
+        # the common case pays one walk and zero jax work
+        if not any((not n.is_variable) and not n.inputs
+                   and n.op.name in _CONST_SOURCES for n in order):
+            return {"folded": 0}
+
+        # the graph builder applies the per-op AMP cast policy to every
+        # node's inputs; a fold that evaluated cast-free would bake
+        # DIFFERENT values than the unoptimized trace computes (add_n
+        # is a LOWP op).  Mirror the casts here — and the optimize
+        # cache keys on the compute dtype, so a graph rebound under a
+        # different policy re-folds.
+        compute_dtype = _amp.get_compute_dtype()
+        max_bytes = getenv_int("MXTPU_FOLD_MAX_BYTES", 1 << 20)
+        values: Dict[Tuple[int, int], np.ndarray] = {}
+        foldable: set = set()
+        for n in order:
+            if n.is_variable or n.op.needs_rng or n.op.train_aware \
+                    or n.op.mutate_inputs:
+                continue
+            name = n.op.name
+            if n.inputs:
+                if name not in _FOLD_PROP:
+                    continue
+                if not all((id(i), x) in values for i, x in n.inputs):
+                    continue
+            elif name not in _CONST_SOURCES:
+                continue
+            try:
+                import jax.numpy as jnp
+
+                # EAGER evaluation (no per-(op, attrs) jit wrapper):
+                # jax's primitive-level caches are shared process-wide,
+                # so a subprocess-heavy test/deploy fleet doesn't pay a
+                # fresh trace+compile per folded op.  Eager and jitted
+                # lowerings of these whitelisted elementwise/shape ops
+                # agree bitwise (same kernels, no reductions).
+                ins = [jnp.asarray(values[(id(i), x)])
+                       for i, x in n.inputs]
+                if compute_dtype is not None and ins:
+                    ins = _amp.cast_op_inputs(name, ins, compute_dtype)
+                out = n.op.fn(*ins, **dict(n.attrs))
+                if not isinstance(out, tuple):
+                    out = (out,)
+                outs = [np.asarray(o) for o in out]
+            except Exception:
+                continue  # unfoldable in practice (bad attrs, ...) — keep
+            if sum(o.nbytes for o in outs) > max_bytes:
+                continue
+            foldable.add(id(n))
+            for i, o in enumerate(outs):
+                values[(id(n), i)] = o
+
+        if not foldable:
+            return {"folded": 0}
+        cons = consumer_map(symbol)
+        mapping: Dict[Tuple[int, int], Tuple] = {}
+        folded = bytes_folded = 0
+        for n in order:
+            if id(n) not in foldable:
+                continue
+            users = cons.get(id(n), ())
+            if not any(c is None or id(c) not in foldable
+                       for c, _, _ in users):
+                continue  # interior constant: dies with the frontier
+            vals = [values[(id(n), i)] for i in range(n.num_outputs())]
+            # keep the ORIGINAL node name: a folded head must not
+            # rename list_outputs(), and scope attribution stays put
+            cn = make_const_node(n.name, vals)
+            cn.ext_attrs.update(n.ext_attrs)
+            cn.ext_attrs["__folded__"] = "1"
+            for i in range(n.num_outputs()):
+                mapping[(id(n), i)] = (cn, i)
+            folded += 1
+            bytes_folded += sum(v.nbytes for v in vals)
+        if mapping:
+            rewrite_entries(symbol, mapping)
+        return {"folded": folded, "folded_bytes": bytes_folded}

@@ -1,0 +1,172 @@
+"""Elementwise-chain fusion grouping.
+
+Collapses maximal single-consumer runs of elementwise ops into ONE
+fused node whose fn replays the member ops in order — the traced jaxpr
+is identical primitive-for-primitive, so outputs (and vjp gradients)
+are bitwise unchanged.  What changes is the graph's granularity: the
+chain traces under a single ``jax.named_scope``, so `mx.inspect` HLO
+attribution and device traces see one region (one layer) where XLA
+fuses one kernel, instead of N per-op scopes chopping the metadata —
+and graph-level tooling (node counts, bench deltas) sees the region
+the way the compiler does.  The TVM/Relay analog is the
+pattern-kind fusion of arXiv 1802.04799 / 1810.00952 restricted to
+injective (elementwise) chains.
+
+Chain membership: single-visible-output, deterministic, non-train-
+aware ops from the elementwise whitelist; every intermediate is
+consumed ONLY by the next member (so no value is computed twice) and
+is not a graph head.  External operands may enter at any position.
+The fused node takes the chain's terminal name (attribution lands on
+the layer a user would blame) and lists its members in the
+``__fused__`` ext attr.
+
+AMP: `_build_graph_fn` applies the per-op-NAME cast policy at the node
+boundary — a fused node would get the policy of its synthetic name, so
+the replay applies `amp.cast_op_inputs` per MEMBER op inside the fn
+(the op's `amp_inline` flag tells the graph builder to skip its own
+boundary cast), keeping mixed-precision graphs bitwise identical to
+their unfused form.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from ..ops.registry import OpDef
+from ..symbol.symbol import Symbol, SymbolNode, _topo_order
+from .core import GraphPass
+from .graph import consumer_map, rewrite_entries
+
+__all__ = ["ElemwiseFusionPass", "FUSABLE_OPS"]
+
+FUSABLE_OPS = frozenset({
+    # unary elementwise
+    "abs", "cbrt", "ceil", "cos", "cosh", "degrees", "erf", "erfinv",
+    "exp", "expm1", "fix", "floor", "gamma", "gammaln", "log", "log10",
+    "log1p", "log2", "logical_not", "negative", "radians", "rcbrt",
+    "reciprocal", "rint", "round", "rsqrt", "sign", "sin", "sinh",
+    "sqrt", "square", "tan", "tanh", "trunc", "arccos", "arccosh",
+    "arcsin", "arcsinh", "arctan", "arctanh",
+    "relu", "sigmoid", "hard_sigmoid", "softsign", "Activation",
+    "LeakyReLU", "clip", "smooth_l1", "Cast", "_copy", "BlockGrad",
+    "make_loss", "zeros_like", "ones_like",
+    # binary / n-ary elementwise
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "_grad_add", "_hypot", "_power", "_maximum", "_minimum", "_mod",
+    "_equal", "_not_equal", "_greater", "_greater_equal", "_lesser",
+    "_lesser_equal", "_logical_and", "_logical_or", "_logical_xor",
+    "add_n",
+    # broadcast binary
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_mod", "broadcast_power", "broadcast_hypot",
+    "broadcast_maximum", "broadcast_minimum", "broadcast_equal",
+    "broadcast_not_equal", "broadcast_greater", "broadcast_greater_equal",
+    "broadcast_lesser", "broadcast_lesser_equal", "broadcast_logical_and",
+    "broadcast_logical_or", "broadcast_logical_xor",
+    # scalar ops
+    "_plus_scalar", "_minus_scalar", "_rminus_scalar", "_mul_scalar",
+    "_div_scalar", "_rdiv_scalar", "_mod_scalar", "_rmod_scalar",
+    "_power_scalar", "_rpower_scalar", "_hypot_scalar", "_maximum_scalar",
+    "_minimum_scalar", "_equal_scalar", "_not_equal_scalar",
+    "_greater_scalar", "_greater_equal_scalar", "_lesser_scalar",
+    "_lesser_equal_scalar", "_logical_and_scalar", "_logical_or_scalar",
+    "_logical_xor_scalar",
+})
+
+_PREV = -1  # slot marker: the previous chain member's output
+
+
+def _fusable(node: SymbolNode) -> bool:
+    if node.is_variable:
+        return False
+    op = node.op
+    return (op.name in FUSABLE_OPS and not op.needs_rng
+            and not op.train_aware and not op.mutate_inputs
+            and op.n_outputs(node.attrs) == 1)
+
+
+def _make_fused_fn(specs):
+    """Replay [(opdef, attrs, slots)] over external inputs; slot _PREV
+    threads the running value.  Per-member AMP casts — see module doc."""
+
+    def fused_fn(*ext_vals, **_kwargs):
+        from .. import amp as _amp
+
+        dt = _amp.get_compute_dtype()
+        cur = None
+        for opdef, attrs, slots in specs:
+            ins = [cur if s == _PREV else ext_vals[s] for s in slots]
+            if dt is not None:
+                ins = _amp.cast_op_inputs(opdef.name, ins, dt)
+            out = opdef.fn(*ins, **attrs)
+            cur = out[0] if isinstance(out, tuple) else out
+        return cur
+
+    return fused_fn
+
+
+class ElemwiseFusionPass(GraphPass):
+    name = "fuse"
+
+    def run(self, symbol: Symbol) -> Dict[str, Any]:
+        order = _topo_order(symbol._outputs)
+        cons = consumer_map(symbol)
+        head_ids = {id(n) for n, _ in symbol._outputs}
+        used: set = set()
+        chains: List[List[SymbolNode]] = []
+        for n in order:
+            if id(n) in used or not _fusable(n):
+                continue
+            chain = [n]
+            cur = n
+            while True:
+                users = cons.get(id(cur), ())
+                ucons = {id(c) for c, _, _ in users}
+                # intermediates must feed EXACTLY the next member (a
+                # head output is an external consumer too)
+                if len(ucons) != 1 or id(cur) in head_ids:
+                    break
+                nxt = users[0][0]
+                if nxt is None or id(nxt) in used or not _fusable(nxt):
+                    break
+                chain.append(nxt)
+                cur = nxt
+            if len(chain) >= 2:
+                used.update(id(c) for c in chain)
+                chains.append(chain)
+
+        mapping: Dict[Tuple[int, int], Tuple] = {}
+        nodes_fused = 0
+        for chain in chains:
+            members = {id(c) for c in chain}
+            ext: List[Tuple[SymbolNode, int]] = []
+            specs = []
+            for i, node in enumerate(chain):
+                slots = []
+                for (inode, idx) in node.inputs:
+                    if i > 0 and inode is chain[i - 1]:
+                        slots.append(_PREV)
+                        continue
+                    assert id(inode) not in members
+                    for j, (en, ei) in enumerate(ext):
+                        if en is inode and ei == idx:
+                            slots.append(j)
+                            break
+                    else:
+                        ext.append((inode, idx))
+                        slots.append(len(ext) - 1)
+                specs.append((node.op, dict(node.attrs), tuple(slots)))
+            op = OpDef("_fused_elemwise", _make_fused_fn(specs),
+                       num_outputs=1,
+                       doc="elementwise chain fused by mxtpu.passes")
+            op.amp_inline = True   # member-wise casts inside the fn
+            op.no_cse = True       # closure identity, not attr identity
+            op.fused_members = [c.name for c in chain]
+            tail = chain[-1]
+            fused = SymbolNode(op, tail.name, {}, ext)
+            fused.ext_attrs = dict(tail.ext_attrs)
+            fused.ext_attrs["__fused__"] = ",".join(c.name for c in chain)
+            mapping[(id(tail), 0)] = (fused, 0)
+            nodes_fused += len(chain) - 1
+        if mapping:
+            rewrite_entries(symbol, mapping)
+        return {"chains": len(chains), "nodes_fused": nodes_fused}

@@ -1,0 +1,194 @@
+"""Shared graph machinery for the symbol-level pass framework.
+
+Passes operate on a PRIVATE clone of the user's Symbol graph
+(:func:`clone_graph`) and mutate it freely — node ``inputs`` lists and
+the symbol's output entries are rewritten in place, and nodes dropped
+from every input list simply vanish from the next ``_topo_order`` walk
+(reachability from the heads IS liveness in this IR).  The helpers
+here are the only graph-surgery primitives the individual passes use:
+
+  * :func:`clone_graph` — structural deep copy (ops/attrs shared,
+    nodes/edges private), iterative so graph depth never hits the
+    Python recursion limit.
+  * :func:`consumer_map` — reverse-edge index including the graph
+    heads (consumer ``None``), for single-consumer/frontier tests.
+  * :func:`rewrite_entries` — apply an ``(old node, out idx) -> entry``
+    mapping transitively across every edge and head.
+  * :func:`ensure_rng_ids` — the stable per-node RNG identity that
+    makes graph rewrites safe for stochastic ops (see below).
+  * :func:`make_const_node` — a constant-carrying node for the folding
+    pass (the value is embedded at trace time as an XLA constant).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ops.registry import OpDef
+from ..symbol.symbol import Symbol, SymbolNode, _topo_order
+
+Entry = Tuple[SymbolNode, int]
+
+__all__ = ["clone_graph", "node_count", "op_node_count", "consumer_map",
+           "rewrite_entries", "ensure_rng_ids", "rng_id_of",
+           "make_const_node"]
+
+
+def clone_graph(symbol: Symbol) -> Symbol:
+    """Structurally identical private copy of ``symbol``'s graph.
+    OpDefs and attr VALUES are shared (treated immutable); nodes,
+    input lists, attr dicts and ext_attrs dicts are fresh.  Nodes are
+    built via ``__new__`` — this runs on EVERY bind, and
+    ``SymbolNode.__init__``'s AttrScope snapshot (a thread-local
+    lookup + dict copy per node, immediately overwritten here) is
+    measurable across a bind-heavy process."""
+    memo: Dict[int, SymbolNode] = {}
+    for n in _topo_order(symbol._outputs):
+        new = SymbolNode.__new__(SymbolNode)
+        new.op = n.op
+        new.name = n.name
+        new.attrs = dict(n.attrs)
+        new.inputs = [(memo[id(i)], x) for i, x in n.inputs]
+        new.is_aux = n.is_aux
+        new.ext_attrs = dict(n.ext_attrs)
+        memo[id(n)] = new
+    return Symbol([(memo[id(n)], i) for n, i in symbol._outputs])
+
+
+def node_count(symbol: Symbol) -> int:
+    return len(_topo_order(symbol._outputs))
+
+
+def op_node_count(symbol: Symbol) -> int:
+    """Non-variable (executing) nodes only."""
+    return sum(1 for n in _topo_order(symbol._outputs) if not n.is_variable)
+
+
+def consumer_map(symbol: Symbol) -> Dict[int, List[Tuple[Optional[SymbolNode], int, int]]]:
+    """id(producer) -> [(consumer node | None for a graph head,
+    consumer input slot | head position, producer output idx), ...]."""
+    cons: Dict[int, List[Tuple[Optional[SymbolNode], int, int]]] = {}
+    for n in _topo_order(symbol._outputs):
+        for s, (i, idx) in enumerate(n.inputs):
+            cons.setdefault(id(i), []).append((n, s, idx))
+    for s, (i, idx) in enumerate(symbol._outputs):
+        cons.setdefault(id(i), []).append((None, s, idx))
+    return cons
+
+
+def rewrite_entries(symbol: Symbol,
+                    mapping: Dict[Tuple[int, int], Entry],
+                    skip=()) -> None:
+    """Apply ``{(id(old node), out idx): (new node, new idx)}`` to every
+    input edge and graph head, resolving chains transitively (a mapping
+    target may itself be mapped).  New nodes introduced by the mapping
+    are swept too (their inputs may reference remapped entries).
+    ``skip`` node ids keep their inputs verbatim — for wrapper nodes
+    that must keep referencing the very node the mapping redirects."""
+
+    def resolve(e: Entry) -> Entry:
+        hops = 0
+        while (id(e[0]), e[1]) in mapping:
+            e = mapping[(id(e[0]), e[1])]
+            hops += 1
+            if hops > 100000:
+                raise MXNetError("pass rewrite mapping contains a cycle")
+        return e
+
+    symbol._outputs = [resolve(e) for e in symbol._outputs]
+    done: set = set(skip)
+    progress = True
+    # fixpoint: each sweep re-walks from the heads so nodes that became
+    # reachable through a rewritten edge get their own inputs rewritten
+    while progress:
+        progress = False
+        for n in _topo_order(symbol._outputs):
+            if id(n) in done:
+                continue
+            if n.inputs:
+                n.inputs = [resolve(e) for e in n.inputs]
+            done.add(id(n))
+            progress = True
+
+
+# ---------------------------------------------------------------------------
+# Stable per-node RNG identity
+# ---------------------------------------------------------------------------
+
+def ensure_rng_ids(symbol: Symbol) -> None:
+    """Assign every ``needs_rng`` node a stable ``__rng_id__`` ext attr.
+
+    ``_build_graph_fn`` historically folded the step key by the node's
+    position among RNG nodes in topo order — so ANY pass that removes or
+    reorders nodes would silently renumber (reseed) downstream
+    dropout-style ops.  Assigning the id once, on the ORIGINAL graph in
+    topo order, keeps the unoptimized numbering bitwise identical to the
+    legacy behavior while making it invariant under rewrites (clones
+    copy ext_attrs, so the optimized graph folds the same ids).
+
+    Idempotent.  Duplicate ids (a bound sub-symbol composed twice into
+    one graph) are re-assigned deterministically in topo order."""
+    used: set = set()
+    pending: List[SymbolNode] = []
+    for n in _topo_order(symbol._outputs):
+        if n.is_variable or not n.op.needs_rng:
+            continue
+        rid = n.ext_attrs.get("__rng_id__")
+        if rid is not None:
+            try:
+                rid = int(rid)
+            except ValueError:
+                rid = None
+        if rid is not None and rid not in used:
+            used.add(rid)
+        else:
+            pending.append(n)
+    nxt = 0
+    for n in pending:
+        while nxt in used:
+            nxt += 1
+        n.ext_attrs["__rng_id__"] = str(nxt)
+        used.add(nxt)
+        nxt += 1
+
+
+def rng_id_of(node: SymbolNode, fallback: int) -> int:
+    """The node's stable RNG id (``fallback`` = legacy topo position,
+    for graphs built before :func:`ensure_rng_ids` ran)."""
+    rid = node.ext_attrs.get("__rng_id__")
+    if rid is None:
+        return fallback
+    try:
+        return int(rid)
+    except ValueError:
+        return fallback
+
+
+# ---------------------------------------------------------------------------
+# Constant nodes (folding)
+# ---------------------------------------------------------------------------
+
+def make_const_node(name: str, values: Sequence[Any]) -> SymbolNode:
+    """A node evaluating to pre-computed host values.  The op is a
+    per-node OpDef (NOT in the global registry): its fn closes over the
+    numpy values and re-emits them at trace time, where XLA embeds them
+    as program constants.  Graphs holding const nodes are for binding /
+    analysis — ``tojson`` of one is not round-trippable."""
+    vals = tuple(np.asarray(v) for v in values)
+
+    def _const_fn(**_kwargs):
+        import jax.numpy as jnp
+
+        outs = tuple(jnp.asarray(v) for v in vals)
+        return outs if len(outs) > 1 else outs[0]
+
+    op = OpDef("_pass_const", _const_fn, num_outputs=len(vals),
+               differentiable=False,
+               doc="constant materialized by mxtpu.passes fold")
+    op.const_values = vals     # value-keyed CSE + debugging
+    op.amp_inline = True       # no inputs -> nothing for AMP to cast
+    node = SymbolNode(op, name, {}, [])
+    node.ext_attrs = {}
+    return node

@@ -1,0 +1,220 @@
+"""Layout propagation: whole-region NHWC for the conv stack.
+
+``MXTPU_LAYOUT=nhwc`` (the pass form of the per-op
+``MXTPU_CONV_LAYOUT`` hack) rewrites every 2-D ``Convolution`` /
+``Pooling`` node to run natively channels-last (``layout="NHWC"``
+attr, honored by the op fns) and brackets it with explicit
+``transpose`` nodes.  A propagation fixpoint then SINKS the
+NHWC->NCHW exit transposes downward — through unary elementwise ops,
+through ``BatchNorm`` (axis 1 -> 3), and through binary elementwise
+ops whose operands are both transposed the same way — until they meet
+the next conv's entry transpose and cancel.  A straight
+conv→bn→relu→conv stack ends up with ONE enter and ONE exit transpose
+instead of a pair per op, which is exactly the graph-level
+transpose-cancellation TVM's layout pass does (arXiv 1802.04799) and
+what `inspect.hlo_histogram`'s ``n_transposes_surviving`` was built to
+measure (ROADMAP item 2: why NHWC benched neutral).
+
+Unlike the other default passes this one is NOT bitwise against the
+NCHW graph: permuting the layout legally permutes reduction iteration
+order (BatchNorm batch statistics, pooling window sums), so parity is
+verified within float tolerance by `tools/check_passes.py --layout`.
+The pass is inert unless requested (env or explicit pass list).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..base import getenv
+from ..ops.registry import get_op
+from ..symbol.symbol import Symbol, SymbolNode, _topo_order
+from .core import GraphPass
+from .graph import consumer_map, rewrite_entries
+
+__all__ = ["LayoutPass", "layout_requested"]
+
+# NCHW <-> NHWC permutations (2 spatial dims; other ranks are skipped)
+_TO_CL = (0, 2, 3, 1)
+_FROM_CL = (0, 3, 1, 2)
+
+# unary shape-preserving ops a transpose commutes with exactly
+_SINK_UNARY = frozenset({
+    "relu", "sigmoid", "tanh", "softsign", "hard_sigmoid", "Activation",
+    "LeakyReLU", "clip", "Cast", "_copy", "BlockGrad", "negative",
+    "abs", "exp", "log", "sqrt", "square", "rsqrt", "reciprocal",
+    "_plus_scalar", "_minus_scalar", "_rminus_scalar", "_mul_scalar",
+    "_div_scalar", "_rdiv_scalar", "_power_scalar", "_maximum_scalar",
+    "_minimum_scalar",
+})
+
+# binary same-shape ops sinkable when BOTH operands are equally permuted
+_SINK_BINARY = frozenset({
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "_grad_add", "_maximum", "_minimum",
+})
+
+
+def layout_requested() -> bool:
+    return (getenv("MXTPU_LAYOUT") or "").lower() == "nhwc"
+
+
+def _is_transpose(node: SymbolNode) -> bool:
+    return (not node.is_variable) and node.op.name == "transpose"
+
+
+def _axes_of(node: SymbolNode) -> Optional[Tuple[int, ...]]:
+    a = node.attrs.get("axes")
+    return tuple(a) if a else None
+
+
+def _compose(p1: Tuple[int, ...], p2: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Permutation of transpose(transpose(x, p1), p2)."""
+    return tuple(p1[i] for i in p2)
+
+
+def _mk_transpose(name: str, entry, axes: Tuple[int, ...]) -> SymbolNode:
+    node = SymbolNode(get_op("transpose"), name, {"axes": axes}, [entry])
+    node.ext_attrs = {}
+    return node
+
+
+def _single_consumer(cons, node) -> Optional[SymbolNode]:
+    """The one consumer NODE of ``node``, or None when it has several,
+    is a graph head, or is unconsumed."""
+    users = cons.get(id(node), ())
+    ids = {id(c) for c, _, _ in users}
+    if len(ids) != 1:
+        return None
+    return users[0][0]  # None for a head = no sinkable consumer
+
+
+class LayoutPass(GraphPass):
+    name = "layout"
+
+    def run(self, symbol: Symbol) -> Dict[str, Any]:
+        stats = {"convs_rewritten": 0, "pools_rewritten": 0,
+                 "transposes_inserted": 0, "transposes_cancelled": 0,
+                 "sunk": 0}
+        self._wrap_spatial_ops(symbol, stats)
+        if stats["convs_rewritten"] or stats["pools_rewritten"]:
+            self._propagate(symbol, stats)
+        return stats
+
+    # -- phase A: native-NHWC spatial ops with explicit boundaries -------
+    def _wrap_spatial_ops(self, symbol: Symbol, stats) -> None:
+        mapping: Dict[Tuple[int, int], Tuple] = {}
+        anchors: List[Tuple[SymbolNode, SymbolNode]] = []
+        for n in _topo_order(symbol._outputs):
+            if n.is_variable:
+                continue
+            lay = str(n.attrs.get("layout") or "").upper()
+            if lay not in ("", "NONE", "NCHW") \
+                    or len(n.attrs.get("kernel", ()) or ()) != 2:
+                continue
+            if n.op.name == "Convolution":
+                kind = "convs_rewritten"
+            elif n.op.name == "Pooling" and not n.attrs.get("global_pool"):
+                kind = "pools_rewritten"
+            else:
+                continue
+            t_in = _mk_transpose(n.name + "__to_nhwc", n.inputs[0], _TO_CL)
+            n.inputs[0] = (t_in, 0)
+            n.attrs["layout"] = "NHWC"
+            t_out = _mk_transpose(n.name + "__to_nchw", (n, 0), _FROM_CL)
+            mapping[(id(n), 0)] = (t_out, 0)
+            anchors.append((t_out, n))
+            stats[kind] += 1
+            stats["transposes_inserted"] += 2
+        if mapping:
+            # the exit transposes must keep reading the very entries the
+            # mapping redirects, so their inputs are exempt from the sweep
+            rewrite_entries(symbol, mapping,
+                            skip={id(t) for t, _ in anchors})
+
+    # -- phase B: sink + cancel fixpoint ---------------------------------
+    def _propagate(self, symbol: Symbol, stats) -> None:
+        guard = 0
+        limit = 25 * max(1, len(_topo_order(symbol._outputs)))
+        while guard < limit:
+            guard += 1
+            if not self._one_edit(symbol, stats):
+                break
+
+    def _one_edit(self, symbol: Symbol, stats) -> bool:
+        nodes = _topo_order(symbol._outputs)
+        cons = consumer_map(symbol)
+        for n in nodes:
+            if not _is_transpose(n):
+                continue
+            axes = _axes_of(n)
+            if axes is None:
+                continue
+            src, src_idx = n.inputs[0]
+            # merge/cancel: transpose(transpose(x)).  Safe even when the
+            # inner transpose keeps other consumers (it just stays).
+            if _is_transpose(src) and src_idx == 0:
+                inner = _axes_of(src)
+                if inner is not None:
+                    combined = _compose(inner, axes)
+                    if combined == tuple(range(len(combined))):
+                        rewrite_entries(symbol, {(id(n), 0): src.inputs[0]})
+                        stats["transposes_cancelled"] += 2
+                    else:
+                        n.inputs[0] = src.inputs[0]
+                        n.attrs["axes"] = combined
+                        stats["transposes_cancelled"] += 1
+                    return True
+            # sink below this transpose's single consumer
+            c = _single_consumer(cons, n)
+            if c is None:
+                continue
+            if self._sink(symbol, n, axes, c, cons, stats):
+                return True
+        return False
+
+    @staticmethod
+    def _swap_below(symbol, t, c) -> None:
+        """Finish a sink: consumers of ``c`` now read ``t``, and ``t``
+        reads ``c`` — done in an order that never forms a self-loop
+        (``t`` is unreferenced during the sweep, re-anchored after)."""
+        rewrite_entries(symbol, {(id(c), 0): (t, 0)})
+        t.inputs[0] = (c, 0)
+
+    def _sink(self, symbol, t, axes, c, cons, stats) -> bool:
+        """Move transpose ``t`` (feeding consumer ``c``) below ``c``
+        when ``c`` commutes with the permutation."""
+        name = c.op.name
+        if name in _SINK_UNARY:
+            if len(c.inputs) != 1 or c.inputs[0][0] is not t:
+                return False
+            c.inputs[0] = t.inputs[0]
+            self._swap_below(symbol, t, c)
+            stats["sunk"] += 1
+            return True
+        if name in _SINK_BINARY and len(c.inputs) == 2:
+            (a, ai), (b, bi) = c.inputs
+            if not (_is_transpose(a) and _is_transpose(b)
+                    and ai == 0 and bi == 0):
+                return False
+            if _axes_of(a) != axes or _axes_of(b) != axes:
+                return False
+            if _single_consumer(cons, a) is not c or \
+                    _single_consumer(cons, b) is not c:
+                return False
+            c.inputs = [a.inputs[0], b.inputs[0]]
+            self._swap_below(symbol, a, c)
+            if b is not a:
+                stats["transposes_cancelled"] += 1  # b goes unreachable
+            stats["sunk"] += 1
+            return True
+        if name in ("BatchNorm", "BatchNorm_v1") \
+                and int(c.attrs.get("axis", 1)) == 1 \
+                and c.inputs and c.inputs[0][0] is t:
+            c.inputs[0] = t.inputs[0]
+            # dim d of t's output is dim axes[d] of t's input, so the
+            # channel axis (1, NCHW) lives at axes[1] pre-transpose
+            c.attrs["axis"] = axes[1]
+            self._swap_below(symbol, t, c)
+            stats["sunk"] += 1
+            return True
+        return False

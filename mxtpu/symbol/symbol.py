@@ -500,6 +500,24 @@ class Symbol(object):
         return Executor._bind(apply_bind_hook(self), ctx, args, args_grad,
                               grad_req, aux_states)
 
+    def optimize(self, passes=None, return_report: bool = False):
+        """Run the `mxtpu.passes` graph-rewrite pipeline over this
+        symbol and return the optimized Symbol (the original graph is
+        untouched).  ``passes`` is a spec like ``"dce,fold"`` /
+        ``"default,-fuse"`` / a name sequence; None uses the active
+        ``MXTPU_PASSES`` configuration.  ``return_report=True`` returns
+        ``(symbol, report)`` with per-pass node counts and stats —
+        that report is what ``tools/hlo_report.py --symbol-json``
+        prints as pre/post deltas.
+
+        Note: a graph holding folded constants binds and analyzes
+        normally but does not round-trip through ``tojson``/``load``
+        (the constant op carries its value in a closure)."""
+        from .. import passes as _passes
+
+        opt, report = _passes.optimize(self, passes)
+        return (opt, report) if return_report else opt
+
     def optimize_for(self, backend, args=None, aux=None, **kwargs):
         """Apply a registered subgraph backend to this graph (the
         reference's `Symbol.optimize_for` / `MXNET_SUBGRAPH_BACKEND`

@@ -82,6 +82,18 @@ def test_check_inspect_guard():
     assert "check_inspect OK" in out
 
 
+def test_check_passes_guard():
+    """tools/check_passes.py: the graph-rewrite pipeline must be
+    bitwise output-identical (passes on vs off) on a real small-model
+    train run across all three dispatch paths, strictly reduce node
+    count, add zero retraces, hold the per-pass time budget, and the
+    NHWC layout pass must cut graph-level transposes vs the per-op
+    form while staying within 1e-4 (see mxtpu/passes/,
+    docs/passes.md)."""
+    out = _run(["tools/check_passes.py", "--layout"], timeout=420)
+    assert "check_passes OK" in out
+
+
 def test_check_health_guard():
     """tools/check_health.py: a NaN injected at a named mid-model
     layer must be blamed to that layer in health.report(), the

@@ -17,6 +17,14 @@ mobilenet1.0, ...), the built-in ``mlp`` (2-layer,
 exported by `HybridBlock.export` / `Symbol.save` (data shape from
 ``--batch``/``--data-shape``).
 
+Graph-rewrite passes (`mxtpu.passes`) run for the build under
+``--passes`` (default: the active MXTPU_PASSES config).  With
+``--symbol-json`` the exported graph is ALSO analyzed pre-pass and the
+report carries a ``pass_deltas`` section — node count and
+HLO-histogram (transposes/fusions/copies) before vs after — plus the
+full per-pass report, so "what did the pipeline buy on THIS graph" is
+one command.  ``--passes off`` restores the raw analysis.
+
 Usage:  python tools/hlo_report.py --batch 128 --dtype bfloat16 --spp 2
         JAX_PLATFORMS=cpu python tools/hlo_report.py --model mlp --batch 8
         JAX_PLATFORMS=cpu python tools/hlo_report.py \
@@ -133,6 +141,11 @@ def main():
     ap.add_argument("--classes", type=int, default=1000)
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--spp", type=int, default=2)
+    ap.add_argument("--passes", default=None,
+                    help="graph-rewrite pass spec for the build "
+                         "(default: active MXTPU_PASSES config; 'off' "
+                         "disables; with --symbol-json the report adds "
+                         "pre/post pass_deltas)")
     ap.add_argument("--dump", default="",
                     help="also write full optimized HLO text here")
     args = ap.parse_args()
@@ -140,16 +153,41 @@ def main():
         args.classes = 10
 
     import mxtpu as mx
+    import mxtpu.passes as P
 
     # this tool IS the inspector's CLI: a disabled registry
     # (MXTPU_INSPECT=0 in the caller's env) would leave it nothing to
     # report on
     mx.inspect.enable(True)
-    loop = build(args)
+    spec = P.parse_spec(args.passes) if args.passes is not None \
+        else P.current_spec()
+    pass_deltas = None
+    if args.symbol_json and spec:
+        # exported graphs route through Symbol.optimize: analyze the
+        # RAW graph first, then the pass-optimized build below — the
+        # deltas are the report's headline for --symbol-json
+        with P.scope("off"):
+            raw_loop = build(args)
+        raw_report = mx.inspect.report(raw_loop._insp, kind="train")
+        head, _ = _load_symbol(args)
+        _, opt_report = head.optimize(passes=list(spec),
+                                      return_report=True)
+        pass_deltas = {"spec": ",".join(spec),
+                       "nodes": [opt_report["nodes_before"],
+                                 opt_report["nodes_after"]],
+                       "per_pass": opt_report["passes"]}
+    with P.scope(list(spec) if spec else "off"):
+        loop = build(args)
     report = mx.inspect.report(loop._insp, kind="train")
+    if pass_deltas is not None:
+        for k in ("n_transposes_surviving", "n_fusions",
+                  "n_copies_surviving", "n_convolutions"):
+            pass_deltas[k] = [raw_report.get(k), report.get(k)]
+        report["pass_deltas"] = pass_deltas
     report["config"] = {"model": args.symbol_json or args.model,
                         "batch": args.batch, "image": args.image,
-                        "dtype": args.dtype, "spp": args.spp}
+                        "dtype": args.dtype, "spp": args.spp,
+                        "passes": ",".join(spec) or "off"}
     if args.dump:
         with open(args.dump, "w") as f:
             f.write(mx.inspect.hlo(loop._insp.name, kind="train"))
