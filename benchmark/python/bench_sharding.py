@@ -1,0 +1,149 @@
+"""ZeRO-1 sharding benchmark seed: optimizer-state bytes + step wall
+time vs replica count.
+
+What `mx.shard` buys (ROADMAP item 1): per-replica optimizer-state
+memory drops ~1/N while the training trajectory stays bitwise — so the
+metric that matters is state bytes/replica against the step-time cost
+of the slice-update + allgather on this host.  Runs the host-replica
+ZeRO-1 engine (Module over N cpu contexts, Adam) for each replica
+count in MXTPU_BENCH_SHARD_REPLICAS, replicated vs sharded, and — on
+a multi-device mesh — the FusedTrainLoop GSPMD sharded-carry variant.
+
+On the virtual CPU mesh the step-time numbers are code-path overhead
+(no real interconnect); on TPU hardware the same harness reports the
+ICI-bound allgather cost against the HBM freed per chip.
+
+Emits ONE JSON line (driver contract):
+  {"metric": "zero1_state_fraction", "value": <per-replica/full at max
+   N>, "unit": "x", "vs_baseline": <sharded/replicated step time>,
+   "extra": {per-N rows}}
+
+Env knobs: MXTPU_BENCH_SHARD_REPLICAS ("1,2,4"), MXTPU_BENCH_SHARD_STEPS
+(30), MXTPU_BENCH_SHARD_HIDDEN (256), MXTPU_BENCH_SHARD_BATCH (64).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+REPLICAS = [int(x) for x in os.environ.get(
+    "MXTPU_BENCH_SHARD_REPLICAS", "1,2,4").split(",")]
+STEPS = int(os.environ.get("MXTPU_BENCH_SHARD_STEPS", "30"))
+HIDDEN = int(os.environ.get("MXTPU_BENCH_SHARD_HIDDEN", "256"))
+BATCH = int(os.environ.get("MXTPU_BENCH_SHARD_BATCH", "64"))
+FEAT = 64
+
+
+def _model():
+    from mxtpu import sym
+
+    x = sym.Variable("data")
+    h = sym.FullyConnected(data=x, num_hidden=HIDDEN, name="fc1")
+    h = sym.Activation(data=h, act_type="relu", name="r1")
+    h = sym.FullyConnected(data=h, num_hidden=HIDDEN, name="fc2")
+    h = sym.Activation(data=h, act_type="relu", name="r2")
+    h = sym.FullyConnected(data=h, num_hidden=8, name="fc3")
+    return sym.SoftmaxOutput(data=h, label=sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def _run(mx, np, plan, n_ctx):
+    """Train STEPS batches; returns (s/step, per_replica_state_bytes,
+    full_state_bytes)."""
+    import contextlib
+
+    from mxtpu.io.io import DataBatch
+    from mxtpu.sharding import ZeRO1Updater, zero1 as z1
+
+    rng = np.random.RandomState(0)
+    batches = [DataBatch(
+        data=[mx.nd.array(rng.rand(BATCH, FEAT).astype("float32"))],
+        label=[mx.nd.array(rng.randint(0, 8, BATCH).astype("float32"))])
+        for _ in range(STEPS)]
+    scope = plan.activate() if plan is not None \
+        else contextlib.nullcontext()
+    with scope:
+        mod = mx.mod.Module(_model(),
+                            context=[mx.cpu(i) for i in range(n_ctx)])
+        mod.bind(data_shapes=[("data", (BATCH, FEAT))],
+                 label_shapes=[("softmax_label", (BATCH,))])
+        mx.random.seed(1)
+        mod.init_params(initializer=mx.init.Xavier())
+        mod.init_optimizer(kvstore="device", optimizer="adam",
+                           optimizer_params={"learning_rate": 0.01})
+        for b in batches[:3]:   # warm compiles out of the timing
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+        t0 = time.perf_counter()
+        for b in batches[3:]:
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+        mod.get_params()        # sync
+        dt = (time.perf_counter() - t0) / max(1, STEPS - 3)
+        upd = mod._updater
+        if isinstance(upd, ZeRO1Updater):
+            full = z1.tree_nbytes(upd._gather_full())
+            per = upd.per_replica_state_nbytes()
+        else:
+            # replicated: with update_on_kvstore the ONE kvstore-side
+            # state stands in for what EACH replica would hold under
+            # per-device updaters
+            import pickle
+
+            states = mod._kvstore._updater.get_states() \
+                if mod._update_on_kvstore else upd.get_states()
+            full = per = z1.tree_nbytes(pickle.loads(states)[0])
+        return dt, per, full
+
+
+def main():
+    import numpy as np
+
+    import jax
+
+    import mxtpu as mx
+    from mxtpu.sharding import ShardingPlan
+
+    rows = {}
+    frac = 1.0
+    ratio = 1.0
+    for n in REPLICAS:
+        if jax.device_count() < n:
+            continue
+        t_rep, per_rep, _ = _run(mx, np, None, n)
+        t_sh, per_sh, full = _run(
+            mx, np, ShardingPlan(min_shard_elems=1024), n)
+        frac = per_sh / float(full)
+        ratio = t_sh / t_rep if t_rep > 0 else 1.0
+        rows["n%d" % n] = {
+            "replicated_ms_step": round(t_rep * 1e3, 3),
+            "sharded_ms_step": round(t_sh * 1e3, 3),
+            "state_bytes_per_replica": per_sh,
+            "state_bytes_full": full,
+            "state_fraction": round(frac, 4),
+        }
+        print("n=%d: %.2f -> %.2f ms/step, state %.1f -> %.1f KiB "
+              "per replica (%.3f of full)"
+              % (n, t_rep * 1e3, t_sh * 1e3, per_rep / 1024.0,
+                 per_sh / 1024.0, frac), file=sys.stderr)
+    print(json.dumps({
+        "metric": "zero1_state_fraction", "value": round(frac, 4),
+        "unit": "x", "vs_baseline": round(ratio, 3),
+        "extra": rows,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,6 +111,8 @@ from . import health
 from . import resilience
 from . import monitor
 from . import visualization
+from . import sharding
+from . import sharding as shard
 from . import module
 from . import module as mod
 from . import rnn

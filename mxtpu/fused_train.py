@@ -153,6 +153,16 @@ class FusedTrainLoop(object):
         # aborts after that many CONSECUTIVE skips.  Note the
         # optimizer's num_update still advances for skipped steps (the
         # lr schedule stays aligned with wall steps).
+        # mx.shard: an active SPMD plan (mesh + ZeRO-1) shards the
+        # scanned optimizer-state carry over the mesh's data axis —
+        # params stay replicated, each device holds 1/N of every
+        # moment, and GSPMD compiles the reduce-scatter/allgather into
+        # the K-step program itself (arXiv 2004.13336 — this is the
+        # "fused K-step loop composes with it" half of ROADMAP item 1)
+        self._shard_plan = None
+        self._carry_pin = None
+        self._init_sharded_carry(weights)
+
         self._guard = _res.BadStepGuard(site="fused_train") \
             if _res.max_bad_steps() > 0 else None
         # health observatory (mx.health): even without the guard, the
@@ -180,6 +190,87 @@ class FusedTrainLoop(object):
             symbol=ex._symbol)
         self._seen_sigs: set = set()
 
+    def _init_sharded_carry(self, weights) -> None:
+        """Re-place the scan carry for an active SPMD ShardingPlan:
+        optimizer state sharded per `plan.opt_state_spec`, params/aux
+        replicated and PINNED so GSPMD cannot drift the forward into a
+        partitioned (reassociated) computation.  No-op without a plan
+        mesh."""
+        import jax
+
+        from . import sharding as _shard
+
+        plan = _shard.current_plan()
+        if plan is None or plan.mesh is None \
+                or not plan.shard_optimizer_state \
+                or int(np.prod(plan.mesh.devices.shape)) <= 1:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        tu = jax.tree_util
+        mesh = plan.mesh
+        rep = NamedSharding(mesh, P())
+        names = [self._arg_names[i] for i in self._diff_idx]
+        w_shardings = [
+            NamedSharding(mesh, plan.opt_state_spec(n, w.shape))
+            for n, w in zip(names, weights)]
+        leaves, treedef = tu.tree_flatten(self._s_tree)
+        k = len(weights)
+        if k == 0 or not leaves or len(leaves) % k != 0:
+            # no optimizer state (e.g. momentum-free SGD) = nothing to
+            # shard, no collectives to account — stay unsharded
+            return
+        s_shard_leaves = w_shardings * (len(leaves) // k)
+        s_shardings = tu.tree_unflatten(treedef, s_shard_leaves)
+        self._p_vals = [jax.device_put(v, rep) for v in self._p_vals]
+        self._aux_vals = [jax.device_put(v, rep) for v in self._aux_vals]
+        self._s_tree = tu.tree_map(lambda v, sh: jax.device_put(v, sh),
+                                   self._s_tree, s_shardings)
+        self._shard_plan = plan
+        self._rep_sharding = rep
+        self._s_shardings = s_shardings
+        # per-chunk collective payload estimate (ring convention, see
+        # docs/sharding.md): params whose state spec actually shards
+        n = plan.num_shards
+        sharded_bytes = sum(
+            int(np.prod(w.shape)) * w.dtype.itemsize
+            for w, sh in zip(weights, w_shardings)
+            if any(ax is not None for ax in sh.spec))
+        self._collective_bytes_per_step = \
+            int(sharded_bytes * (n - 1) / float(n)) if n > 1 else 0
+
+        def pin(new_p, new_s, aux_new):
+            wsc = jax.lax.with_sharding_constraint
+            new_p = [wsc(a, rep) for a in new_p]
+            new_s = tu.tree_map(lambda a, sh: wsc(a, sh), new_s,
+                                s_shardings)
+            aux_new = [wsc(a, rep) for a in aux_new]
+            return new_p, new_s, aux_new
+
+        self._carry_pin = pin
+
+    def sharding_info(self) -> Optional[Dict[str, Any]]:
+        """Live carry placement: plan, total state bytes, and the
+        per-device state bytes (the ZeRO-1 1/N memory win, measurable
+        on the virtual CPU mesh and on real chips alike).  None when
+        the carry is unsharded."""
+        if self._shard_plan is None:
+            return None
+        import jax
+
+        leaves = [l for l in jax.tree_util.tree_leaves(self._s_tree)]
+        total = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                    for l in leaves)
+        per_dev: Dict[str, int] = {}
+        for leaf in leaves:
+            for sh in leaf.addressable_shards:
+                key = str(sh.device.id)
+                per_dev[key] = per_dev.get(key, 0) + int(
+                    np.prod(sh.data.shape)) * leaf.dtype.itemsize
+        return {"plan": self._shard_plan.describe(),
+                "state_total_bytes": total,
+                "state_bytes_per_device": per_dev}
+
     def _make_program(self):
         import jax
         import jax.numpy as jnp
@@ -199,6 +290,7 @@ class FusedTrainLoop(object):
         guard_on = self._guard is not None
         track_health = self._track_health
         stats_on = self._stats_on
+        carry_pin = self._carry_pin
 
         def program(p_vals, s_tree, aux_vals, fixed_vals, base_key, t0,
                     data_stack, lr_rows):
@@ -254,6 +346,13 @@ class FusedTrainLoop(object):
                         ys["lnorms"] = tuple(lnorms)
                 else:
                     ys = tuple(outs) if collect else ()
+                if carry_pin is not None:
+                    # sharded-carry mode: params/aux pinned replicated,
+                    # opt state pinned to its ZeRO-1 placement, every
+                    # scan iteration — GSPMD keeps the forward
+                    # replicated and the update sharded
+                    new_p, new_s, aux_new = carry_pin(new_p, new_s,
+                                                      aux_new)
                 return (new_p, new_s, aux_new, t + 1), ys
 
             (p, s, aux, _), outs = lax.scan(
@@ -299,9 +398,22 @@ class FusedTrainLoop(object):
         lr_rows = self._scan_step.host_sched(self._K)
         fixed_vals = [self._exec.arg_arrays[i]._data
                       for i in self._fixed_idx]
+        t0 = jnp.int32(self._t)
+        lr_arr = jnp.asarray(lr_rows)
+        if self._shard_plan is not None:
+            # sharded-carry mode: every non-carry input rides the mesh
+            # replicated (the carry was placed at init; jit propagates
+            # from there)
+            import jax
+
+            rep = self._rep_sharding
+            data_stack = [jax.device_put(d, rep) for d in data_stack]
+            fixed_vals = [jax.device_put(v, rep) for v in fixed_vals]
+            base_key = jax.device_put(base_key, rep)
+            t0 = jax.device_put(t0, rep)
+            lr_arr = jax.device_put(lr_arr, rep)
         return (self._p_vals, self._s_tree, self._aux_vals, fixed_vals,
-                base_key, jnp.int32(self._t), data_stack,
-                jnp.asarray(lr_rows))
+                base_key, t0, data_stack, lr_arr)
 
     def lower_stacked(self, data_stack: List[Any]):
         """AOT-lower the fused K-step program for a staged stack
@@ -366,6 +478,17 @@ class FusedTrainLoop(object):
         self._p_vals, self._s_tree, self._aux_vals = p, s, aux
         self._t += K
         self._optimizer.commit_scan_steps(self._opt_indices, K)
+        if self._shard_plan is not None \
+                and self._collective_bytes_per_step:
+            # the ring-payload estimate of what GSPMD moved for the K
+            # sharded updates (reduce-scatter grads in, allgather
+            # params out) — same counters the eager ZeRO-1 engine ticks
+            from . import profiler as _prof
+
+            _prof.inc_stat("reduce_scatter_bytes",
+                           self._collective_bytes_per_step * K)
+            _prof.inc_stat("allgather_bytes",
+                           self._collective_bytes_per_step * K)
         self._publish()
         # one record for the whole K-step program: per-step batch size
         # is the second dim of the staged (K, batch, ...) stacks

@@ -24,7 +24,7 @@ __all__ = ["Trainer"]
 class Trainer(object):
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, sharding_plan=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -47,6 +47,10 @@ class Trainer(object):
         self._params_to_init = []
         self._contexts = None
         self._bad_step_guard = None  # built lazily from MXTPU_MAX_BAD_STEPS
+        # mx.shard: an explicit plan, or the ambient one at _init_kvstore
+        # time, engages the ZeRO-1 sharded updater over the replicas
+        self._sharding_plan = sharding_plan
+        self._zero1 = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -96,7 +100,30 @@ class Trainer(object):
             for i, param in enumerate(self._params):
                 if param.grad_req != "null":
                     self._kvstore.init(i, param.data())
+        self._init_zero1()
         self._kv_initialized = True
+
+    def _init_zero1(self):
+        """Engage the ZeRO-1 sharded updater when a ShardingPlan is in
+        force (ctor arg, active `mx.shard` scope, or MXTPU_SHARD env),
+        there are multiple replica contexts, and the optimizer honors
+        the elementwise-slicing contract.  One updater replaces the N
+        per-replica full-state updaters (`docs/sharding.md`)."""
+        from .. import sharding as _shard
+
+        plan = self._sharding_plan if self._sharding_plan is not None \
+            else _shard.current_plan()
+        if (plan is None or self._update_on_kvstore
+                or len(self._contexts) <= 1
+                or not plan.shard_optimizer_state
+                or not getattr(self._optimizer, "zero1_compatible", True)):
+            self._zero1 = None
+            return
+        plan = plan.resolved(len(self._contexts))
+        self._sharding_plan = plan
+        idx2name = {i: p.name for i, p in enumerate(self._params)}
+        self._zero1 = _shard.ZeRO1Updater(self._optimizer, plan,
+                                          idx2name=idx2name)
 
     @property
     def live_workers(self):
@@ -215,6 +242,21 @@ class Trainer(object):
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        if self._zero1 is not None:
+            triples = []
+            for i, param in enumerate(self._params):
+                if param.grad_req == "null":
+                    continue
+                if param._data is None:
+                    if not ignore_stale_grad:
+                        raise MXNetError(
+                            "Parameter %s has not been initialized"
+                            % param.name)
+                    continue
+                triples.append((i, param.list_grad(), param.list_data()))
+            self._zero1.update_replicas(
+                triples, pre_reduced=self._kvstore is not None)
+            return
         pending: Dict[int, list] = {}
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
@@ -246,14 +288,20 @@ class Trainer(object):
     def save_states(self, fname):
         if not self._kv_initialized:
             self._init_kvstore()
+        upd = self._zero1 if self._zero1 is not None else self._updaters[0]
         with _res.atomic_write(fname) as f:
-            f.write(self._updaters[0].get_states(dump_optimizer=True))
+            f.write(upd.get_states(dump_optimizer=True))
 
     def load_states(self, fname):
         if not self._kv_initialized:
             self._init_kvstore()
         with open(fname, "rb") as f:
             states = f.read()
+        if self._zero1 is not None:
+            # re-shards under the active plan (replica count may differ
+            # from the saver's)
+            self._zero1.set_states(states)
+            return
         for upd in self._updaters:
             upd.set_states(states)
             upd.optimizer = self._optimizer

@@ -390,6 +390,11 @@ class ProgramRecord(object):
         # pipeline optimized the graph, so "this fusion created this
         # HLO region" is answerable from the registry
         self.pass_report: Optional[Dict[str, Any]] = None
+        # sharding provenance (mx.shard): the plan the shard pass
+        # stamped on this program's graph (or the plan active at
+        # registration), e.g. "zero1:n=4,axis=dp" — rides every
+        # telemetry ``compile`` event as the ``sharding`` field
+        self.sharding: Optional[str] = None
         self.hits = 0          # unlocked bump: the <10us hot path
         self.compiles = 0      # dispatch-path compiles (ticks *_trace)
         self.aot_compiles = 0  # warmup/AOT builds (ticks *_warmup)
@@ -453,7 +458,7 @@ class ProgramRecord(object):
         ev = _tel.record("compile", site=site, step=_tel.current_step(),
                          program=self.name, variant=kind, flops=0.0,
                          peak_bytes=0, compile_s=0.0, blame=blame,
-                         passes=pass_prov)
+                         passes=pass_prov, sharding=self.sharding)
         if not _ENABLED:
             return None
         _prof.inc_stat("inspect_compiles")
@@ -517,6 +522,8 @@ class ProgramRecord(object):
             from . import passes as _passes
 
             d["passes"] = _passes.provenance_summary(self.pass_report)
+        if self.sharding is not None:
+            d["sharding"] = self.sharding
         if analyze and sig_infos:
             analysis = sig_infos[-1].analyze()
             d.update({k: v for k, v in analysis.items() if k != "error"})
@@ -609,6 +616,23 @@ def program(site: str, name: str,
                 rec.pass_report = prov
         except Exception:
             pass
+    # sharding provenance: prefer what the shard pass actually stamped
+    # on this graph; fall back to the plan active at registration
+    try:
+        if rec.sharding is None:
+            if rec.pass_report is not None:
+                for p in rec.pass_report.get("passes", ()):
+                    if p.get("pass") == "shard" and p.get("plan"):
+                        rec.sharding = p["plan"]
+                        break
+            if rec.sharding is None:
+                from .sharding.plan import current_plan as _cur_plan
+
+                plan = _cur_plan()
+                if plan is not None:
+                    rec.sharding = plan.describe()
+    except Exception:
+        pass
     return rec
 
 

@@ -355,16 +355,21 @@ class KVStoreDevice(KVStore):
 class KVStoreTPU(KVStoreDevice):
     """`tpu` backend: XLA all-reduce over the active mesh's data axis.
 
-    With a live mesh whose ``dp`` axis matches the number of pushed
+    With a live mesh whose data axis matches the number of pushed
     per-device values, the merge is `jax.lax.psum` under shard_map (one
     compiled collective over ICI); otherwise falls back to the on-device
     fused merge.  This is the BASELINE.json ``kvstore=tpu`` north star.
+
+    Mesh and axis resolve through the sharding backbone: an explicit
+    ctor arg wins, then the `MeshContext` stack, then the active
+    `mx.shard.ShardingPlan` (mesh AND data-axis name) — the collective
+    is chosen from the plan, not hand-wired per call site.
     """
 
-    def __init__(self, mesh=None, axis="dp"):
+    def __init__(self, mesh=None, axis=None):
         super().__init__()
         self._mesh = mesh
-        self._axis = axis
+        self._axis = axis  # None = the active plan's data axis
         self.last_reduce_path = None  # "psum" | "fallback" (introspection)
         self._warned_fallback = False
 
@@ -372,32 +377,42 @@ class KVStoreTPU(KVStoreDevice):
     def type(self):
         return "tpu"
 
-    def _dp_line_mesh(self, mesh, n):
+    def _resolve(self):
+        """(mesh, axis) for this reduce, via the backbone order."""
+        from .parallel.mesh import current_mesh
+        from .sharding.plan import current_plan
+
+        plan = current_plan()
+        axis = self._axis or (plan.data_axis if plan is not None
+                              else "dp")
+        mesh = self._mesh or current_mesh() or \
+            (plan.mesh if plan is not None else None)
+        return mesh, axis
+
+    def _dp_line_mesh(self, mesh, n, axis):
         """A 1-D sub-mesh over the `n` devices forming the reduce axis.
         For a 1-D (or effectively-1-D) mesh that is the mesh itself; for
         a multi-axis mesh (dp, tp, ...) it is the dp line at index 0 of
         every other axis — the n Module replicas map onto it in order."""
-        if self._axis not in mesh.shape or mesh.shape[self._axis] != n:
+        if axis not in mesh.shape or mesh.shape[axis] != n:
             return None
         if len(mesh.devices.flat) == n:
             if len(mesh.axis_names) == 1:
                 return mesh
             from jax.sharding import Mesh
 
-            return Mesh(mesh.devices.reshape(n), (self._axis,))
+            return Mesh(mesh.devices.reshape(n), (axis,))
         from jax.sharding import Mesh
 
-        ai = list(mesh.axis_names).index(self._axis)
+        ai = list(mesh.axis_names).index(axis)
         line = np.moveaxis(mesh.devices, ai, 0).reshape(n, -1)[:, 0]
-        return Mesh(line, (self._axis,))
+        return Mesh(line, (axis,))
 
     def _reduce(self, k, vals: List[NDArray]) -> NDArray:
-        from .parallel.mesh import current_mesh
-
-        mesh = self._mesh or current_mesh()
+        mesh, axis = self._resolve()
         n = len(vals)
-        line = self._dp_line_mesh(mesh, n) if mesh is not None and n > 1 \
-            else None
+        line = self._dp_line_mesh(mesh, n, axis) \
+            if mesh is not None and n > 1 else None
         if line is not None:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec
@@ -407,14 +422,14 @@ class KVStoreTPU(KVStoreDevice):
             # one shard per pushed value, placed on the reduce-line
             # devices in order — no host round-trip, replica i's gradient
             # stays on (or moves device-to-device to) line device i
-            sharding = NamedSharding(line, PartitionSpec(self._axis))
+            sharding = NamedSharding(line, PartitionSpec(axis))
             shape0 = vals[0].shape
             line_devs = list(line.devices.flat)
             shards = [jax.device_put(v._data.reshape((1,) + shape0), d)
                       for v, d in zip(vals, line_devs)]
             stacked = jax.make_array_from_single_device_arrays(
                 (n,) + shape0, sharding, shards)
-            merged = collectives.all_reduce(stacked, axis=self._axis,
+            merged = collectives.all_reduce(stacked, axis=axis,
                                             mesh=line)[0]
             if self._compression is not None:
                 merged = self._compression.compress(k, merged)
@@ -426,7 +441,7 @@ class KVStoreTPU(KVStoreDevice):
             logging.getLogger(__name__).warning(
                 "kvstore=tpu: %d pushed values do not line up with the "
                 "mesh's %r axis (shape %s) — falling back to the fused "
-                "device merge (no XLA collective)", n, self._axis,
+                "device merge (no XLA collective)", n, axis,
                 dict(mesh.shape))
             self._warned_fallback = True
         self.last_reduce_path = "fallback"

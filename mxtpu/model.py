@@ -78,7 +78,29 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None, param_names=None):
     """Aggregate via kvstore (push+pull grads) then run the updater per
-    device (reference `model.py:165-201`)."""
+    device (reference `model.py:165-201`).
+
+    With a :class:`mxtpu.sharding.ZeRO1Updater` (Module engaged a
+    `ShardingPlan`) the per-device update loop is replaced by ONE
+    cross-replica sharded update: the updater slices the merged grad,
+    applies the optimizer on each replica's 1/N state chunk, and
+    allgathers the params back into every replica — no per-device
+    state redundancy (`docs/sharding.md`)."""
+    from .sharding.zero1 import ZeRO1Updater
+
+    if isinstance(updater, ZeRO1Updater):
+        triples = []
+        for i, (arg_list, grad_list) in enumerate(zip(param_arrays,
+                                                      grad_arrays)):
+            if grad_list[0] is None:
+                continue
+            if kvstore:
+                name = param_names[i]
+                kvstore.push(name, grad_list, priority=-i)
+                kvstore.pull(name, grad_list, priority=-i)
+            triples.append((i, grad_list, arg_list))
+        updater.update_replicas(triples, pre_reduced=kvstore is not None)
+        return
     updates: List[List[Tuple]] = [[] for _ in range(num_device)]
     for i, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair

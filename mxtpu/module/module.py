@@ -7,6 +7,7 @@ kvstore/update_on_kvstore via `model._create_kvstore`, `update`
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 from typing import Any, Dict, List, Optional
@@ -226,12 +227,27 @@ class Module(BaseModule):
                 raise MXNetError("shared_module must be bound+initialized")
             shared_group = shared_module._exec_group
 
-        self._exec_group = DataParallelExecutorGroup(
-            self._symbol, self._context, self._work_load_list, data_shapes,
-            label_shapes if for_training else (label_shapes or None),
-            self._param_names, for_training, inputs_need_grad, shared_group,
-            logger=self.logger, fixed_param_names=self._fixed_param_names,
-            grad_req=grad_req)
+        # mx.shard: Module is where the replica count becomes known, so
+        # an ambient unpinned plan is resolved HERE — the shard pass
+        # running under this bind stamps the real n onto the graph
+        # (provenance shows "zero1:n=<replicas>", not a placeholder)
+        from .. import sharding as _shard
+
+        plan = _shard.current_plan()
+        bind_scope = (
+            _shard.plan_scope(plan.resolved(len(self._context)))
+            if plan is not None and not plan.resolved_explicitly
+            and len(self._context) > 1
+            else contextlib.nullcontext())
+        with bind_scope:
+            self._exec_group = DataParallelExecutorGroup(
+                self._symbol, self._context, self._work_load_list,
+                data_shapes,
+                label_shapes if for_training else (label_shapes or None),
+                self._param_names, for_training, inputs_need_grad,
+                shared_group, logger=self.logger,
+                fixed_param_names=self._fixed_param_names,
+                grad_req=grad_req)
         if shared_module is not None and shared_module.params_initialized:
             self._arg_params = shared_module._arg_params
             self._aux_params = shared_module._aux_params
@@ -269,8 +285,30 @@ class Module(BaseModule):
             batch_size *= kvstore.num_workers
         rescale_grad = 1.0 / batch_size
 
+        # mx.shard: an active ShardingPlan (or MXTPU_SHARD=zero1) with
+        # multiple replica contexts engages the ZeRO-1 sharded updater
+        # — one updater, state in 1/N chunks — instead of N full
+        # per-device updaters.  The plan owns the update PLACEMENT
+        # too: in-process kvstores (local/device/tpu) drop to
+        # aggregation-only so the sharded update runs here (the dist
+        # PS keeps its server-side updates — sharding those is the
+        # recsys item, ROADMAP 4).  The shard pass stamped the same
+        # plan on the graph at bind.
+        from .. import sharding as _shard
+
+        plan = _shard.current_plan()
+        zero1_possible = (plan is not None and len(self._context) > 1
+                          and plan.shard_optimizer_state
+                          and self._zero1_ok(optimizer))
+        if zero1_possible and update_on_kvstore \
+                and kvstore is not None and "dist" not in kvstore.type:
+            update_on_kvstore = False
+        use_zero1 = zero1_possible and not update_on_kvstore
+        if use_zero1:
+            plan = plan.resolved(len(self._context))
+
         idx2name = {}
-        if update_on_kvstore:
+        if update_on_kvstore or use_zero1:
             idx2name.update(enumerate(self._exec_group.param_names))
         else:
             for k in range(len(self._context)):
@@ -298,6 +336,7 @@ class Module(BaseModule):
         self._kvstore = kvstore
         self._update_on_kvstore = update_on_kvstore
         self._updater = None
+        self._sharding_plan = plan if use_zero1 else None
         if kvstore:
             if self._compression_params:
                 kvstore.set_gradient_compression(self._compression_params)
@@ -308,12 +347,30 @@ class Module(BaseModule):
                                 update_on_kvstore=update_on_kvstore)
         if update_on_kvstore:
             kvstore.set_optimizer(self._optimizer)
+        elif use_zero1:
+            from ..sharding.zero1 import ZeRO1Updater
+
+            self.logger.info("mx.shard: ZeRO-1 optimizer-state sharding "
+                             "engaged (%s) over %d replicas",
+                             plan.describe(), len(self._context))
+            self._updater = ZeRO1Updater(optimizer, plan,
+                                         idx2name=dict(idx2name))
         else:
             self._updater = opt_mod.get_updater(optimizer)
         self.optimizer_initialized = True
         if hasattr(self, "_preload_opt_states"):
             self.load_optimizer_states(self._preload_opt_states)
             del self._preload_opt_states
+
+    @staticmethod
+    def _zero1_ok(optimizer) -> bool:
+        """Whether the (possibly not-yet-created) optimizer supports
+        the elementwise-slicing contract of ZeRO-1."""
+        if isinstance(optimizer, str):
+            klass = opt_mod.Optimizer.opt_registry.get(optimizer.lower())
+            return bool(klass is not None
+                        and getattr(klass, "zero1_compatible", True))
+        return bool(getattr(optimizer, "zero1_compatible", True))
 
     def borrow_optimizer(self, shared_module):
         """Share optimizer/kvstore/updater with another Module bound to
@@ -324,6 +381,8 @@ class Module(BaseModule):
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
+        self._sharding_plan = getattr(shared_module, "_sharding_plan",
+                                      None)
         self.optimizer_initialized = True
 
     # -- execution ----------------------------------------------------------

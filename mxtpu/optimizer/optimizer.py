@@ -37,6 +37,16 @@ def _is_lowp(dtype) -> bool:
 class Optimizer(object):
     opt_registry: Dict[str, type] = {}
 
+    # ZeRO-1 contract (mxtpu/sharding/zero1.py): True when `update` is a
+    # pure ELEMENTWISE function of (weight, grad, state) plus host
+    # scalars derived only from the update counters — then slicing the
+    # update across replicas is bitwise-identical to the full update and
+    # the sharded optimizer-state engine may drive this optimizer.
+    # Optimizers that reduce over the whole weight (LARS norms), draw
+    # per-call noise, or advance per-call schedule scalars must set
+    # False; they keep the replicated path.
+    zero1_compatible = True
+
     @staticmethod
     def register(klass):
         name = klass.__name__.lower()
@@ -634,6 +644,8 @@ class NAG(Optimizer):
 class SGLD(Optimizer):
     """Stochastic Gradient Langevin Dynamics (reference SGLD)."""
 
+    zero1_compatible = False  # per-call noise draw is shape-dependent
+
     def update(self, index, weight, grad, state):
         from .. import random as _rnd
 
@@ -925,6 +937,8 @@ class Adamax(Optimizer):
 
 @register
 class Nadam(Optimizer):
+    zero1_compatible = False  # m_schedule advances per update() CALL
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -964,6 +978,8 @@ class Nadam(Optimizer):
 class LBSGD(SGD):
     """Large-batch SGD with LARS-style layer-wise adaptive rate
     (reference `optimizer.py:683`; simplified warmup handling)."""
+
+    zero1_compatible = False  # LARS scales by WHOLE-weight norms
 
     def __init__(self, momentum=0.0, multi_precision=False, warmup_strategy
                  ="linear", warmup_epochs=5, batch_scale=1, updates_per_epoch

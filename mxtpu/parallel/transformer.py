@@ -168,48 +168,41 @@ def param_shapes(cfg: TransformerConfig, pp: int) -> Dict[str, Tuple]:
     return shapes
 
 
+def _plan_for_mesh(cfg: TransformerConfig, mesh):
+    """The transformer stack's ShardingPlan: Megatron model specs plus
+    ZeRO-1 state sharding over dp — re-based onto the `mx.shard`
+    backbone so the placement logic lives in ONE place
+    (`ShardingPlan.shard_dim` / `opt_state_spec`)."""
+    from ..sharding.plan import ShardingPlan
+
+    return ShardingPlan(mesh=mesh, data_axis=AXIS_DP,
+                        model_axis=AXIS_TP,
+                        param_specs=param_specs(cfg),
+                        shard_optimizer_state=True,
+                        min_shard_elems=_ZERO1_MIN_ELEMS,
+                        name="transformer")
+
+
 def _zero1_dims(cfg: TransformerConfig, mesh) -> Dict[str, Any]:
     """ZeRO-1 placement (arxiv 2004.13336, automatic cross-replica
     sharding of the weight update): per parameter, the dimension to
     shard optimizer state over the dp axis — the first spec-unsharded
-    dim whose size divides dp.  None = state stays replicated (tiny
-    params not worth a collective)."""
-    import numpy as np
-
-    dp = mesh.shape[AXIS_DP]
-    specs = param_specs(cfg)
+    dim whose size divides dp (`ShardingPlan.shard_dim`).  None =
+    state stays replicated (tiny params not worth a collective)."""
+    plan = _plan_for_mesh(cfg, mesh)
     shapes = param_shapes(cfg, mesh.shape[AXIS_PP])
-    out = {}
-    for name, shape in shapes.items():
-        spec = specs[name]
-        dim = None
-        # a few hundred floats are not worth a per-step collective
-        if dp > 1 and int(np.prod(shape)) >= _ZERO1_MIN_ELEMS:
-            for i, size in enumerate(shape):
-                ax = spec[i] if i < len(spec) else None
-                if ax is None and size % dp == 0:
-                    dim = i
-                    break
-        out[name] = dim
-    return out
+    return {name: plan.shard_dim(name, shape)
+            for name, shape in shapes.items()}
 
 
 def _opt_state_specs(cfg: TransformerConfig, mesh):
     """PartitionSpecs for the ZeRO-sharded Adam moments: the param's
-    spec with AXIS_DP added on the chosen dim."""
-    from jax.sharding import PartitionSpec as P
-
-    specs = param_specs(cfg)
+    spec with AXIS_DP added on the chosen dim
+    (`ShardingPlan.opt_state_spec`)."""
+    plan = _plan_for_mesh(cfg, mesh)
     shapes = param_shapes(cfg, mesh.shape[AXIS_PP])
-    zdims = _zero1_dims(cfg, mesh)
-    out = {}
-    for name, shape in shapes.items():
-        spec = list(specs[name]) + [None] * (len(shape)
-                                             - len(specs[name]))
-        if zdims[name] is not None:
-            spec[zdims[name]] = AXIS_DP
-        out[name] = P(*spec)
-    return out
+    return {name: plan.opt_state_spec(name, shape)
+            for name, shape in shapes.items()}
 
 
 def init_opt_state(cfg: TransformerConfig, mesh):

@@ -59,23 +59,29 @@ from .dce_cse import CSEPass, DeadNodePass
 from .fold import ConstantFoldPass
 from .fuse import ElemwiseFusionPass, FUSABLE_OPS
 from .layout import LayoutPass, layout_requested
+from .sharding import ShardingPass, shard_requested
 
 __all__ = [
     "GraphPass", "PassManager", "register_pass", "pass_names",
     "DeadNodePass", "CSEPass", "ConstantFoldPass", "ElemwiseFusionPass",
-    "LayoutPass", "optimize", "optimize_for_build", "provenance_for",
-    "provenance_summary", "ensure_rng_ids", "rng_id_of", "scope",
-    "current_spec", "FUSABLE_OPS",
+    "LayoutPass", "ShardingPass", "optimize", "optimize_for_build",
+    "provenance_for", "provenance_summary", "ensure_rng_ids",
+    "rng_id_of", "scope", "current_spec", "FUSABLE_OPS",
 ]
 
 # canonical order is registration order (see core.PassManager doc).
 # layout runs BEFORE cse so the entry transposes it inserts on sibling
 # branches (residual blocks transpose the same tensor twice) dedupe.
+# shard runs LAST (annotation-only): its specs must land on the
+# variables that SURVIVE dce/fold/cse and sit under the final fused
+# graph — and it must never give the rewriting passes annotated nodes
+# they'd have to preserve.
 register_pass("dce", DeadNodePass)
 register_pass("fold", ConstantFoldPass)
 register_pass("layout", LayoutPass)
 register_pass("cse", CSEPass)
 register_pass("fuse", ElemwiseFusionPass)
+register_pass("shard", ShardingPass)
 
 _local = threading.local()
 _cache_lock = threading.Lock()
@@ -90,8 +96,14 @@ _OPT_CACHE: "collections.OrderedDict[Tuple, Dict[str, Any]]" = \
 # ---------------------------------------------------------------------------
 
 def _default_names() -> List[str]:
-    return [n for n in pass_names()
-            if n != "layout" or layout_requested()]
+    out = []
+    for n in pass_names():
+        if n == "layout" and not layout_requested():
+            continue
+        if n == "shard" and not shard_requested():
+            continue
+        out.append(n)
+    return out
 
 
 def parse_spec(spec: Union[None, str, Sequence[str]]) -> Tuple[str, ...]:
@@ -137,7 +149,7 @@ def current_spec() -> Tuple[str, ...]:
     if ov is not None:
         return ov
     raw = getenv("MXTPU_PASSES") or "default"
-    memo_key = (raw, layout_requested())
+    memo_key = (raw, layout_requested(), shard_requested())
     spec = _SPEC_MEMO.get(memo_key)
     if spec is None:
         spec = parse_spec(raw)
@@ -207,8 +219,15 @@ def optimize_for_build(symbol: Symbol
     from .. import amp as _amp
 
     # fold bakes values under the ACTIVE compute-dtype policy, so the
-    # same graph bound under a different amp scope must re-optimize
+    # same graph bound under a different amp scope must re-optimize;
+    # likewise shard stamps the ACTIVE plan's specs, so a plan change
+    # (or deactivation) invalidates the memo
     spec = ",".join(names) + "|amp=%s" % _amp.get_compute_dtype()
+    if "shard" in names:
+        from ..sharding.plan import current_plan as _cur_plan
+
+        plan = _cur_plan()
+        spec += "|plan=%s" % (plan.describe() if plan is not None else "-")
     with _cache_lock:
         ent = _OPT_CACHE.get(key)
         if ent is not None and ent["spec"] == spec and _entry_alive(ent):

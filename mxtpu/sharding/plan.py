@@ -1,0 +1,289 @@
+"""ShardingPlan — the one partitioning decision the whole stack consumes.
+
+A plan names the mesh axes (data axis for replica sharding, model axis
+for tensor parallelism), carries per-parameter ``PartitionSpec``
+overrides, and decides — once — whether optimizer state is sharded
+across data-parallel replicas (ZeRO-1, arXiv 2004.13336: shard the
+Adam moments and the weight update over the replicas, allgather the
+updated params).  Trainer / Module / FusedTrainLoop / kvstore=tpu /
+``mxtpu.parallel`` all resolve their partitioning through the ACTIVE
+plan instead of hand-wiring collectives per call site; the ``shard``
+pass (`mxtpu/passes/sharding.py`) stamps the same decision onto the
+Symbol graph so provenance rides `mx.inspect` program records.
+
+Two execution modes share one plan object:
+
+  * **host-replica** (Module/Trainer over a context list): ``num_shards``
+    is the replica count; :meth:`shard_dim` / :meth:`shard_slice` drive
+    the eager ZeRO-1 updater (`mxtpu/sharding/zero1.py`).
+  * **SPMD** (a live `jax.sharding.Mesh`): :meth:`spec_for` /
+    :meth:`opt_state_spec` hand out ``PartitionSpec``s, and
+    :meth:`named_sharding` the `NamedSharding`s GSPMD consumes
+    (FusedTrainLoop's scanned carry, `parallel/transformer.py`).
+
+Env knobs (docs/env_vars.md):
+  ``MXTPU_SHARD``            ``zero1``/``1``: Trainer/Module auto-build a
+                             plan over their contexts when none is active
+  ``MXTPU_SHARD_OPT_STATE``  default ``1``: optimizer-state sharding on
+                             by default inside an active plan
+  ``MXTPU_SHARD_MIN_SIZE``   default ``4096``: min param elements worth a
+                             per-step collective (tiny LayerNorm vectors
+                             keep replicated state)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError, getenv
+
+__all__ = ["ShardingPlan", "current_plan", "plan_scope", "auto_plan",
+           "shard_requested", "default_min_shard_elems",
+           "opt_state_sharding_default"]
+
+_state = threading.local()
+
+
+def default_min_shard_elems() -> int:
+    """MXTPU_SHARD_MIN_SIZE — smallest parameter (in elements) whose
+    optimizer state is worth sharding (matches the transformer stack's
+    historical ``_ZERO1_MIN_ELEMS``)."""
+    v = getenv("MXTPU_SHARD_MIN_SIZE")
+    return int(v) if v else 4096
+
+
+def opt_state_sharding_default() -> bool:
+    """MXTPU_SHARD_OPT_STATE — ZeRO-1 state sharding default (ON)."""
+    return (getenv("MXTPU_SHARD_OPT_STATE") or "1").lower() \
+        not in ("0", "off", "false", "none")
+
+
+class ShardingPlan(object):
+    """One partitioning decision: axes, per-param specs, ZeRO-1 on/off.
+
+    Parameters
+    ----------
+    num_shards : int, optional
+        Data-parallel replica count for host-replica mode.  Defaults to
+        the mesh's ``data_axis`` size when a mesh is given, else 1; a
+        plan built with neither resolves when Trainer/Module engage it
+        (:meth:`resolved`).
+    mesh : jax.sharding.Mesh, optional
+        The SPMD device mesh (None = host-replica mode).
+    data_axis / model_axis : str
+        Mesh axis names for replica and tensor parallelism.
+    param_specs : dict name -> PartitionSpec, optional
+        Model-parallel placement overrides; params absent here are
+        replicated (their state sharding is pure ZeRO-1).
+    shard_optimizer_state : bool, optional
+        ZeRO-1 on/off; defaults to ``MXTPU_SHARD_OPT_STATE`` (on).
+    shard_data : bool
+        SPMD mode only: shard the batch dim of data inputs over
+        ``data_axis`` (off by default so replicated-data parity runs
+        stay bitwise).
+    data_names : sequence of str
+        Variable names treated as data/labels by :meth:`spec_for`.
+    min_shard_elems : int, optional
+        Per-param element floor below which state stays replicated.
+    """
+
+    def __init__(self, num_shards: Optional[int] = None, mesh=None,
+                 data_axis: str = "dp", model_axis: str = "tp",
+                 param_specs: Optional[Dict[str, Any]] = None,
+                 shard_optimizer_state: Optional[bool] = None,
+                 shard_data: bool = False,
+                 data_names: Sequence[str] = ("data", "softmax_label"),
+                 min_shard_elems: Optional[int] = None,
+                 name: Optional[str] = None):
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.model_axis = model_axis
+        self.param_specs = dict(param_specs or {})
+        self.shard_data = bool(shard_data)
+        self.data_names = tuple(data_names)
+        self.min_shard_elems = (default_min_shard_elems()
+                                if min_shard_elems is None
+                                else int(min_shard_elems))
+        self.shard_optimizer_state = (opt_state_sharding_default()
+                                      if shard_optimizer_state is None
+                                      else bool(shard_optimizer_state))
+        self.name = name
+        if num_shards is None and mesh is not None:
+            num_shards = int(mesh.shape.get(data_axis, 1))
+        self._num_shards = None if num_shards is None else int(num_shards)
+
+    # -- sizing ----------------------------------------------------------
+    @property
+    def num_shards(self) -> int:
+        """Replica count; 1 when still unresolved."""
+        return self._num_shards if self._num_shards is not None else 1
+
+    @property
+    def resolved_explicitly(self) -> bool:
+        return self._num_shards is not None
+
+    def resolved(self, num_shards: int) -> "ShardingPlan":
+        """This plan bound to a concrete replica count: returns self
+        when it already matches (or was never pinned — then a pinned
+        copy), raises on a conflicting pin."""
+        num_shards = int(num_shards)
+        if self._num_shards is None:
+            import copy
+
+            out = copy.copy(self)
+            out._num_shards = num_shards
+            return out
+        if self._num_shards != num_shards:
+            raise MXNetError(
+                "ShardingPlan pinned to %d shards cannot drive %d "
+                "replicas" % (self._num_shards, num_shards))
+        return self
+
+    # -- ZeRO-1 placement -------------------------------------------------
+    def shard_dim(self, name: str, shape: Sequence[int]) -> Optional[int]:
+        """The dimension to shard ``name``'s optimizer state over the
+        data axis: the first dim NOT claimed by the param's model spec
+        whose size divides ``num_shards``.  None = state stays
+        replicated (plan off for this param: too small, indivisible, or
+        ZeRO-1 disabled)."""
+        n = self.num_shards
+        if n <= 1 or not self.shard_optimizer_state:
+            return None
+        shape = tuple(int(s) for s in shape)
+        if int(np.prod(shape)) < self.min_shard_elems:
+            return None
+        spec = self.param_specs.get(name, ())
+        for i, size in enumerate(shape):
+            ax = spec[i] if i < len(spec) else None
+            if ax is None and size % n == 0:
+                return i
+        return None
+
+    def shard_slice(self, shape: Sequence[int], dim: int,
+                    rank: int) -> Tuple[slice, ...]:
+        """Index tuple selecting replica ``rank``'s 1/N chunk of a
+        buffer of ``shape`` along ``dim``."""
+        n = self.num_shards
+        if not 0 <= rank < n:
+            raise MXNetError("rank %d out of range for %d shards"
+                             % (rank, n))
+        size = int(shape[dim])
+        step = size // n
+        idx = [slice(None)] * len(shape)
+        idx[dim] = slice(rank * step, (rank + 1) * step)
+        return tuple(idx)
+
+    # -- SPMD specs -------------------------------------------------------
+    def spec_for(self, name: str, shape: Optional[Sequence[int]] = None):
+        """PartitionSpec for variable ``name``: the model-parallel
+        override when one exists, batch-sharded over the data axis for
+        data/label inputs (only with ``shard_data``), replicated
+        otherwise."""
+        from jax.sharding import PartitionSpec as P
+
+        if name in self.param_specs:
+            return self.param_specs[name]
+        if name in self.data_names and self.shard_data \
+                and self.num_shards > 1:
+            return P(self.data_axis)
+        return P()
+
+    def opt_state_spec(self, name: str, shape: Sequence[int]):
+        """PartitionSpec for ``name``'s optimizer state: the param spec
+        with the data axis added on :meth:`shard_dim` — the ZeRO-1
+        placement (arXiv 2004.13336)."""
+        from jax.sharding import PartitionSpec as P
+
+        base = self.param_specs.get(name, ())
+        spec = list(base) + [None] * (len(shape) - len(base))
+        dim = self.shard_dim(name, shape)
+        if dim is not None:
+            spec[dim] = self.data_axis
+        return P(*spec)
+
+    def named_sharding(self, spec):
+        """NamedSharding over this plan's mesh (SPMD mode only)."""
+        if self.mesh is None:
+            raise MXNetError("plan has no mesh: named_sharding is for "
+                             "SPMD plans")
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, spec)
+
+    # -- identity / provenance -------------------------------------------
+    def describe(self) -> str:
+        """Compact provenance string for pass reports, inspect records
+        and telemetry compile events."""
+        mode = "zero1" if self.shard_optimizer_state else "repl"
+        parts = ["%s:n=%d" % (mode, self.num_shards),
+                 "axis=%s" % self.data_axis]
+        if self.mesh is not None:
+            parts.append("mesh=%s" % "x".join(
+                str(s) for s in self.mesh.devices.shape))
+        if self.shard_data:
+            parts.append("data")
+        if self.param_specs:
+            parts.append("mp=%d" % len(self.param_specs))
+        if self.name:
+            parts.insert(0, self.name)
+        return ",".join(parts)
+
+    def __repr__(self):
+        return "<ShardingPlan %s>" % self.describe()
+
+    # -- activation -------------------------------------------------------
+    def activate(self):
+        """``with plan.activate():`` — make this the current plan for
+        the block (same stack discipline as `MeshContext`)."""
+        return plan_scope(self)
+
+
+class plan_scope(object):
+    """``with plan_scope(plan):`` — push ``plan`` onto the thread's
+    current-plan stack.  ``plan_scope(None)`` masks any outer plan."""
+
+    def __init__(self, plan: Optional[ShardingPlan]):
+        self._plan = plan
+
+    def __enter__(self):
+        if not hasattr(_state, "stack"):
+            _state.stack = []
+        _state.stack.append(self._plan)
+        return self._plan
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+        return False
+
+
+def current_plan() -> Optional[ShardingPlan]:
+    """Innermost active plan (None when no scope is live).  When no
+    scope was ever entered, ``MXTPU_SHARD=zero1|1`` yields a process
+    default plan (unpinned; Trainer/Module resolve the replica count)."""
+    stack = getattr(_state, "stack", None)
+    if stack:
+        return stack[-1]
+    if (getenv("MXTPU_SHARD") or "").lower() in ("1", "zero1", "on"):
+        global _ENV_PLAN
+        if _ENV_PLAN is None:
+            _ENV_PLAN = ShardingPlan(name="env")
+        return _ENV_PLAN
+    return None
+
+
+_ENV_PLAN: Optional[ShardingPlan] = None
+
+
+def shard_requested() -> bool:
+    """True when an active plan could shard anything — how the pass
+    manager decides whether ``shard`` joins the default pass set."""
+    return current_plan() is not None
+
+
+def auto_plan(num_shards: Optional[int] = None, mesh=None,
+              **kwargs) -> ShardingPlan:
+    """Convenience: a ZeRO-1 plan over ``num_shards`` replicas (or a
+    mesh's data axis)."""
+    return ShardingPlan(num_shards=num_shards, mesh=mesh, **kwargs)

@@ -119,7 +119,8 @@ __all__ = [
 #: chrome-trace counter tracks by :func:`merge_dir`.)
 EVENT_KINDS = ("step", "compile", "kvstore", "kvstore_round", "retry",
                "failover", "membership", "checkpoint", "monitor",
-               "timeout", "flight", "anomaly", "tensor_stats", "serve")
+               "timeout", "flight", "anomaly", "tensor_stats", "serve",
+               "reshard")
 
 #: ``profiler.stats()`` keys that are point-in-time gauges, not
 #: additive counters: cluster aggregation takes their MAX, and counter
@@ -1128,6 +1129,13 @@ def merge_dir(directory: str, out_trace: str = "merged_trace.json",
         "per_rank_compile_s": per_rank_compile,
         "compile_total": aggregate.get("inspect_compiles", 0),
         "recompile_total": aggregate.get("inspect_recompiles", 0),
+        # sharding rollup (mx.shard): cluster-wide per-collective
+        # payload totals from the ZeRO-1 engine, the eager collectives
+        # and reshard moves (docs/sharding.md byte conventions)
+        "sharding": {k: aggregate.get(k, 0)
+                     for k in ("allgather_bytes", "reduce_scatter_bytes",
+                               "allreduce_bytes", "alltoall_bytes",
+                               "ppermute_bytes", "reshard_bytes")},
         # training-health rollup (mx.health): per-rank anomaly counts
         # and the first non-finite blame, next to the compile/step rows
         "health": health_rollup(snaps),

@@ -392,6 +392,98 @@ def test_pass_timings_in_profiler_stats():
         assert "pass_wall_us::%s" % name in stats
 
 
+def test_shard_pass_joins_default_only_with_plan_and_orders_last():
+    """`shard` composes with dce/fold/cse/fuse in canonical order (it
+    registers LAST) and joins the default set only under an active
+    ShardingPlan — mirroring layout's opt-in discipline."""
+    assert P.parse_spec("shard,dce") == ("dce", "shard")
+    assert P.parse_spec("fuse,shard,fold") == ("fold", "fuse", "shard")
+    assert "shard" not in P.parse_spec("default")
+    with mx.shard.ShardingPlan(num_shards=4).activate():
+        spec = P.parse_spec("default")
+        assert spec[-1] == "shard"
+        net = _probe_net()
+        _, rep = net.optimize(return_report=True)
+        assert [p["pass"] for p in rep["passes"]] == \
+            ["dce", "fold", "cse", "fuse", "shard"]
+
+
+def test_shard_pass_noop_on_single_shard_bitwise():
+    """On a 1-shard plan the pass must be a STRICT no-op: zero
+    annotations, zero node delta, bitwise-identical execution."""
+    net = _probe_net()
+    with mx.shard.ShardingPlan(num_shards=1).activate():
+        opt, rep = net.optimize(passes="shard", return_report=True)
+        st = rep["passes"][0]
+        assert st["annotated"] == 0 and st["plan"] is None
+        assert st["nodes_before"] == st["nodes_after"]
+        assert not any("__shard_spec__" in n.ext_attrs
+                       for n in _nodes(opt))
+        res = {}
+        for spec in ("off", "default"):
+            with P.scope(spec):
+                ex = net.simple_bind(mx.cpu(), data=(8, 16),
+                                     grad_req="write")
+            _fill_args(ex)
+            x = mx.nd.array(np.random.RandomState(0).rand(8, 16)
+                            .astype("float32"))
+            mx.random.seed(42)
+            res[spec] = ex.forward(is_train=True, data=x)[0].asnumpy()
+        np.testing.assert_array_equal(res["off"], res["default"])
+
+
+def test_shard_pass_annotates_variables_only():
+    with mx.shard.ShardingPlan(num_shards=4,
+                               min_shard_elems=16).activate():
+        w = sym.Variable("w", shape=(64, 32))
+        out = sym.FullyConnected(data=sym.Variable("data"), weight=w,
+                                 no_bias=True, num_hidden=32)
+        opt, rep = out.optimize(passes="shard", return_report=True)
+        st = rep["passes"][0]
+        assert st["annotated"] == 2 and st["state_sharded"] == 1
+        assert "zero1:n=4" in st["plan"]
+        for n in _nodes(opt):
+            if n.is_variable:
+                assert "__shard_spec__" in n.ext_attrs
+                if n.name == "w":
+                    assert n.ext_attrs["__shard_state_dim__"] == "0"
+            else:
+                assert "__shard_spec__" not in n.ext_attrs
+        # the ORIGINAL graph is untouched (passes clone)
+        assert not any("__shard_spec__" in n.ext_attrs
+                       for n in _nodes(out))
+
+
+def test_shard_pass_never_touches_rng_ids():
+    """Annotation under a live multi-shard plan must leave the stable
+    `__rng_id__` untouched and the stochastic output bitwise identical
+    passes-on vs passes-off."""
+    x = sym.Variable("data")
+    h = sym.Dropout(sym.identity(x) * 1.0, p=0.5, name="do1")
+    out = sym.Dropout(h + (x * 0.0), p=0.5, name="do2")
+    P.ensure_rng_ids(out)
+    ids_before = [n.ext_attrs["__rng_id__"] for n in _nodes(out)
+                  if not n.is_variable and n.op.needs_rng]
+    with mx.shard.ShardingPlan(num_shards=4).activate():
+        res = {}
+        for spec in ("off", "default"):
+            with P.scope(spec):
+                ex = out.simple_bind(mx.cpu(), data=(16, 8),
+                                     grad_req="null")
+            mx.random.seed(9)
+            x_in = mx.nd.array(np.ones((16, 8), "float32"))
+            res[spec] = ex.forward(is_train=True,
+                                   data=x_in)[0].asnumpy()
+        opt = out.optimize(passes="default")
+        ids_after = [n.ext_attrs["__rng_id__"] for n in _nodes(out)
+                     if not n.is_variable and n.op.needs_rng]
+        opt_ids = [n.ext_attrs["__rng_id__"] for n in _nodes(opt)
+                   if not n.is_variable and n.op.needs_rng]
+    np.testing.assert_array_equal(res["off"], res["default"])
+    assert ids_after == ids_before
+    assert set(opt_ids) <= set(ids_before)
+
+
 def test_stablehlo_histogram_parses_lowered_text():
     txt = """\
 module @jit_f {

@@ -94,6 +94,19 @@ def test_check_passes_guard():
     assert "check_passes OK" in out
 
 
+def test_check_sharding_guard():
+    """tools/check_sharding.py: ZeRO-1 sharded training on a 4-replica
+    CPU mesh must match replicated training's 50-step loss trajectory
+    within 1e-6 (bitwise expected), measure ~1/N per-replica optimizer
+    state bytes, carry the plan as `mx.passes` shard-pass provenance on
+    the inspect record + telemetry compile events, tick the
+    allgather/reduce_scatter byte counters, and the FusedTrainLoop
+    sharded scanned carry must match the plain loop (see
+    mxtpu/sharding/, docs/sharding.md)."""
+    out = _run(["tools/check_sharding.py", "--fused"], timeout=420)
+    assert "check_sharding OK" in out
+
+
 def test_check_health_guard():
     """tools/check_health.py: a NaN injected at a named mid-model
     layer must be blamed to that layer in health.report(), the
