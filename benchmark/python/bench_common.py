@@ -1,0 +1,123 @@
+"""Shared structured-result runner for the `bench_*.py` seeds.
+
+Every benchmark in this directory emits its headline result through
+:func:`emit_result`, so all seeds — and `tools/check_perf.py`'s
+ratchet micro-benches, and the future `mx.tune` trial harness — speak
+ONE row schema::
+
+    {"schema": "mxtpu-bench-v1",
+     "bench": "serving",                  # which seed produced it
+     "metric": "...", "value": <float>, "unit": "...",
+     "vs_baseline": <float|null>,        # legacy driver contract keys
+     "throughput": <rows-or-steps/s|null>,
+     "step_time_us": <float|null>,
+     "mfu": <float|null>,                # from metrics()["perf"], when
+     "phases": {...}|null,               # the run had an observatory
+     "knobs": {"MXTPU_*": ..., "JAX_PLATFORMS": ...},
+     "extra": {...}}                     # free-form per-bench detail
+
+The row is printed as the LAST stdout line (the driver takes the final
+JSON line — strict superset of the old ``{metric,value,unit,
+vs_baseline,extra}`` contract, so existing consumers keep parsing) and
+appended to ``$MXTPU_BENCH_OUT`` as JSONL when set, which is how the
+perf-regression ratchet (`tools/check_perf.py`,
+``benchmark/baselines/<backend>.json``) and any sweep driver collect
+rows without scraping human output.
+
+Seeds should call :func:`emit_result` exactly once, at the end, after
+their measurement loops — the MFU/phase columns are read from the
+LIVE `mx.perf` observatory at emit time.
+"""
+import json
+import os
+from typing import Any, Dict, Optional
+
+SCHEMA = "mxtpu-bench-v1"
+
+#: env keys that parameterize performance — recorded on every row so a
+#: regression can be traced to a knob flip, and so `mx.tune` trials
+#: are reproducible from their rows alone
+_KNOB_PREFIXES = ("MXTPU_",)
+_KNOB_EXTRA = ("JAX_PLATFORMS", "XLA_FLAGS")
+
+
+def knobs() -> Dict[str, str]:
+    """The performance-relevant environment at emit time."""
+    out = {}
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(_KNOB_PREFIXES) or k in _KNOB_EXTRA:
+            out[k] = v
+    return out
+
+
+def perf_summary() -> Optional[Dict[str, Any]]:
+    """The live `mx.perf` view (mfu / dominant phase / per-step phase
+    averages), or None when the framework was never imported or the
+    observatory is off."""
+    try:
+        import sys
+
+        mx = sys.modules.get("mxtpu")
+        if mx is None:
+            return None
+        blk = mx.telemetry.metrics().get("perf")
+        if not blk or not blk.get("enabled"):
+            return None
+        return {"mfu": blk.get("mfu"),
+                "dominant_phase": blk.get("dominant_phase"),
+                "phases_us_per_step": blk.get("phases_us_per_step")}
+    except Exception:
+        return None
+
+
+def row(bench: str, metric: str, value: float, unit: str,
+        vs_baseline: Optional[float] = None,
+        throughput: Optional[float] = None,
+        step_time_us: Optional[float] = None,
+        mfu: Optional[float] = None,
+        phases: Optional[Dict[str, Any]] = None,
+        extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Build one structured result row (see module doc for schema).
+    ``mfu``/``phases`` default to the live `mx.perf` observatory."""
+    p = perf_summary()
+    if p is not None:
+        if mfu is None:
+            mfu = p.get("mfu")
+        if phases is None:
+            phases = p.get("phases_us_per_step")
+    return {
+        "schema": SCHEMA,
+        "bench": bench,
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": vs_baseline if vs_baseline is not None else value,
+        "throughput": throughput,
+        "step_time_us": step_time_us,
+        "mfu": mfu,
+        "phases": phases,
+        "knobs": knobs(),
+        "extra": extra or {},
+    }
+
+
+def emit(r: Dict[str, Any]) -> Dict[str, Any]:
+    """Print ``r`` as the result line and append it to
+    ``$MXTPU_BENCH_OUT`` (JSONL) when set.  Returns ``r``."""
+    line = json.dumps(r, default=str)
+    print(line)
+    path = os.environ.get("MXTPU_BENCH_OUT")
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass  # a broken sink must not fail the bench
+    return r
+
+
+def emit_result(bench: str, metric: str, value: float, unit: str,
+                **kwargs) -> Dict[str, Any]:
+    """:func:`row` + :func:`emit` in one call — the one-liner every
+    ``bench_*.py`` seed ends with."""
+    return emit(row(bench, metric, value, unit, **kwargs))

@@ -14,10 +14,10 @@ model-zoo net:
     program per distinct size) vs ON (<= log2 bucket programs), reporting
     wall time and program counts for each.
 
-Emits ONE JSON line (driver contract):
-  {"metric": "compile_cache_warm_bind_speedup", "value": <x>,
-   "unit": "x", "vs_baseline": <x>, "extra": {...}}
-("baseline" is the cold start, so vs_baseline == value.)
+Emits ONE structured row via `bench_common.emit_result` (the shared
+runner schema every seed and the `tools/check_perf.py` ratchet read);
+metric "compile_cache_warm_bind_speedup", "baseline" is the cold
+start, so vs_baseline == value.
 
 Env knobs: MXTPU_BENCH_CC_NET (default resnet18_v1),
 MXTPU_BENCH_CC_BATCH (default 4), MXTPU_BENCH_CC_HW (input H=W,
@@ -129,13 +129,13 @@ def main():
         extra["ragged"] = bench_ragged()
     except Exception as e:  # ragged sweep must not sink the record
         extra["ragged_error"] = str(e)[:300]
-    print(json.dumps({
-        "metric": "compile_cache_warm_bind_speedup",
-        "value": round(speedup, 2),
-        "unit": "x",
-        "vs_baseline": round(speedup, 2),
-        "extra": extra,
-    }))
+    import bench_common
+
+    bench_common.emit_result(
+        "compile_cache", "compile_cache_warm_bind_speedup",
+        round(speedup, 2), "x",
+        step_time_us=round(warm["warmup_s"] * 1e6, 1),
+        extra=extra)
 
 
 if __name__ == "__main__":

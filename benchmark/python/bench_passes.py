@@ -30,7 +30,6 @@ Env knobs: MXTPU_BENCH_PASSES_NET (resnet18_v1), MXTPU_BENCH_PASSES_HW
 (32), MXTPU_BENCH_PASSES_BATCH (2), MXTPU_BENCH_PASSES_LAYERS (2,
 transformer depth), MXTPU_BENCH_PASSES_DMODEL (64).
 """
-import json
 import os
 import sys
 import time
@@ -152,18 +151,16 @@ def main():
             bench_model("transformer", _transformer_symbol)]
     speedups = [r["bind_speedup"] for r in rows if r["bind_speedup"]]
     value = round(sum(speedups) / len(speedups), 3) if speedups else 0.0
-    print(json.dumps({
-        "metric": "passes_bind_speedup",
-        "value": value,
-        "unit": "x",
-        "vs_baseline": value,
-        "extra": {"models": rows,
-                  "net": NET, "hw": HW, "batch": BATCH,
-                  "node_reduction": {
-                      r["model"]: "%d->%d" % (r["nodes_before"],
-                                              r["nodes_after"])
-                      for r in rows}},
-    }))
+    import bench_common
+
+    bench_common.emit_result(
+        "passes", "passes_bind_speedup", value, "x",
+        extra={"models": rows,
+               "net": NET, "hw": HW, "batch": BATCH,
+               "node_reduction": {
+                   r["model"]: "%d->%d" % (r["nodes_before"],
+                                           r["nodes_after"])
+                   for r in rows}})
 
 
 if __name__ == "__main__":

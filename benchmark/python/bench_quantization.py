@@ -36,6 +36,7 @@ def bench_fc(batch, in_dim, out_dim, iters):
             qx[0], qw[0], qb, qx[1], qx[2], qw[1], qw[2],
             qw[1], qw[2], num_hidden=out_dim)
 
+    rates = {}
     for fn, name in ((run_fp32, "fp32"), (run_int8, "int8")):
         fn()[0].wait_to_read()
         tic = time.perf_counter()
@@ -43,16 +44,33 @@ def bench_fc(batch, in_dim, out_dim, iters):
             out = fn()
         out[0].wait_to_read()
         rate = iters / (time.perf_counter() - tic)
+        rates[name] = rate
         print("FC %dx%d->%d  %s: %9.1f it/s"
-              % (batch, in_dim, out_dim, name, rate))
+              % (batch, in_dim, out_dim, name, rate), file=sys.stderr)
+    return rates
 
 
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--iters", type=int, default=30)
     args = p.parse_args()
-    bench_fc(64, 1024, 1024, args.iters)
-    bench_fc(32, 4096, 4096, max(args.iters // 3, 5))
+    rows = {
+        "fc_64x1024": bench_fc(64, 1024, 1024, args.iters),
+        "fc_32x4096": bench_fc(32, 4096, 4096, max(args.iters // 3, 5)),
+    }
+    # structured row (shared runner schema): int8-vs-fp32 speedup on
+    # the large FC — the config quantized serving actually runs
+    import bench_common
+
+    big = rows["fc_32x4096"]
+    bench_common.emit_result(
+        "quantization", "quantized_fc_int8_speedup",
+        round(big["int8"] / big["fp32"], 3) if big.get("fp32") else 0.0,
+        "x",
+        throughput=big.get("int8"),
+        step_time_us=(1e6 / big["int8"]) if big.get("int8") else None,
+        extra={k: {n: round(v, 1) for n, v in r.items()}
+               for k, r in rows.items()})
 
 
 if __name__ == "__main__":

@@ -195,6 +195,17 @@ def main():
                        "stages": stages,
                        "sustained": sustained}, f, indent=2)
         print("bench_serving: wrote %s" % args.json)
+    import bench_common
+
+    bench_common.emit_result(
+        "serving", "serving_sustained_rows_per_s_at_p99",
+        round(sustained["rows_per_s"], 1) if sustained else 0.0,
+        "rows/s",
+        throughput=sustained["rows_per_s"] if sustained else 0.0,
+        step_time_us=(sustained["p99_ms"] * 1e3) if sustained else None,
+        extra={"p99_budget_ms": args.p99_budget_ms,
+               "sustained": sustained, "stages": stages,
+               "target": target})
     return 0 if sustained else 1
 
 

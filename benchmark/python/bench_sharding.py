@@ -21,7 +21,6 @@ Emits ONE JSON line (driver contract):
 Env knobs: MXTPU_BENCH_SHARD_REPLICAS ("1,2,4"), MXTPU_BENCH_SHARD_STEPS
 (30), MXTPU_BENCH_SHARD_HIDDEN (256), MXTPU_BENCH_SHARD_BATCH (64).
 """
-import json
 import os
 import sys
 import time
@@ -137,11 +136,14 @@ def main():
               "per replica (%.3f of full)"
               % (n, t_rep * 1e3, t_sh * 1e3, per_rep / 1024.0,
                  per_sh / 1024.0, frac), file=sys.stderr)
-    print(json.dumps({
-        "metric": "zero1_state_fraction", "value": round(frac, 4),
-        "unit": "x", "vs_baseline": round(ratio, 3),
-        "extra": rows,
-    }))
+    import bench_common
+
+    last = list(rows.values())[-1] if rows else {}
+    bench_common.emit_result(
+        "sharding", "zero1_state_fraction", round(frac, 4), "x",
+        vs_baseline=round(ratio, 3),
+        step_time_us=last.get("sharded_ms_step", 0) * 1e3 or None,
+        extra=rows)
     return 0
 
 

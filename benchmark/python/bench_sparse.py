@@ -53,11 +53,30 @@ def main():
     p.add_argument("--batch", type=int, default=128)
     p.add_argument("--iters", type=int, default=20)
     args = p.parse_args()
+    rows = {}
     for vocab in (int(v) for v in args.vocabs.split(",")):
         d = bench(vocab, args.dim, args.batch, args.iters, False)
         s = bench(vocab, args.dim, args.batch, args.iters, True)
+        rows["vocab%d" % vocab] = {
+            "dense_steps_per_s": round(d, 1),
+            "row_sparse_steps_per_s": round(s, 1),
+            "speedup": round(s / d, 3)}
         print("vocab=%-8d dense %8.1f steps/s   row_sparse %8.1f "
-              "steps/s   speedup %.2fx" % (vocab, d, s, s / d))
+              "steps/s   speedup %.2fx" % (vocab, d, s, s / d),
+              file=sys.stderr)
+    # structured row (shared runner schema): the headline is the
+    # speedup at the LARGEST vocab — where sparse exists to win
+    import bench_common
+
+    last = list(rows.values())[-1] if rows else {}
+    bench_common.emit_result(
+        "sparse", "row_sparse_embedding_speedup",
+        last.get("speedup", 0.0), "x",
+        throughput=last.get("row_sparse_steps_per_s"),
+        step_time_us=(1e6 / last["row_sparse_steps_per_s"])
+        if last.get("row_sparse_steps_per_s") else None,
+        extra={"dim": args.dim, "batch": args.batch,
+               "iters": args.iters, "rows": rows})
 
 
 if __name__ == "__main__":

@@ -108,6 +108,7 @@ from . import profiler
 from . import telemetry
 from . import inspect
 from . import health
+from . import perf
 from . import resilience
 from . import monitor
 from . import visualization

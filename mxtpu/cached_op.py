@@ -25,6 +25,7 @@ from .base import MXNetError
 from . import autograd as _ag
 from . import compile_cache as _cc
 from . import health as _health
+from . import perf as _perf
 from .context import current_context
 from .executor import _build_graph_fn
 from .ndarray.ndarray import NDArray
@@ -180,7 +181,9 @@ class CachedOp(object):
                     return self._jit_infer(key, *xs)
 
             all_nd = list(args) + list(aux_arrays)
+            pt0 = _perf.begin()
             outs, node = _ag._record_fn("_CachedOp", tupled, all_nd, flat)
+            _perf.end(self._insp.name, "cachedop", pt0, outputs=outs)
             if tok is not None:
                 # the recording path runs under jax.vjp, so the train
                 # program XLA builds spans forward AND backward — hand
@@ -194,9 +197,11 @@ class CachedOp(object):
             if training:
                 tok = self._track_sig("train", flat)
                 jit_train = self._jit_train_donated or self._jit_train
+                pt0 = _perf.begin()
                 outs = jit_train(key, *flat)
                 if tok is not None:
                     tok.done(jit_train, (key,) + tuple(flat))
+                _perf.end(self._insp.name, "cachedop", pt0, outputs=outs)
             else:
                 outs = self._infer_dispatch(key, flat)
             node = None
@@ -308,11 +313,16 @@ class CachedOp(object):
         if compiled is not None:
             _prof.inc_stat("cachedop_aot_hit")
             self._insp.hit()
-            return compiled(key, *flat)
+            pt0 = _perf.begin()
+            outs = compiled(key, *flat)
+            _perf.end(self._insp.name, "cachedop", pt0, outputs=outs)
+            return outs
         tok = self._track_sig("infer", sig)
+        pt0 = _perf.begin()
         outs = self._jit_infer(key, *flat)
         if tok is not None:
             tok.done(self._jit_infer, (key,) + tuple(flat))
+        _perf.end(self._insp.name, "cachedop", pt0, outputs=outs)
         return outs
 
     def _pad_mask(self, flat, b: int, bp: int):
@@ -453,8 +463,10 @@ class CachedOp(object):
             names=[self._arg_names[i] for i in stacked] +
                   [self._arg_names[i] for i in fixed] + self._aux_names)
         key = self._key()
+        pt0 = _perf.begin()
         outs = jit_program(key, stack_vals, fixed_vals, aux_vals)
         if tok is not None:
             tok.done(jit_program, (key, stack_vals, fixed_vals, aux_vals))
+        _perf.end(self._insp.name, "cachedop", pt0, outputs=outs, n=K)
         ctx = args[stacked[0]].ctx
         return [NDArray(o, ctx=ctx, _committed=True) for o in outs]

@@ -37,6 +37,7 @@ from .context import Context, current_context
 from .ndarray.ndarray import NDArray
 from .symbol.symbol import Symbol, _topo_order
 from . import health as _health
+from . import perf as _perf
 
 __all__ = ["Executor"]
 
@@ -499,11 +500,13 @@ class Executor(object):
             # backward applies the cached pullback (no fwd recompute)
             tok = self._track_sig("train", self._arg_vals())
             self._last_fwd_state = (self._arg_vals(), saved_aux, key)
+            pt0 = _perf.begin()
             outs, aux_new, vjp = self._jit_fwd_vjp(
                 self._arg_vals(), self._aux_vals(), key)
             if tok is not None:
                 tok.done(self._jit_fwd_vjp,
                          (self._arg_vals(), self._aux_vals(), key))
+            _perf.end(self._insp.name, "executor", pt0, outputs=outs)
             self._cached_vjp = (vjp, aux_new)
             self._cached_grads = None
             self._write_aux(aux_new)
@@ -522,6 +525,7 @@ class Executor(object):
             # the vjp for THIS step without semantic drift (jax arrays
             # are immutable; holding the refs is free)
             self._last_fwd_state = (self._arg_vals(), saved_aux, key)
+            pt0 = _perf.begin()
             if self._aot_step is not None:
                 _prof.inc_stat("executor_aot_hit")
                 self._insp.hit()
@@ -535,28 +539,39 @@ class Executor(object):
                     tok.done(self._jit_step,
                              (self._arg_vals(), self._aux_vals(), key,
                               ograds))
+            # block target = outputs AND grads: the fused step's device
+            # span must cover the backward half too
+            _perf.end(self._insp.name, "executor", pt0,
+                      outputs=(outs, grads))
             self._cached_grads = grads
             self._write_aux(aux_new)
         elif is_train:
             tok = self._track_sig("train", self._arg_vals())
+            pt0 = _perf.begin()
             outs, aux_new = self._jit_fwd_train(
                 self._arg_vals(), self._aux_vals(), key)
             if tok is not None:
                 tok.done(self._jit_fwd_train,
                          (self._arg_vals(), self._aux_vals(), key))
+            _perf.end(self._insp.name, "executor", pt0, outputs=outs)
             self._write_aux(aux_new)
         elif ragged:
             outs = self._forward_bucketed(ragged, key)
-        elif self._aot_infer is not None:
-            _prof.inc_stat("executor_aot_hit")
-            self._insp.hit()
-            outs = self._aot_infer(self._arg_vals(), self._aux_vals(), key)
         else:
-            tok = self._track_sig("infer", self._arg_vals())
-            outs = self._jit_fwd_infer(self._arg_vals(), self._aux_vals(), key)
-            if tok is not None:
-                tok.done(self._jit_fwd_infer,
-                         (self._arg_vals(), self._aux_vals(), key))
+            pt0 = _perf.begin()
+            if self._aot_infer is not None:
+                _prof.inc_stat("executor_aot_hit")
+                self._insp.hit()
+                outs = self._aot_infer(self._arg_vals(), self._aux_vals(),
+                                       key)
+            else:
+                tok = self._track_sig("infer", self._arg_vals())
+                outs = self._jit_fwd_infer(self._arg_vals(),
+                                           self._aux_vals(), key)
+                if tok is not None:
+                    tok.done(self._jit_fwd_infer,
+                             (self._arg_vals(), self._aux_vals(), key))
+            _perf.end(self._insp.name, "executor", pt0, outputs=outs)
         self.outputs = [NDArray(o, ctx=self._ctx, _committed=True)
                         for o in outs]
         return self.outputs
@@ -594,10 +609,12 @@ class Executor(object):
             if bp != b:
                 _prof.inc_stat("executor_bucket_fallback")
         tok = self._track_sig("infer", call_vals)
+        pt0 = _perf.begin()
         outs = self._jit_fwd_infer(call_vals, self._aux_vals(), key)
         if tok is not None:
             tok.done(self._jit_fwd_infer,
                      (call_vals, self._aux_vals(), key))
+        _perf.end(self._insp.name, "executor", pt0, outputs=outs)
         if mask is not None:
             outs = [o[:b] if m else o for o, m in zip(outs, mask)]
         return outs

@@ -43,6 +43,7 @@ from .base import MXNetError
 from .executor import _build_graph_fn
 from .ndarray.ndarray import NDArray
 from . import health as _health
+from . import perf as _perf
 from . import resilience as _res
 
 __all__ = ["FusedTrainLoop"]
@@ -450,10 +451,14 @@ class FusedTrainLoop(object):
             arg_names=[self._arg_names[i] for i in self._data_idx])
         prog_args = self._program_args(data_stack, base_key)
         t0 = _time.monotonic()
+        pt0 = _perf.begin()
         with _OOM_RUN:
             p, s, aux, outs = self._jit_program(*prog_args)
         if tok is not None:
             tok.done(self._jit_program, prog_args)
+        # block target = the new params: produced LAST in the scanned
+        # program, so call->ready spans the full K-step chunk
+        _perf.end(self._insp.name, "fused_train", pt0, outputs=p, n=K)
         bad_flags = gnorms = lnorms = prev_health = None
         if self._track_health:
             bad_dev, gn_dev = outs["bad"], outs["gnorm"]

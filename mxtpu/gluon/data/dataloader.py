@@ -183,12 +183,14 @@ class DataLoader(object):
 
         it = self._iter_impl()
         while True:
-            t0 = time.perf_counter()
+            # nesting-guarded scope: when this fetch itself drives an
+            # inner DataIter (dataset backed by one), only THIS
+            # outermost layer records — no double count
             try:
-                batch = next(it)
+                with _tel.input_wait():
+                    batch = next(it)
             except StopIteration:
                 return
-            _tel.record_input_wait(time.perf_counter() - t0)
             yield batch
 
     def _iter_impl(self):

@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional
 from ..base import MXNetError
 from .. import health as _health
 from .. import optimizer as opt_mod
+from .. import perf as _perf
 from .. import resilience as _res
 from .. import telemetry as _tel
 from ..ndarray.ndarray import NDArray
@@ -190,13 +191,22 @@ class Trainer(object):
             # guard off: deferred no-stall grad monitoring on the
             # MXTPU_HEALTH_CHECK_EVERY cadence
             _health.monitor_grads("trainer", self._grad_vals)
+        # perf phase attribution (mx.perf): the two host-side segments
+        # of a trainer step outside the compiled forward/backward —
+        # gradient allreduce (collective) and the parameter update
+        # (optimizer).  begin() is None when MXTPU_PERF=0.
+        pt0 = _perf.begin()
         self._allreduce_grads()
+        if self._kvstore is not None:
+            _perf.note_phase_since("collective", pt0)
         # opt-in per-layer grad/param-norm streaming (before the update
         # so |Δw|/|w| pairs this step's grads with its pre-step params)
         _health.maybe_stream_stats(
             self._stats_triple, site="trainer",
             scale=abs(self.learning_rate * self._optimizer.rescale_grad))
+        pt0 = _perf.begin()
         self._update(ignore_stale_grad)
+        _perf.note_phase_since("optimizer", pt0)
         _tel.record_step(batch_size=batch_size, site="trainer")
 
     def _grad_vals(self):

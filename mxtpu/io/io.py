@@ -93,17 +93,16 @@ class DataIter(object):
         raise StopIteration
 
     def __next__(self):
-        # input-wait gauge: nested iterators call `.next()` directly,
-        # so only the OUTERMOST (protocol-driven) hop records — no
-        # double counting (see telemetry.record_input_wait)
-        import time as _time
-
+        # input-wait gauge, nesting-guarded (telemetry.input_wait):
+        # nested iterators usually call `.next()` directly, but a
+        # wrapper that drives this protocol hop (a DataLoader over a
+        # DataIter-backed dataset, a PrefetchingIter) must not make
+        # both layers stamp the same wall-clock wait — the guard
+        # records only at the outermost level
         from .. import telemetry as _tel
 
-        t0 = _time.perf_counter()
-        batch = self.next()
-        _tel.record_input_wait(_time.perf_counter() - t0)
-        return batch
+        with _tel.input_wait():
+            return self.next()
 
     def iter_next(self):
         return False

@@ -21,6 +21,7 @@ from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
                      load_latest as _load_latest_checkpoint,
                      save_checkpoint)
 from .. import health as _health
+from .. import perf as _perf
 from .. import resilience as _res
 from ..ndarray.ndarray import NDArray, zeros
 from .. import optimizer as opt_mod
@@ -487,6 +488,11 @@ class Module(BaseModule):
         _health.maybe_stream_stats(self._stats_triple, site="module",
                                    scale=self._update_scale())
         self._params_dirty = True
+        # perf phase attribution (mx.perf): the whole host-side update
+        # segment — kvstore aggregation included — is the `optimizer`
+        # phase of a Module step (the compiled fwd+bwd was accounted by
+        # the Executor dispatch hook)
+        pt0 = _perf.begin()
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
                                       self._exec_group.grad_arrays,
@@ -499,6 +505,7 @@ class Module(BaseModule):
                            num_device=len(self._context),
                            kvstore=self._kvstore,
                            param_names=self._exec_group.param_names)
+        _perf.note_phase_since("optimizer", pt0)
         _tel.record_step(batch_size=self._exec_group.batch_size,
                          site="module")
 

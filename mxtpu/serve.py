@@ -66,6 +66,7 @@ import numpy as np
 from .base import (MemoryExhaustedError, MXNetError, RequestShedError,
                    getenv, getenv_int)
 from . import compile_cache as _cc
+from . import perf as _perf
 
 __all__ = [
     "Server",
@@ -537,6 +538,11 @@ class Server(object):
         with entry.lock:
             entry.inflight_rows = rows
         _prof.set_stat("serve_inflight", self._inflight_rows())
+        # phase attribution for the batcher: predict() is synchronous
+        # (numpy out), so host_dispatch here IS the full dispatch wall;
+        # the per-program device split comes from the CachedOp hook
+        # underneath
+        pt0 = _perf.begin()
         try:
             out = _res.guarded("serve", entry.predict, xs)
         except (MemoryExhaustedError, MemoryError) as e:
@@ -553,6 +559,7 @@ class Server(object):
             with entry.lock:
                 entry.inflight_rows = 0
             _prof.set_stat("serve_inflight", self._inflight_rows())
+        _perf.end("serve:%s" % entry.name, "serve", pt0)
         self._fulfill(entry, batch, rows, bucket, out)
 
     def _fulfill(self, entry: _ModelEntry, batch: List[_Request],

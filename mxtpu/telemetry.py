@@ -81,9 +81,11 @@ __all__ = [
     "record",
     "record_step",
     "record_input_wait",
+    "input_wait",
     "current_step",
     "events",
     "health_rollup",
+    "perf_rollup",
     "clear",
     "metrics",
     "snapshot",
@@ -117,10 +119,13 @@ __all__ = [
 #: or OOM; ``tensor_stats`` = one in-graph per-layer grad/param-norm
 #: sample at the ``MXTPU_HEALTH_STATS_EVERY`` cadence, rendered as
 #: chrome-trace counter tracks by :func:`merge_dir`.)
+#: (``perf`` = an `mx.perf` sampled device-sync point: per-program
+#: host_dispatch/device_compute/wall spans + MFU when known, rendered
+#: as chrome-trace counter tracks by :func:`merge_dir`.)
 EVENT_KINDS = ("step", "compile", "kvstore", "kvstore_round", "retry",
                "failover", "membership", "checkpoint", "monitor",
                "timeout", "flight", "anomaly", "tensor_stats", "serve",
-               "reshard")
+               "reshard", "perf")
 
 #: ``profiler.stats()`` keys that are point-in-time gauges, not
 #: additive counters: cluster aggregation takes their MAX, and counter
@@ -129,7 +134,10 @@ EVENT_KINDS = ("step", "compile", "kvstore", "kvstore_round", "retry",
 GAUGE_STATS = ("step_time_us_last", "device_mem_watermark_bytes",
                "kvstore_round_last", "input_wait_us_last",
                "serve_queue_depth", "serve_inflight",
-               "serve_batch_occupancy_pct", "serve_max_batch")
+               "serve_batch_occupancy_pct", "serve_max_batch",
+               "perf_host_dispatch_us_last",
+               "perf_device_compute_us_last", "perf_input_wait_us_last",
+               "perf_optimizer_us_last", "perf_collective_us_last")
 
 # RLock, NOT Lock: the flight recorder's signal handler snapshots
 # state on whatever thread the signal lands on — if that thread was
@@ -280,7 +288,11 @@ def record_input_wait(dur_s: float) -> None:
     (``input_wait_us_last`` in `profiler.stats()`) + running totals in
     :func:`metrics` — this is what attributes an input-bound step-time
     gap (the 911us/step dispatch gap in BENCH_r05) to the pipeline
-    instead of the device."""
+    instead of the device.  Producers that can NEST (a DataLoader
+    whose fetch drives an inner DataIter — both used to stamp the same
+    wait, double-counting it) should wrap the fetch in
+    :func:`input_wait` instead, which records only at the outermost
+    level.  Also feeds the `mx.perf` phase schema as ``input_wait``."""
     if not _ENABLED:
         return
     with _lock:
@@ -290,6 +302,49 @@ def record_input_wait(dur_s: float) -> None:
     from . import profiler as _prof
 
     _prof.set_stat("input_wait_us_last", int(dur_s * 1e6))
+    from . import perf as _perf
+
+    _perf.note_phase("input_wait", dur_s)
+
+
+_INPUT_WAIT_TLS = threading.local()
+
+
+class _InputWait(object):
+    """Re-entrancy-guarded input-wait scope (see :func:`input_wait`).
+    A plain class, not ``contextmanager``: this sits on the per-batch
+    hot path and a generator frame per batch is measurable there."""
+
+    __slots__ = ("_outer", "_t0")
+
+    def __enter__(self):
+        depth = getattr(_INPUT_WAIT_TLS, "depth", 0)
+        _INPUT_WAIT_TLS.depth = depth + 1
+        self._outer = depth == 0
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        _INPUT_WAIT_TLS.depth = getattr(_INPUT_WAIT_TLS, "depth", 1) - 1
+        # only the OUTERMOST scope on this thread records: a DataLoader
+        # wrapping a DataIter (or any nested iterator stack) counts the
+        # wait ONCE, at the layer the training loop actually blocked on
+        if self._outer and exc[0] is None:
+            record_input_wait(time.perf_counter() - self._t0)
+        return False
+
+
+def input_wait() -> _InputWait:
+    """Context manager measuring one host-input wait with a
+    thread-local nesting guard: nested scopes (outer ``DataLoader``
+    fetch driving an inner ``DataIter.__next__``) record nothing —
+    only the outermost records, so `input_wait_frac` can never
+    double-count one wall-clock wait::
+
+        with telemetry.input_wait():
+            batch = next(source)
+    """
+    return _InputWait()
 
 
 _last_mem_sample = [0.0]
@@ -655,6 +710,28 @@ def health_rollup(snaps: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
             "first_nonfinite": first_nonfinite}
 
 
+def perf_rollup(snaps: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold per-node snapshots into the performance cluster view:
+    per-rank MFU, the worker MFU spread (straggler signal — max-min
+    over ranks reporting one), and each rank's dominant phase.  Shared
+    by ``merge_dir``'s cluster.json and the scheduler's
+    ``kv.telemetry()`` view."""
+    per_rank_mfu: Dict[str, float] = {}
+    per_rank_phase: Dict[str, str] = {}
+    for key, snap in snaps.items():
+        p = (snap.get("metrics") or {}).get("perf") or {}
+        if p.get("mfu") is not None:
+            per_rank_mfu[key] = float(p["mfu"])
+        if p.get("dominant_phase"):
+            per_rank_phase[key] = p["dominant_phase"]
+    worker_mfus = [v for k, v in per_rank_mfu.items()
+                   if k.startswith("worker")] or list(per_rank_mfu.values())
+    return {"per_rank_mfu": per_rank_mfu,
+            "mfu_spread": (max(worker_mfus) - min(worker_mfus))
+            if len(worker_mfus) >= 2 else 0.0,
+            "per_rank_dominant_phase": per_rank_phase}
+
+
 def aggregate_stats(stat_dicts) -> Dict[str, int]:
     """Fold per-node counter snapshots into one cluster view: additive
     counters sum, :data:`GAUGE_STATS` take the max."""
@@ -964,6 +1041,20 @@ def _events_to_chrome(snap: Dict[str, Any], t0: float) -> List[Dict]:
                             "args": {"grad_norm":
                                      st.get("grad_norm", 0.0)}})
             continue
+        if ev.get("kind") == "perf":
+            # mx.perf sampled sync points: per-program counter tracks
+            # (device span + MFU when known) next to the step spans
+            prog = ev.get("program", "program")
+            cargs = {"device_compute_us": ev.get("device_us", 0.0),
+                     "host_dispatch_us": ev.get("host_us", 0.0)}
+            out.append({"name": "perf/%s" % prog, "cat": "perf",
+                        "ph": "C", "ts": ts_us, "pid": pid, "tid": 0,
+                        "args": cargs})
+            if ev.get("mfu") is not None:
+                out.append({"name": "mfu/%s" % prog, "cat": "perf",
+                            "ph": "C", "ts": ts_us, "pid": pid,
+                            "tid": 0, "args": {"mfu": ev["mfu"]}})
+            continue
         if ev.get("kind") == "step" and dur:
             # the record's ts is the step's END; when the start would
             # fall before the merged origin, clip the DURATION too so
@@ -1139,6 +1230,11 @@ def merge_dir(directory: str, out_trace: str = "merged_trace.json",
         # training-health rollup (mx.health): per-rank anomaly counts
         # and the first non-finite blame, next to the compile/step rows
         "health": health_rollup(snaps),
+        # performance rollup (mx.perf): per-rank MFU + dominant phase
+        # from each role's metrics()["perf"] block; the worker MFU
+        # spread is the straggler signal (one slow rank drags every
+        # synchronous collective down to its speed)
+        "perf": perf_rollup(snaps),
         "flights": flights,
     }
     _write_json(os.path.join(directory, out_cluster), cluster)
@@ -1174,10 +1270,17 @@ class Speedometer(object):
         if self._count % self.frequent:
             return
         m = metrics()
+        # mx.perf columns: MFU + dominant phase from metrics()["perf"]
+        # — "-" when the observatory is disabled or has no sample yet
+        p = m.get("perf") or {}
+        mfu = p.get("mfu")
         self.logger.info(
             "telemetry: step %d\t%.1f samples/sec\tstep %.1f ms "
-            "(avg %.1f ms)\tnonfinite %d\tmem watermark %.1f MB",
+            "(avg %.1f ms)\tnonfinite %d\tmem watermark %.1f MB\t"
+            "MFU %s\tphase %s",
             m["steps"], m["examples_per_sec"],
             m["step_time_last_s"] * 1e3, m["step_time_avg_s"] * 1e3,
             m["nonfinite_steps"],
-            m["device_mem_watermark_bytes"] / 1e6)
+            m["device_mem_watermark_bytes"] / 1e6,
+            ("%.3f" % mfu) if mfu is not None else "-",
+            p.get("dominant_phase") or "-")

@@ -252,8 +252,12 @@ def test_example_rbm():
 
 
 def test_example_sgld():
+    # 400 iters / 200 burn-in converges to the same 0.905 ensemble
+    # accuracy as the old 1000-iter run (gate 0.8) at ~1/3 the wall
+    # time — this eager per-op loop was the single slowest tier-1 test
+    # (131s of the ~890s budget)
     out = _run_example("bayesian-methods/sgld_logistic.py",
-                       "--iters", "1000")
+                       "--iters", "400", "--burn-in", "200")
     assert _final_metric(out, "FINAL_ENSEMBLE_ACCURACY") > 0.8
 
 

@@ -117,6 +117,48 @@ def test_check_health_guard():
     assert "check_health OK" in out
 
 
+def test_check_perf_guard(tmp_path):
+    """tools/check_perf.py: the perf-regression ratchet.  Baselines
+    are written and compared ON THIS MACHINE (temp file) so the check
+    is a same-box ratchet, then the compare run must pass, assert the
+    always-on mx.perf hook under its 10us/step budget, and the
+    mx.perf.report() acceptance (dominant phase named, MFU in (0,1])
+    must hold on the 50-step MLP train run.  The committed CPU
+    baseline (benchmark/baselines/cpu.json) must exist and parse —
+    it is the reference-box default for interactive use."""
+    import json as _json
+
+    with open(os.path.join(REPO, "benchmark", "baselines",
+                           "cpu.json")) as f:
+        committed = _json.load(f)
+    assert committed["backend"] == "cpu"
+    assert committed["benches"]["mlp_train_step"]["step_time_us"] > 0
+    base = str(tmp_path / "cpu.json")
+    _run(["tools/check_perf.py", "--update-baseline",
+          "--baseline", base], timeout=420)
+    out = _run(["tools/check_perf.py", "--baseline", base],
+               timeout=420)
+    assert "check_perf OK" in out
+
+
+def test_check_perf_ratchet_catches_slowdown(tmp_path):
+    """tools/check_perf.py --slow-us: a deliberately slowed bench
+    (injected per-step sleep) must FAIL the ratchet with a named
+    regression — the self-test that the guard can actually fire."""
+    base = str(tmp_path / "cpu.json")
+    _run(["tools/check_perf.py", "--update-baseline", "--baseline",
+          base, "--steps", "30"], timeout=420)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, "tools/check_perf.py", "--baseline", base,
+         "--steps", "30", "--slow-us", "2000"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=420)
+    assert r.returncode == 1, (r.returncode, r.stdout, r.stderr)
+    assert "REGRESSION" in r.stderr, r.stderr
+
+
 def test_check_resilience_guard():
     """tools/check_resilience.py: a short fault-injected training run
     (compile-fail + kvstore-pull-fail + checkpoint-fail + SIGTERM +

@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Perf-regression ratchet + `mx.perf` observatory acceptance guard.
+
+Runs two tier-1-sized micro-benches through the shared structured-
+result runner (`benchmark/python/bench_common.py`) and compares their
+steady-state step time against the on-disk baseline
+(``benchmark/baselines/<backend>.json``):
+
+  * **mlp_train_step** — a Module-bound MLP trained for ``--steps``
+    (50) steps through the Executor's fused ``_jit_step`` path.  This
+    is also the observatory acceptance run: ``mx.perf.report()`` must
+    name a dominant phase and report an MFU in (0, 1] for the train
+    program.
+  * **cachedop_serve_dispatch** — a bucket-warmed hybridized net
+    driven through the CachedOp AOT serving hot path, one blocking
+    dispatch per call.
+
+FAILS (rc=1) when either bench regresses more than ``--threshold``
+(25%) vs its baseline — the ratchet that keeps "img/s went down"
+from landing silently — and always asserts the always-on `mx.perf`
+hook (begin/end, unsampled) costs under
+``MXTPU_PERF_HOOK_BUDGET_US`` (10) per step.
+
+``--update-baseline`` (re)writes the baseline from this machine's
+measurements — CI runs it into a temp file first so the ratchet
+compares same-machine numbers (the committed CPU baseline documents a
+reference box and serves interactive use).  ``--slow-us N`` injects a
+sleep into every bench step — the self-test `tests/test_tools.py`
+uses to prove a deliberate slowdown fails the ratchet.
+
+Usage: python tools/check_perf.py [--steps N] [--baseline PATH]
+           [--update-baseline] [--threshold F] [--slow-us N]
+           [--overhead-only]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# this tool IS the observatory's guard: an inherited MXTPU_PERF=0
+# opt-out would make it measure a no-op bool check and then die on the
+# report() assertions — force the subject on, pin a deterministic
+# sampling cadence (the 50-step acceptance run must collect several
+# device-sync samples)
+os.environ["MXTPU_PERF"] = "1"
+os.environ.setdefault("MXTPU_PERF_SYNC_EVERY", "8")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "benchmark", "python"))
+
+HOOK_BUDGET_US = float(os.environ.get("MXTPU_PERF_HOOK_BUDGET_US", "10"))
+
+
+def measure_hook_overhead(batches=20, n=2000):
+    """Per-step cost of the always-on unsampled begin/end pair.  MIN
+    over short batches (same rationale as tools/check_inspect.py: the
+    budget bounds the path's intrinsic cost, not what else this
+    machine was doing)."""
+    from mxtpu import perf
+
+    t0 = perf.begin()
+    perf.end("check_perf:hook", "tool", t0)  # warm the record
+    best = float("inf")
+    for _ in range(batches):
+        t = time.perf_counter()
+        for _ in range(n):
+            t0 = perf.begin()
+            perf.end("check_perf:hook", "tool", t0)
+        best = min(best, (time.perf_counter() - t) / n * 1e6)
+    return best
+
+
+def bench_mlp_train(steps, slow_us=0):
+    """Module-bound MLP train loop (Executor fused fwd+bwd program +
+    host-side optimizer phase).  Returns (step_time_us, program_name)."""
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import sym
+    from mxtpu.io.io import DataBatch
+
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data=data, num_hidden=64, name="fc1")
+    h = sym.Activation(data=h, act_type="relu", name="relu1")
+    h = sym.FullyConnected(data=h, num_hidden=32, name="fc2")
+    h = sym.Activation(data=h, act_type="relu", name="relu2")
+    h = sym.FullyConnected(data=h, num_hidden=10, name="fc3")
+    out = sym.SoftmaxOutput(data=h, label=sym.Variable("softmax_label"),
+                            name="softmax")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (32, 64))],
+             label_shapes=[("softmax_label", (32,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01})
+    rng = np.random.RandomState(0)
+    batch = DataBatch(
+        data=[mx.nd.array(rng.rand(32, 64).astype("float32"))],
+        label=[mx.nd.array(rng.randint(0, 10, 32).astype("float32"))])
+
+    def step():
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        if slow_us:
+            time.sleep(slow_us / 1e6)
+
+    warm = max(3, steps // 10)
+    for _ in range(warm):
+        step()
+    import jax
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        step()
+    # one barrier so async tails are charged to the loop, not dropped
+    jax.block_until_ready(
+        [a._data for a in mod._exec_group.execs[0].arg_arrays])
+    wall = time.perf_counter() - t0
+    prog = mod._exec_group.execs[0]._insp.name
+    return wall / steps * 1e6, prog
+
+
+def bench_cachedop_dispatch(calls, slow_us=0):
+    """Bucket-warmed hybridized net on the CachedOp AOT serving hot
+    path, one blocking dispatch per call.  Returns step_time_us."""
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu.gluon import nn
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu"), nn.Dense(8))
+    net.initialize()
+    net.hybridize()
+    net.warmup([(8, 32)])  # the AOT zero-compile serving path
+    rng = np.random.RandomState(1)
+    x = mx.nd.array(rng.rand(8, 32).astype("float32"))
+    net(x).wait_to_read()
+    warm = max(3, calls // 10)
+    for _ in range(warm):
+        net(x).wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        net(x).wait_to_read()
+        if slow_us:
+            time.sleep(slow_us / 1e6)
+    wall = time.perf_counter() - t0
+    return wall / calls * 1e6
+
+
+def default_baseline_path():
+    import jax
+
+    return os.path.join(REPO, "benchmark", "baselines",
+                        "%s.json" % jax.default_backend())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50,
+                    help="train steps (and 4x serve dispatches)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default benchmark/baselines/"
+                         "<backend>.json)")
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="max allowed step-time regression fraction")
+    ap.add_argument("--slow-us", type=int, default=0,
+                    help="inject a per-step sleep (ratchet self-test)")
+    ap.add_argument("--overhead-only", action="store_true")
+    args = ap.parse_args()
+
+    import mxtpu as mx
+    import bench_common
+    from mxtpu import perf
+
+    overhead = measure_hook_overhead()
+    print("always-on perf hook: %.2f us/step (budget %.0f)"
+          % (overhead, HOOK_BUDGET_US), file=sys.stderr)
+    if overhead >= HOOK_BUDGET_US:
+        print("FAIL: always-on mx.perf hook costs %.2f us/step "
+              "(budget %.0f)" % (overhead, HOOK_BUDGET_US),
+              file=sys.stderr)
+        return 1
+    if args.overhead_only:
+        print("check_perf OK (overhead only: %.2f us/step)" % overhead)
+        return 0
+
+    def emit(name, us):
+        # emitted while ITS bench's perf state is live (bench_common
+        # reads mfu/phases from the global observatory at emit time —
+        # without the reset below, the serve row would inherit the
+        # train bench's MFU and optimizer phase)
+        bench_common.emit_result(
+            "check_perf", "%s_time_us" % name, round(us, 1), "us",
+            step_time_us=round(us, 1),
+            extra={"threshold": args.threshold,
+                   "slow_us": args.slow_us})
+
+    perf.reset()
+    mlp_us, train_prog = bench_mlp_train(args.steps,
+                                         slow_us=args.slow_us)
+
+    # --- observatory acceptance: dominant phase + MFU in (0, 1] -----
+    rep = perf.report()
+    row = (rep.get("programs") or {}).get(train_prog)
+    assert row is not None, \
+        "train program %r missing from mx.perf.report()" % train_prog
+    assert row.get("dominant_phase") in perf.PHASES, \
+        "no dominant phase named: %r" % (row,)
+    mfu = row.get("mfu")
+    assert mfu is not None and 0.0 < mfu <= 1.0, \
+        "MFU not in (0, 1]: %r (sync_samples=%s)" \
+        % (mfu, row.get("sync_samples"))
+    assert row.get("sync_samples", 0) > 0, "no sampled device sync ran"
+    assert rep.get("dominant_phase") in perf.PHASES
+    print("mx.perf: train program %s MFU %.3g, dominant phase %s, "
+          "roofline %s" % (train_prog, mfu, row["dominant_phase"],
+                           (row.get("roofline") or {}).get("bound")),
+          file=sys.stderr)
+    emit("mlp_train_step", mlp_us)
+
+    perf.reset()
+    serve_us = bench_cachedop_dispatch(args.steps * 4,
+                                       slow_us=args.slow_us)
+    emit("cachedop_serve_dispatch", serve_us)
+    measured = {"mlp_train_step": mlp_us,
+                "cachedop_serve_dispatch": serve_us}
+
+    # --- the ratchet ------------------------------------------------
+    path = args.baseline or default_baseline_path()
+    if args.update_baseline:
+        if args.slow_us:
+            # a sleep-inflated baseline would pad the reference so the
+            # >threshold ratchet could never fire at real regressions
+            print("FAIL: refusing to write a baseline from a "
+                  "--slow-us run", file=sys.stderr)
+            return 1
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        import jax
+
+        with open(path, "w") as f:
+            json.dump({"backend": jax.default_backend(),
+                       "threshold": args.threshold,
+                       "steps": args.steps,
+                       "hook_overhead_us": round(overhead, 2),
+                       "benches": {k: {"step_time_us": round(v, 1)}
+                                   for k, v in measured.items()}},
+                      f, indent=1)
+        print("check_perf: wrote baseline %s" % path, file=sys.stderr)
+        print("check_perf OK (baseline updated; hook %.2f us/step, "
+              "MFU %.3g)" % (overhead, mfu))
+        return 0
+    if not os.path.exists(path):
+        # a missing (or mistyped --baseline) file must not silently
+        # disarm the ratchet: writing one and passing would let every
+        # regression through as "first run"
+        print("FAIL: no baseline at %s — run with --update-baseline "
+              "on a known-good build to arm the ratchet" % path,
+              file=sys.stderr)
+        return 1
+
+    with open(path) as f:
+        base = json.load(f)
+    if base.get("steps") and base["steps"] != args.steps:
+        # fixed costs amortize differently across step counts, so a
+        # cross-step comparison is noise dressed as a ratchet verdict
+        print("WARNING: baseline was measured at --steps %s, this run "
+              "uses --steps %d — compare like with like"
+              % (base["steps"], args.steps), file=sys.stderr)
+    failures = []
+    for name, us in measured.items():
+        b = (base.get("benches") or {}).get(name, {}).get("step_time_us")
+        if not b:
+            continue
+        ratio = us / b
+        note = ""
+        if ratio > 1.0 + args.threshold:
+            failures.append((name, b, us, ratio))
+            note = "  << REGRESSION"
+        elif ratio < 0.75:
+            note = "  (much faster — consider --update-baseline)"
+        print("%-28s baseline %9.1f us   measured %9.1f us  "
+              "(%.2fx)%s" % (name, b, us, ratio, note),
+              file=sys.stderr)
+    if failures:
+        for name, b, us, ratio in failures:
+            print("FAIL: %s step-time regression: %.1f us vs baseline "
+                  "%.1f us (%.2fx > %.2fx allowed)"
+                  % (name, us, b, ratio, 1.0 + args.threshold),
+                  file=sys.stderr)
+        return 1
+    print("check_perf OK (hook %.2f us/step, MFU %.3g, dominant %s)"
+          % (overhead, mfu, row["dominant_phase"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

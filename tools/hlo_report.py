@@ -148,6 +148,11 @@ def main():
                          "pre/post pass_deltas)")
     ap.add_argument("--dump", default="",
                     help="also write full optimized HLO text here")
+    ap.add_argument("--roofline", action="store_true",
+                    help="print per-program flops/bytes/operational "
+                         "intensity + compute-vs-memory-bound "
+                         "classification from the mx.inspect registry "
+                         "(mx.perf peak table; MXTPU_PEAK_* override)")
     args = ap.parse_args()
     if args.model == "mlp" and args.classes == 1000:
         args.classes = 10
@@ -191,6 +196,30 @@ def main():
     if args.dump:
         with open(args.dump, "w") as f:
             f.write(mx.inspect.hlo(loop._insp.name, kind="train"))
+
+    if args.roofline:
+        # per-program roofline rows over EVERY registered program (the
+        # build above registers the fused train program; a caller that
+        # imported more models sees them all)
+        from mxtpu import perf as mxperf
+
+        rows = {}
+        for p in mx.inspect.programs(analyze=True):
+            rf = mxperf.roofline(p.get("flops", 0.0),
+                                 p.get("bytes_accessed", 0.0))
+            rows[p["name"]] = {
+                "flops": p.get("flops"),
+                "bytes_accessed": p.get("bytes_accessed"),
+                "peak_bytes": p.get("peak_bytes"),
+                "roofline": rf,
+            }
+        report["roofline"] = {
+            "peak_flops_per_s": mxperf.peak_flops(),
+            "peak_bytes_per_s": mxperf.peak_bytes(),
+            "ridge_flops_per_byte": round(
+                mxperf.peak_flops() / mxperf.peak_bytes(), 3),
+            "programs": rows,
+        }
 
     flops = (report.get("cost") or {}).get("flops")
     if args.model.startswith("resnet") and not args.symbol_json:

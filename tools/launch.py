@@ -99,8 +99,11 @@ def main(argv=None):
                          "(and flight_* on crash/kill) into DIR, and "
                          "after the run the launcher merges them into "
                          "merged_trace.json (one chrome trace, clocks "
-                         "aligned) + cluster.json (per-rank step time, "
-                         "straggler spread, counter totals)")
+                         "aligned, mx.perf MFU/phase counter tracks) "
+                         "+ cluster.json (per-rank step time, "
+                         "straggler spread, counter totals, and the "
+                         "mx.perf rollup: per-rank MFU + dominant "
+                         "phase, worker MFU spread)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
