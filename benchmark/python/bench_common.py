@@ -103,7 +103,11 @@ def row(bench: str, metric: str, value: float, unit: str,
 
 def emit(r: Dict[str, Any]) -> Dict[str, Any]:
     """Print ``r`` as the result line and append it to
-    ``$MXTPU_BENCH_OUT`` (JSONL) when set.  Returns ``r``."""
+    ``$MXTPU_BENCH_OUT`` (JSONL) when set.  With ``MXTPU_RUN_DIR``
+    set, the row also lands in the `mx.obs` run ledger
+    (``<run_id>.jsonl``, ``kind="bench"``) — the trial-history rows
+    ``tools/compare_runs.py`` diffs and `mx.tune` will search.
+    Returns ``r``."""
     line = json.dumps(r, default=str)
     print(line)
     path = os.environ.get("MXTPU_BENCH_OUT")
@@ -113,6 +117,19 @@ def emit(r: Dict[str, Any]) -> Dict[str, Any]:
                 f.write(line + "\n")
         except OSError:
             pass  # a broken sink must not fail the bench
+    if os.environ.get("MXTPU_RUN_DIR"):
+        try:
+            import sys
+            import time
+
+            mx = sys.modules.get("mxtpu")
+            if mx is not None:
+                row_ = dict(r)
+                row_.setdefault("kind", "bench")
+                row_.setdefault("ts", time.time())
+                mx.obs.ledger_append(row_)
+        except Exception:
+            pass  # the ledger must not fail the bench either
     return r
 
 

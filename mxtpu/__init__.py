@@ -120,6 +120,7 @@ from . import rnn
 from . import image
 from . import gluon
 from . import serve
+from . import obs
 from . import fused_train
 from .fused_train import FusedTrainLoop
 from . import contrib

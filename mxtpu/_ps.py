@@ -109,6 +109,19 @@ def role_from_env() -> Optional[str]:
     return _env("MXTPU_ROLE", "DMLC_ROLE")
 
 
+def _start_obs() -> None:
+    """Bring up the `mx.obs` sampler + OpenMetrics endpoint for this
+    role (no-op unless the plane is armed — see ``obs.armed``).  Every
+    PS role calls this right after stamping its telemetry identity, so
+    one scrape config covers the whole training fleet."""
+    try:
+        from . import obs as _obs
+
+        _obs.ensure_started()
+    except Exception:
+        pass  # observability must never fail a role bootstrap
+
+
 def _root_addr() -> Tuple[str, int]:
     host = _env("MXTPU_PS_ROOT_URI", "DMLC_PS_ROOT_URI", default="127.0.0.1")
     port = int(_env("MXTPU_PS_ROOT_PORT", "DMLC_PS_ROOT_PORT",
@@ -468,6 +481,7 @@ class Scheduler(object):
         # posthumous flight record when a node is declared dead)
         self._telemetry: Dict[int, Dict[str, Any]] = {}
         _telemetry.set_identity("scheduler", 0)
+        _start_obs()
 
     # -- liveness / membership (all called with self._cv held) --------------
     def _live_workers(self) -> int:
@@ -1014,6 +1028,7 @@ class Server(object):
         self.rank = info["rank"]
         self.node_id = info.get("node_id", 8 + 2 * self.rank)
         _telemetry.set_identity("server", self.rank)
+        _start_obs()
         servers = [tuple(a) for a in info.get("servers", [])]
         ns = len(servers)
         self._repl_on = _replication_on() and ns > 1
@@ -1527,6 +1542,7 @@ class Worker(object):
         self.node_id = info.get("node_id", 9 + 2 * self.rank)
         self._closed = False
         _telemetry.set_identity(role_from_env() or "worker", self.rank)
+        _start_obs()
         if self.rejoined:
             _inc_stat("elastic_rejoin")
             _telemetry.record("membership", action="rejoin",

@@ -44,9 +44,25 @@ def init_module():
         # a healthy run must not leave crash-style flight corpses —
         # then flush the final snapshot explicitly (the hard exit
         # below skips atexit)
-        from . import telemetry
+        import signal
+
+        from . import obs, telemetry
 
         telemetry.uninstall_flight_recorder()
+        # the launcher's routine teardown SIGTERM races this epilogue
+        # (it fires the instant the workers exit — exactly when a
+        # healthy scheduler reaches here, with the flight recorder
+        # just disarmed): mask it for the few ms the final ledger
+        # rows + snapshot take.  The launcher escalates to SIGKILL
+        # after 10s, so a wedged epilogue still cannot leak the role.
+        try:
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        except ValueError:
+            pass
+        # the hard exit below skips atexit: close the obs plane
+        # explicitly so the server/scheduler's final sample + ledger
+        # summary row land like every other role's
+        obs.stop()
         telemetry.flush()
         # hard exit, ps-lite style: the role's work is DONE when run()
         # returns, but interpreter/native teardown with live daemon

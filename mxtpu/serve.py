@@ -690,7 +690,12 @@ class HttpFrontend(object):
           -> 200 {"output": [...], "replica": <rank>, "rows": n}
           -> 503 {"error": ..., "shed": true, "reason": ...}   (shed)
           -> 404 unknown model, 400 bad payload, 500 model error
-        GET  /metrics   -> mx.telemetry.metrics() as JSON
+        GET  /metrics   -> mx.telemetry.metrics() as JSON, or —
+          content-negotiated via the Accept header
+          (``application/openmetrics-text`` / ``text/plain``, what a
+          Prometheus scraper sends) — the `mx.obs` OpenMetrics text
+          exposition, so ONE scrape config covers serve replicas and
+          training roles identically
         GET  /healthz   -> {"ok": true, "replica": <rank>, "models": [...]}
 
     ``port=0`` binds an ephemeral port (read it back from ``.port``).
@@ -727,6 +732,7 @@ class HttpFrontend(object):
                 self.wfile.write(body)
 
             def do_GET(self):
+                from . import obs as _obs
                 from . import telemetry as _tel
 
                 if self.path == "/healthz":
@@ -734,7 +740,24 @@ class HttpFrontend(object):
                                       "replica": rank,
                                       "models": srv.models()})
                 elif self.path == "/metrics":
-                    self._reply(200, _tel._json_safe(_tel.metrics()))
+                    # content negotiation: a Prometheus scraper asks
+                    # for openmetrics-text/text-plain and gets the
+                    # mx.obs exposition (same families as every
+                    # training role's endpoint); the JSON default
+                    # keeps the existing dashboards parsing
+                    accept = self.headers.get("Accept", "") or ""
+                    if "openmetrics" in accept or "text/plain" in accept:
+                        body = _obs.openmetrics().encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         _obs.CONTENT_TYPE)
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self._reply(200,
+                                    _tel._json_safe(_tel.metrics()))
                 else:
                     self._reply(404, {"error": "no such path"})
 
@@ -814,6 +837,11 @@ def serve_forever(build_models: Callable[[Server], None],
     rank = getenv_int("MXTPU_SERVE_RANK", 0)
     _tel.set_identity(role="serve", rank=rank)
     _tel.install_flight_recorder()
+    from . import obs as _obs
+
+    _obs.ensure_started()  # the replica's own OpenMetrics endpoint +
+    # sampler (queue depth / occupancy / SLO time series), next to the
+    # frontend's content-negotiated /metrics
     server = Server()
     build_models(server)
     front = HttpFrontend(server, port=port).start()

@@ -84,6 +84,7 @@ __all__ = [
     "input_wait",
     "current_step",
     "events",
+    "stat_rollup",
     "health_rollup",
     "perf_rollup",
     "clear",
@@ -137,7 +138,8 @@ GAUGE_STATS = ("step_time_us_last", "device_mem_watermark_bytes",
                "serve_batch_occupancy_pct", "serve_max_batch",
                "perf_host_dispatch_us_last",
                "perf_device_compute_us_last", "perf_input_wait_us_last",
-               "perf_optimizer_us_last", "perf_collective_us_last")
+               "perf_optimizer_us_last", "perf_collective_us_last",
+               "obs_sample_wall_us_last")
 
 # RLock, NOT Lock: the flight recorder's signal handler snapshots
 # state on whatever thread the signal lands on — if that thread was
@@ -528,31 +530,82 @@ class Histogram(object):
                 self.vmax = max(self.vmax, other.vmax)
         return self
 
-    def quantile(self, q: float) -> float:
-        """The q-quantile (0..1) as the geometric midpoint of the
-        bucket holding that rank, clamped to the observed [min, max].
-        0.0 when empty."""
+    def _quantile_of(self, counts, n: int, q: float,
+                     vmin: Optional[float] = None,
+                     vmax: Optional[float] = None) -> float:
+        """q-quantile over an arbitrary bucket-count vector of THIS
+        histogram's layout (shared by the cumulative :meth:`quantile`
+        and the windowed :meth:`interval`): the geometric midpoint of
+        the bucket holding the rank, clamped into [vmin, vmax] when
+        given.  0.0 when the vector is empty."""
         import math
 
-        with self._hlock:
-            n = self.count
-            if n == 0:
-                return 0.0
-            rank = min(n - 1, max(0, int(math.ceil(q * n)) - 1))
-            acc = 0
-            idx = self.nbins - 1
-            for i, c in enumerate(self._counts):
-                acc += c
-                if acc > rank:
-                    idx = i
-                    break
-            vmin, vmax = self.vmin, self.vmax
+        if n <= 0:
+            return 0.0
+        rank = min(n - 1, max(0, int(math.ceil(q * n)) - 1))
+        acc = 0
+        idx = self.nbins - 1
+        for i, c in enumerate(counts):
+            acc += c
+            if acc > rank:
+                idx = i
+                break
         if idx == 0:
             est = self.low
         else:
             # bucket idx spans [low*g^(idx-1), low*g^idx)
             est = self.low * math.exp(self._log_growth * (idx - 0.5))
-        return min(max(est, vmin), vmax)
+        if vmin is not None:
+            est = max(est, vmin)
+        if vmax is not None:
+            est = min(est, vmax)
+        return est
+
+    def quantile(self, q: float) -> float:
+        """The q-quantile (0..1) as the geometric midpoint of the
+        bucket holding that rank, clamped to the observed [min, max].
+        0.0 when empty."""
+        with self._hlock:
+            counts = list(self._counts)
+            n = self.count
+            vmin, vmax = self.vmin, self.vmax
+        return self._quantile_of(counts, n, q, vmin, vmax)
+
+    def state(self) -> tuple:
+        """Opaque cumulative state for :meth:`interval` — take one,
+        hold it, and the next ``interval(prev_state)`` call answers
+        "what were the percentiles BETWEEN the two samples"."""
+        with self._hlock:
+            return (tuple(self._counts), self.count, self.total)
+
+    def interval(self, prev: Optional[tuple] = None):
+        """WINDOWED snapshot: percentiles of only the values recorded
+        since ``prev`` (a state returned by :meth:`state` or a prior
+        ``interval`` call).  ``prev=None`` means "since the
+        beginning".  Returns ``(snapshot_dict, new_state)`` where the
+        dict carries per-window ``count/sum/avg/p50/p95/p99`` — the
+        time-series row primitive (`mx.obs` sample rows show
+        per-interval latency, not lifetime-cumulative values).  A
+        :meth:`reset` inside the window (cumulative counts went
+        backwards) degrades gracefully to "everything currently
+        recorded".  Interval quantiles clamp to the bucket range, not
+        a per-window min/max (not tracked per window)."""
+        with self._hlock:
+            cur = (tuple(self._counts), self.count, self.total)
+        if (prev is None or len(prev) != 3
+                or len(prev[0]) != len(cur[0])):
+            prev = ((0,) * len(cur[0]), 0, 0.0)
+        counts = [a - b for a, b in zip(cur[0], prev[0])]
+        n = cur[1] - prev[1]
+        tot = cur[2] - prev[2]
+        if n < 0 or any(c < 0 for c in counts):
+            counts, n, tot = list(cur[0]), cur[1], cur[2]
+        snap = {"count": n, "sum": tot,
+                "avg": tot / n if n else 0.0,
+                "p50": self._quantile_of(counts, n, 0.50),
+                "p95": self._quantile_of(counts, n, 0.95),
+                "p99": self._quantile_of(counts, n, 0.99)}
+        return snap, cur
 
     def percentiles(self) -> Dict[str, float]:
         return {"p50": self.quantile(0.50), "p95": self.quantile(0.95),
@@ -589,6 +642,14 @@ def histograms() -> Dict[str, Dict[str, Any]]:
     with _lock:
         hs = dict(_HISTOGRAMS)
     return {name: h.snapshot() for name, h in sorted(hs.items())}
+
+
+def _registered_histograms() -> Dict[str, Histogram]:
+    """The LIVE registered histogram objects (not snapshots) — the
+    `mx.obs` sampler holds per-histogram interval states across
+    ticks."""
+    with _lock:
+        return dict(_HISTOGRAMS)
 
 
 # named callables merged into metrics() under their key — how a
@@ -682,6 +743,35 @@ def hb_payload() -> Optional[Dict[str, Any]]:
     return snapshot(max_events=_HB_EVENTS)
 
 
+def stat_rollup(stats) -> Dict[str, int]:
+    """Derived per-node tickers from ONE ``profiler.stats()`` dict —
+    the single definition shared by `mx.obs` sample rows, the live
+    aggregator's per-role rows and :func:`health_rollup`, so the
+    anomaly/retry/failover arithmetic cannot drift between surfaces.
+    Tolerates a malformed dict (a dying role's last heartbeat)."""
+    out = {"anomalies": 0, "retries": 0, "failovers": 0}
+    if not isinstance(stats, dict):
+        return out
+
+    def _i(v) -> int:
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return 0
+
+    for k, v in stats.items():
+        if k.startswith("health_anomaly::"):
+            out["anomalies"] += _i(v)
+        elif k.startswith("retry_attempts::"):
+            out["retries"] += _i(v)
+        elif k.startswith("serve_failover::"):
+            out["failovers"] += _i(v)
+    out["anomalies"] += _i(stats.get("health_nonfinite_steps", 0))
+    out["anomalies"] += _i(stats.get("health_oom", 0))
+    out["failovers"] += _i(stats.get("elastic_failover", 0))
+    return out
+
+
 def health_rollup(snaps: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
     """Fold per-node snapshots into the training-health cluster view:
     per-node anomaly counts (``health_*`` counters) and the FIRST
@@ -691,14 +781,16 @@ def health_rollup(snaps: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
     per_node: Dict[str, int] = {}
     first_nonfinite: Dict[str, Dict[str, Any]] = {}
     for key, snap in snaps.items():
-        stats = snap.get("stats") or {}
-        n = sum(v for k, v in stats.items()
-                if k.startswith("health_anomaly::"))
-        n += int(stats.get("health_nonfinite_steps", 0))
-        n += int(stats.get("health_oom", 0))
+        if not isinstance(snap, dict):
+            continue  # a corrupt heartbeat/merge source names the
+            # gap upstream; the rollup folds the survivors
+        n = stat_rollup(snap.get("stats"))["anomalies"]
         if n:
             per_node[key] = n
-        for ev in snap.get("events", []):
+        evs = snap.get("events")
+        for ev in (evs if isinstance(evs, list) else []):
+            if not isinstance(ev, dict):
+                continue
             if ev.get("kind") == "anomaly" and \
                     ev.get("atype") == "nonfinite" and ev.get("layer"):
                 first_nonfinite[key] = {
@@ -719,11 +811,18 @@ def perf_rollup(snaps: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
     per_rank_mfu: Dict[str, float] = {}
     per_rank_phase: Dict[str, str] = {}
     for key, snap in snaps.items():
-        p = (snap.get("metrics") or {}).get("perf") or {}
-        if p.get("mfu") is not None:
-            per_rank_mfu[key] = float(p["mfu"])
+        if not isinstance(snap, dict):
+            continue  # tolerate corrupt sources; fold the survivors
+        m = snap.get("metrics")
+        p = m.get("perf") if isinstance(m, dict) else None
+        p = p if isinstance(p, dict) else {}
+        try:
+            if p.get("mfu") is not None:
+                per_rank_mfu[key] = float(p["mfu"])
+        except (TypeError, ValueError):
+            pass
         if p.get("dominant_phase"):
-            per_rank_phase[key] = p["dominant_phase"]
+            per_rank_phase[key] = str(p["dominant_phase"])
     worker_mfus = [v for k, v in per_rank_mfu.items()
                    if k.startswith("worker")] or list(per_rank_mfu.values())
     return {"per_rank_mfu": per_rank_mfu,
@@ -737,11 +836,17 @@ def aggregate_stats(stat_dicts) -> Dict[str, int]:
     counters sum, :data:`GAUGE_STATS` take the max."""
     out: Dict[str, int] = {}
     for stats in stat_dicts:
-        for k, v in (stats or {}).items():
+        if not isinstance(stats, dict):
+            continue  # a SIGKILL-truncated role may leave a non-dict
+        for k, v in stats.items():  # stats block; fold the survivors
+            try:
+                iv = int(v)
+            except (TypeError, ValueError):
+                continue
             if k in GAUGE_STATS:
-                out[k] = max(out.get(k, 0), int(v))
+                out[k] = max(out.get(k, 0), iv)
             else:
-                out[k] = out.get(k, 0) + int(v)
+                out[k] = out.get(k, 0) + iv
     return out
 
 
@@ -899,6 +1004,18 @@ def _flight_signal_handler(signum, frame):
     except ValueError:
         name = str(signum)
     dump_flight("signal", name)
+    try:
+        # the chained previous disposition usually TERMINATES the
+        # process (no atexit): let the mx.obs run ledger write its
+        # final sample + summary first, so a role the launcher reaps
+        # with SIGTERM still closes its trial record (idempotent; a
+        # SIGKILL still leaves no summary — that asymmetry is the
+        # orderly-vs-killed signal tools/check_obs.py asserts)
+        from . import obs as _obs
+
+        _obs._ledger_epilogue()
+    except Exception:
+        pass
     from .resilience import chain_prev_signal
 
     chain_prev_signal(_FLIGHT["prev_handlers"].get(signum),
@@ -1013,7 +1130,31 @@ if hasattr(os, "register_at_fork"):
 # ---------------------------------------------------------------------------
 
 def _role_key(snap: Dict[str, Any]) -> str:
-    return "%s%d" % (snap.get("role", "node"), int(snap.get("rank", 0)))
+    try:
+        rank = int(snap.get("rank", 0))
+    except (TypeError, ValueError):
+        rank = 0
+    return "%s%d" % (snap.get("role", "node"), rank)
+
+
+def _load_snap(path: str) -> Dict[str, Any]:
+    """Load one per-role JSON file STRICTLY: raises ``ValueError`` on
+    torn/truncated/non-object content (a SIGKILLed role can leave any
+    of those) so :func:`merge_dir` can merge the survivors and NAME
+    the gap instead of crashing — or worse, silently dropping it."""
+    with open(path) as f:
+        snap = json.load(f)
+    if not isinstance(snap, dict):
+        raise ValueError("not a JSON object")
+    # normalize the blocks every consumer indexes into
+    if not isinstance(snap.get("stats"), dict):
+        snap["stats"] = {}
+    if not isinstance(snap.get("metrics"), dict):
+        snap["metrics"] = {}
+    evs = snap.get("events")
+    snap["events"] = [e for e in evs if isinstance(e, dict)] \
+        if isinstance(evs, list) else []
+    return snap
 
 
 def _events_to_chrome(snap: Dict[str, Any], t0: float) -> List[Dict]:
@@ -1132,21 +1273,27 @@ def merge_dir(directory: str, out_trace: str = "merged_trace.json",
     Returns the cluster dict."""
     snaps: Dict[str, Dict[str, Any]] = {}
     flights: List[Dict[str, Any]] = []
+    # files a SIGKILLed role left truncated/torn (or that vanished
+    # between listdir and open) are MERGE GAPS: the merge folds the
+    # survivors and names each gap in cluster.json instead of crashing
+    gaps: List[Dict[str, str]] = []
     names = sorted(os.listdir(directory))
     for name in names:
         path = os.path.join(directory, name)
         if name.startswith("telemetry_") and name.endswith(".json"):
             try:
-                with open(path) as f:
-                    snap = json.load(f)
-            except (OSError, ValueError):
+                snap = _load_snap(path)
+            except (OSError, ValueError) as e:
+                gaps.append({"file": name,
+                             "error": str(e) or type(e).__name__})
                 continue
             snaps[_role_key(snap)] = snap
         elif name.startswith("flight_") and name.endswith(".json"):
             try:
-                with open(path) as f:
-                    fl = json.load(f)
-            except (OSError, ValueError):
+                fl = _load_snap(path)
+            except (OSError, ValueError) as e:
+                gaps.append({"file": name,
+                             "error": str(e) or type(e).__name__})
                 continue
             flights.append({
                 "file": name,
@@ -1236,6 +1383,10 @@ def merge_dir(directory: str, out_trace: str = "merged_trace.json",
         # synchronous collective down to its speed)
         "perf": perf_rollup(snaps),
         "flights": flights,
+        # files that could not be merged (truncated by a SIGKILL,
+        # torn, non-JSON): the survivors above are complete, and the
+        # missing contribution is NAMED instead of silently absent
+        "merge_gaps": gaps,
     }
     _write_json(os.path.join(directory, out_cluster), cluster)
     return cluster

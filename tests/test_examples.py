@@ -252,12 +252,13 @@ def test_example_rbm():
 
 
 def test_example_sgld():
-    # 400 iters / 200 burn-in converges to the same 0.905 ensemble
-    # accuracy as the old 1000-iter run (gate 0.8) at ~1/3 the wall
-    # time — this eager per-op loop was the single slowest tier-1 test
-    # (131s of the ~890s budget)
+    # 250 iters / 120 burn-in land at the same ~0.905 ensemble
+    # accuracy as the old 1000- and 400-iter runs (gate 0.8; the
+    # posterior ensemble converges early) — this eager per-op loop is
+    # still among the slowest tier-1 tests, and the suite has to fit
+    # its 870s wall budget
     out = _run_example("bayesian-methods/sgld_logistic.py",
-                       "--iters", "400", "--burn-in", "200")
+                       "--iters", "250", "--burn-in", "120")
     assert _final_metric(out, "FINAL_ENSEMBLE_ACCURACY") > 0.8
 
 
@@ -318,8 +319,11 @@ def test_example_factorization_machine():
     """FM on sparse features (reference example/sparse/
     factorization_machine): interactions-only labels — a linear model
     is stuck at the majority baseline (~0.76), the FM must crack 0.9."""
+    # 12 epochs land at 0.983 vs the 20-epoch 0.993 — both far past
+    # the 0.9 gate (linear baseline ~0.76); the shorter run keeps the
+    # tier-1 suite inside its wall budget
     out = _run_example("sparse/factorization_machine.py",
-                       "--epochs", "20", timeout=560)
+                       "--epochs", "12", timeout=560)
     assert _final_metric(out, "FINAL_ACCURACY") > 0.9
 
 

@@ -334,12 +334,13 @@ def test_prefetching_iter_overlaps_on_threaded_engine():
     prev = get_engine()
     set_engine(ThreadedEngine(num_threads=2))
     try:
-        # up to 3 attempts: the ordering-based check cannot produce a
-        # FALSE positive, but a fully loaded machine can starve the
+        # up to 6 attempts: the ordering-based check cannot produce a
+        # FALSE positive, but a loaded/noisy machine can starve the
         # producer thread an entire epoch (observed under a parallel
-        # full-suite run) — retrying distinguishes starvation from a
-        # genuinely serial implementation
-        for attempt in range(3):
+        # full-suite run, and ~25% of SOLO runs on a noisy host) —
+        # retrying distinguishes starvation from a genuinely serial
+        # implementation, which fails every attempt regardless
+        for attempt in range(6):
             n, delay = 10, 0.03
             src = _SlowIter(n, delay)
             it = PrefetchingIter(src, prefetch_depth=3)

@@ -586,3 +586,41 @@ def test_serve_metrics_and_histograms(server):
               "serve_max_batch", "serve_inflight"):
         assert k in stats
         assert k in telemetry.GAUGE_STATS
+
+
+def test_frontend_metrics_content_negotiation(server):
+    """/metrics answers JSON by default (existing dashboards) and the
+    mx.obs OpenMetrics text exposition when the Accept header asks for
+    it (what a Prometheus scraper sends) — one scrape config covers
+    serve replicas and training roles identically."""
+    import json
+    import urllib.request
+
+    from mxtpu import obs
+
+    server.add_model("mlp", _mlp(), input_shape=(10,))
+    front = mx.serve.HttpFrontend(server, port=0).start()
+    try:
+        server.infer("mlp", np.random.rand(2, 10).astype("float32"))
+        base = "http://127.0.0.1:%d/metrics" % front.port
+        with urllib.request.urlopen(base, timeout=5) as r:
+            assert "json" in r.headers.get("Content-Type")
+            body = json.loads(r.read())
+        assert "serve" in body and "steps" in body
+        for accept in ("application/openmetrics-text; version=1.0.0",
+                       "text/plain;version=0.0.4;q=0.5,*/*;q=0.1"):
+            req = urllib.request.Request(base,
+                                         headers={"Accept": accept})
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert "openmetrics-text" in r.headers["Content-Type"]
+                text = r.read().decode()
+        fams = obs.parse_openmetrics(text)  # strict parse
+        # the serve SLO surface is in the exposition: the per-model
+        # latency summary + the queue-depth gauge
+        assert fams["mxtpu_serve_latency_s"]["type"] == "summary"
+        keys = {lab.get("key") for _, lab, _
+                in fams["mxtpu_serve_latency_s"]["samples"]}
+        assert "mlp" in keys
+        assert fams["mxtpu_serve_queue_depth"]["type"] == "gauge"
+    finally:
+        front.close()

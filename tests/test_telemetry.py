@@ -585,6 +585,105 @@ def test_histogram_merge_opposite_directions_no_deadlock():
     assert len(done) == 2, "merge deadlocked"
 
 
+def test_histogram_interval_windowed_percentiles():
+    """state()/interval(): a windowed snapshot covers only the values
+    recorded BETWEEN the two samples — the time-series primitive
+    mx.obs sample rows use instead of lifetime-cumulative values."""
+    h = telemetry.Histogram()
+    for _ in range(100):
+        h.record(0.001)
+    st = h.state()
+    snap, st2 = h.interval(None)
+    assert snap["count"] == 100
+    assert snap["p99"] == pytest.approx(0.001, rel=0.1)
+    # the new window holds only slow values: interval p50 must be the
+    # window's ~1.0, while the cumulative p50 stays at ~0.001
+    for _ in range(10):
+        h.record(1.0)
+    win, st3 = h.interval(st)
+    assert win["count"] == 10
+    assert win["sum"] == pytest.approx(10.0, rel=0.01)
+    assert win["p50"] == pytest.approx(1.0, rel=0.15)
+    assert h.quantile(0.5) == pytest.approx(0.001, rel=0.15)
+    # an empty window is explicit, not a stale copy
+    empty, _ = h.interval(st3)
+    assert empty["count"] == 0 and empty["p99"] == 0.0
+
+
+def test_histogram_interval_tolerates_reset():
+    """A reset() mid-window (cumulative counts go backwards) degrades
+    to 'everything currently recorded' instead of negative counts."""
+    h = telemetry.Histogram()
+    for _ in range(5):
+        h.record(0.01)
+    st = h.state()
+    h.reset()
+    h.record(0.5)
+    snap, _ = h.interval(st)
+    assert snap["count"] == 1
+    assert snap["p50"] == pytest.approx(0.5, rel=0.15)
+
+
+def test_merge_dir_tolerates_truncated_role_files(tmp_path):
+    """A SIGKILLed role can leave telemetry_<role>.json truncated
+    mid-write (or as JSON that is not an object).  merge_dir must
+    merge the survivors and NAME each gap in cluster.json instead of
+    crashing — the post-run merge is exactly the moment a post-mortem
+    needs it most."""
+    t0 = 1_700_000_000.0
+    good = _fake_snap("worker", 0, t0, 5, 100)
+    with open(tmp_path / "telemetry_worker0.json", "w") as f:
+        json.dump(good, f)
+    # truncated mid-write: the first half of a real snapshot
+    full = json.dumps(_fake_snap("worker", 1, t0, 5, 101))
+    (tmp_path / "telemetry_worker1.json").write_text(
+        full[:len(full) // 2])
+    # valid JSON, wrong shape (a list)
+    (tmp_path / "telemetry_server0.json").write_text("[1, 2, 3]")
+    # a torn flight corpse
+    (tmp_path / "flight_worker1.json").write_text('{"role": "wor')
+
+    cluster = telemetry.merge_dir(str(tmp_path))
+    # the survivor merged completely
+    assert cluster["aggregate"]["telemetry_steps"] == 5
+    assert "worker0" in cluster["per_rank_step_time_s"]
+    # every gap is NAMED with its file and an error
+    gap_files = {g["file"] for g in cluster["merge_gaps"]}
+    assert gap_files == {"telemetry_worker1.json",
+                        "telemetry_server0.json",
+                        "flight_worker1.json"}
+    assert all(g["error"] for g in cluster["merge_gaps"])
+    # and the artifacts were still written as valid JSON
+    json.load(open(tmp_path / "merged_trace.json"))
+    json.load(open(tmp_path / "cluster.json"))
+
+
+def test_rollups_tolerate_malformed_snapshots():
+    """perf_rollup/health_rollup/aggregate_stats fold the survivors
+    when a snapshot (e.g. from a dying role's last heartbeat) is
+    malformed, instead of raising."""
+    snaps = {
+        "worker0": {"metrics": {"perf": {"mfu": 0.4,
+                                         "dominant_phase": "x"}},
+                    "stats": {"health_nonfinite_steps": 2,
+                              "telemetry_steps": 5}},
+        "worker1": [1, 2],                      # not a dict
+        "worker2": {"metrics": "garbage",       # wrong shapes
+                    "stats": None,
+                    "events": {"kind": "anomaly"}},
+        "worker3": {"metrics": {"perf": {"mfu": "not-a-float"}},
+                    "stats": {"telemetry_steps": "NaNish"}},
+    }
+    p = telemetry.perf_rollup(snaps)
+    assert p["per_rank_mfu"] == {"worker0": 0.4}
+    h = telemetry.health_rollup(snaps)
+    assert h["per_node_anomalies"] == {"worker0": 2}
+    agg = telemetry.aggregate_stats(s.get("stats")
+                                    if isinstance(s, dict) else s
+                                    for s in snaps.values())
+    assert agg["telemetry_steps"] == 5
+
+
 def test_histogram_registry_in_metrics_and_clear():
     h = telemetry.histogram("t_reg_latency_s")
     assert telemetry.histogram("t_reg_latency_s") is h  # get-or-create

@@ -206,6 +206,20 @@ def test_check_serving_guard():
     assert "check_serving OK" in out
 
 
+def test_check_obs_guard():
+    """tools/check_obs.py: a 2x2 dist_sync fleet with a SIGKILLed
+    worker must keep its LIVE observability plane: every surviving
+    role's OpenMetrics endpoint scrapes clean under the strict parser
+    with provably read-only scrapes (compile + device-sync counters
+    frozen across a scrape burst), cluster_live.json keeps refreshing
+    and names the dead rank while the survivor stays live, the run
+    ledger reconciles with the final telemetry counters, and the
+    sampler holds its overhead budget (see mxtpu/obs.py,
+    docs/observability.md §Live metrics)."""
+    out = _run(["tools/check_obs.py"], timeout=420)
+    assert "check_obs OK" in out
+
+
 @pytest.mark.slow
 def test_check_elastic_full_guard():
     """Full chaos gauntlet: SIGKILL one worker (respawned by

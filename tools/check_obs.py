@@ -1,0 +1,393 @@
+#!/usr/bin/env python
+"""mx.obs guard: the LIVE observability plane must survive chaos.
+
+Drives ONE real multi-process `dist_sync` run (tools/launch.py: 1
+scheduler + 2 servers + 2 workers, telemetry dir + run ledger armed,
+fast ``MXTPU_OBS_SAMPLE_S``) in which worker rank 1 SIGKILLs itself
+mid-round, and fails (rc=1) unless the live plane
+(`docs/observability.md` §Live metrics) held up:
+
+  1. **every role exposes a parseable endpoint** — all 5 roles'
+     OpenMetrics exporters are discovered (``obs_pid*.json``) and each
+     ``/metrics`` body passes the STRICT OpenMetrics parser
+     (``mx.obs.parse_openmetrics``: grammar, suffix rules, ``# EOF``);
+  2. **a scrape is read-only** — a burst of scrapes against a live
+     worker must not move its compile counter
+     (``mxtpu_inspect_compiles_total``) or its device-sync sample
+     counter (``mxtpu_perf_sync_samples_total``): scraping never
+     compiles and never syncs a device;
+  3. **live aggregation survives the kill** — ``cluster_live.json``
+     keeps refreshing DURING the run (refresh counter strictly
+     increases) and, after the SIGKILL, names ``worker1`` in its
+     ``dead`` list while ``worker0`` stays live;
+  4. **the run ledger reconciles** — one ``<run_id>.jsonl`` holds
+     sample rows from every role, summary rows from each surviving
+     role, NO summary from the SIGKILLed rank, and worker0's summary
+     counters agree exactly with its final
+     ``telemetry_worker0.json`` snapshot;
+  5. **sampler overhead under budget** — the median recorded
+     ``sample_wall_us`` stays under ``MXTPU_OBS_BUDGET_US``
+     (default 20000);
+  6. the launcher still exits nonzero (a SIGKILLed worker is a real
+     failure — the live plane must never paper over it).
+
+Usage: python tools/check_obs.py [--steps N]
+"""
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+BUDGET_US = float(os.environ.get("MXTPU_OBS_BUDGET_US", "20000"))
+
+
+# ---------------------------------------------------------------------------
+# child: one dist_sync training worker (run under tools/launch.py)
+# ---------------------------------------------------------------------------
+
+def run_worker(args):
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import telemetry
+    from mxtpu.io.io import DataBatch
+
+    kv = mx.kv.create("dist_sync")
+    rank = kv.rank
+
+    mx.random.seed(11)
+    x = mx.sym.Variable("data")
+    y = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(x, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(h, label=y, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Uniform(0.1))
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+
+    rng = np.random.RandomState(0)
+    for i in range(args.steps):
+        xb = rng.rand(4, 10).astype("float32")
+        yb = rng.randint(0, 3, (4,)).astype("float32")
+        mod.forward(DataBatch(data=[mx.nd.array(xb)],
+                              label=[mx.nd.array(yb)]), is_train=True)
+        mod.backward()
+        if rank == 1 and i + 1 == args.kill_step:
+            os.kill(os.getpid(), signal.SIGKILL)
+        mod.update()
+        time.sleep(args.step_sleep)
+
+    if rank == 0:
+        # hold the rendezvous until the kill was DECLARED, so the
+        # aggregator has time to observe worker1's endpoint dead
+        deadline = time.time() + 60
+        while kv.live_workers > 1 and time.time() < deadline:
+            time.sleep(0.2)
+    kv.barrier()
+    kv.close()
+    # deterministic ledger epilogue: final sample + summary BEFORE the
+    # final telemetry snapshot, so the reconciliation below compares
+    # two records of the same instant
+    mx.obs.stop()
+    telemetry.flush()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestration + live assertions
+# ---------------------------------------------------------------------------
+
+BASE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "MXTPU_PS_HEARTBEAT_INTERVAL": "0.2",
+    "MXTPU_DEAD_TIMEOUT": "1.5",
+    "MXTPU_OBS_SAMPLE_S": "0.2",
+    # chaos children must stay out of the shared persistent cache
+    # (SIGKILL mid-write poisons it; see check_telemetry.py)
+    "MXTPU_COMPILE_CACHE": "0",
+}
+
+
+def _get(url, timeout=3.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _discover(tdir):
+    """role-key -> endpoint dict from the obs_pid*.json files."""
+    out = {}
+    try:
+        names = sorted(os.listdir(tdir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("obs_pid") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(tdir, name)) as f:
+                d = json.load(f)
+            out["%s%d" % (d["role"], int(d["rank"]))] = d
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return out
+
+
+def _counter_value(fams, family, suffix="_total"):
+    info = fams.get(family)
+    if not info:
+        return None
+    total = 0.0
+    for name, labels, value in info["samples"]:
+        if name == family + suffix:
+            total += value
+    return total
+
+
+def run_check(args):
+    import subprocess
+
+    from mxtpu import obs
+
+    steps = args.steps
+    kill_step = max(3, steps // 3)
+    workdir = tempfile.mkdtemp(prefix="mxtpu_obs_")
+    tdir = os.path.join(workdir, "telemetry")
+    run_dir = os.path.join(workdir, "runs")
+    run_id = "checkobs%d" % os.getpid()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(BASE_ENV)
+    env["MXTPU_RUN_DIR"] = run_dir
+    env["MXTPU_RUN_ID"] = run_id
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "-s", "2", "--telemetry-dir", tdir,
+           sys.executable, os.path.abspath(__file__),
+           "--child", "worker", "--steps", str(steps),
+           "--kill-step", str(kill_step),
+           "--step-sleep", str(args.step_sleep)]
+    logp = os.path.join(workdir, "log")
+    failures = []
+    live_checks = {"scraped": set(), "parse_ok": set(),
+                   "readonly_ok": False, "refresh_seen": set(),
+                   "dead_marked": False, "live_with_dead": False}
+    with open(logp, "wb") as logf:
+        proc = subprocess.Popen(cmd, env=env, stdout=logf,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        deadline = time.time() + 240
+        cluster_path = os.path.join(tdir, "cluster_live.json")
+        try:
+            while proc.poll() is None and time.time() < deadline:
+                time.sleep(0.25)
+                # (1) scrape every discovered endpoint with the
+                # strict parser
+                for key, d in _discover(tdir).items():
+                    try:
+                        text = _get("http://127.0.0.1:%d/metrics"
+                                    % d["port"])
+                    except Exception:
+                        continue
+                    live_checks["scraped"].add(key)
+                    try:
+                        fams = obs.parse_openmetrics(text)
+                        if "mxtpu_obs" in fams:
+                            live_checks["parse_ok"].add(key)
+                    except ValueError as e:
+                        failures.append(
+                            "endpoint %s OpenMetrics REJECTED by the "
+                            "strict parser: %s" % (key, e))
+                        raise KeyboardInterrupt
+                # (2) scrape read-only burst, once, against worker0
+                if not live_checks["readonly_ok"]:
+                    d = _discover(tdir).get("worker0")
+                    if d is not None:
+                        live_checks["readonly_ok"] = _readonly_burst(
+                            d["port"], obs, failures)
+                # (3) live aggregation
+                try:
+                    with open(cluster_path) as f:
+                        cl = json.load(f)
+                    live_checks["refresh_seen"].add(cl.get("refreshes"))
+                    if "worker1" in cl.get("dead", []):
+                        live_checks["dead_marked"] = True
+                        if "worker0" in cl.get("live", []):
+                            live_checks["live_with_dead"] = True
+                except (OSError, ValueError):
+                    pass
+            if proc.poll() is None:
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+                failures.append("run HUNG past its deadline")
+            rc = proc.returncode
+        except KeyboardInterrupt:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
+            rc = proc.returncode
+
+    text = open(logp, "rb").read().decode(errors="replace")
+    if rc == 0:
+        failures.append("launcher exited 0 despite the SIGKILLed "
+                        "worker (obs must not mask failures)")
+
+    want_roles = {"scheduler0", "server0", "server1", "worker0",
+                  "worker1"}
+    missing = want_roles - live_checks["parse_ok"]
+    if missing:
+        failures.append("roles never scraped clean: %s (scraped: %s)"
+                        % (sorted(missing),
+                           sorted(live_checks["scraped"])))
+    if not live_checks["readonly_ok"]:
+        failures.append("could not demonstrate a read-only scrape "
+                        "burst (compile/sync counters moved or "
+                        "worker0 endpoint never answered)")
+    if len(live_checks["refresh_seen"]) < 2:
+        failures.append("cluster_live.json did not keep refreshing "
+                        "during the run (refresh ids seen: %s)"
+                        % sorted(live_checks["refresh_seen"]))
+    if not live_checks["dead_marked"]:
+        failures.append("cluster_live.json never marked worker1 dead")
+    elif not live_checks["live_with_dead"]:
+        failures.append("worker0 was not live while worker1 was "
+                        "marked dead (aggregation died with the rank)")
+
+    # (4) the run ledger
+    ledger = os.path.join(run_dir, run_id + ".jsonl")
+    if not os.path.exists(ledger):
+        failures.append("run ledger %s missing" % ledger)
+        print(text)
+        return failures
+    rows = obs.read_ledger(ledger)
+    stray = {"%s%s" % (r.get("role"), r.get("rank"))
+             for r in rows} - want_roles
+    if stray:
+        failures.append("ledger polluted by non-fleet producers: %s "
+                        "(merge/aggregator helpers must run with "
+                        "MXTPU_OBS=0)" % sorted(stray))
+    sample_roles = {"%s%s" % (r.get("role"), r.get("rank"))
+                    for r in rows if r.get("kind") == "sample"}
+    for want in want_roles:
+        if want not in sample_roles:
+            failures.append("ledger has no sample rows from %s (has "
+                            "%s)" % (want, sorted(sample_roles)))
+    summaries = {"%s%s" % (r.get("role"), r.get("rank")): r
+                 for r in rows if r.get("kind") == "summary"}
+    for want in ("scheduler0", "server0", "server1", "worker0"):
+        if want not in summaries:
+            failures.append("ledger has no summary row from surviving "
+                            "role %s" % want)
+    if "worker1" in summaries:
+        failures.append("SIGKILLed worker1 left a summary row — "
+                        "summaries must mean a clean exit")
+    # reconcile: worker0's summary counters vs its final telemetry
+    # snapshot (written immediately after obs.stop() in the child)
+    w0 = summaries.get("worker0")
+    tel_path = os.path.join(tdir, "telemetry_worker0.json")
+    if w0 is not None and os.path.exists(tel_path):
+        with open(tel_path) as f:
+            snap = json.load(f)
+        for key in ("telemetry_steps", "obs_samples"):
+            a = int((w0.get("counters") or {}).get(key, -1))
+            b = int((snap.get("stats") or {}).get(key, -2))
+            if a != b:
+                failures.append(
+                    "ledger summary does not reconcile with the final "
+                    "snapshot: %s %d (ledger) != %d (telemetry file)"
+                    % (key, a, b))
+        if int(w0.get("value", 0)) != steps:
+            failures.append("worker0 summary records %s steps, ran %d"
+                            % (w0.get("value"), steps))
+    elif w0 is not None:
+        failures.append("telemetry_worker0.json missing — cannot "
+                        "reconcile the ledger")
+
+    # (5) sampler overhead
+    walls = sorted(r["sample_wall_us"] for r in rows
+                   if r.get("kind") == "sample"
+                   and isinstance(r.get("sample_wall_us"),
+                                  (int, float)))
+    if not walls:
+        failures.append("no sample rows carry sample_wall_us")
+    else:
+        median = walls[len(walls) // 2]
+        if median > BUDGET_US:
+            failures.append("sampler median wall %.0fus exceeds the "
+                            "%.0fus budget" % (median, BUDGET_US))
+
+    if failures:
+        print(text)
+    return failures
+
+
+def _readonly_burst(port, obs, failures, tries=4):
+    """A burst of /metrics scrapes must leave the compile + sync-
+    sample counters untouched.  Retried: an early-run scrape can race
+    the training loop's OWN legitimate compiles — some attempt must
+    observe a fully quiet burst."""
+    for _ in range(tries):
+        try:
+            before = obs.parse_openmetrics(
+                _get("http://127.0.0.1:%d/metrics" % port))
+            for _ in range(3):
+                obs.parse_openmetrics(
+                    _get("http://127.0.0.1:%d/metrics" % port))
+            after = obs.parse_openmetrics(
+                _get("http://127.0.0.1:%d/metrics" % port))
+        except Exception:
+            return False
+        quiet = True
+        for fam in ("mxtpu_inspect_compiles",
+                    "mxtpu_perf_sync_samples"):
+            a = _counter_value(before, fam)
+            b = _counter_value(after, fam)
+            if a != b:
+                quiet = False
+        scr_a = _counter_value(before, "mxtpu_obs_scrapes")
+        scr_b = _counter_value(after, "mxtpu_obs_scrapes")
+        if quiet and scr_a is not None and scr_b is not None \
+                and scr_b > scr_a:
+            return True
+        time.sleep(0.3)
+    failures.append("every read-only burst attempt saw the compile/"
+                    "sync counters move (a scrape is compiling or "
+                    "syncing)")
+    return False
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=9)
+    ap.add_argument("--child", choices=["worker"])
+    ap.add_argument("--kill-step", type=int, default=3)
+    ap.add_argument("--step-sleep", type=float, default=0.25)
+    args = ap.parse_args()
+    if args.child == "worker":
+        return run_worker(args)
+    failures = run_check(args)
+    if failures:
+        print("check_obs FAILURES:")
+        for f in failures:
+            print("  -", f)
+        return 1
+    print("check_obs OK: a 2x2 dist_sync fleet with a SIGKILLed "
+          "worker kept every surviving role's OpenMetrics endpoint "
+          "scraping clean (strict parser, read-only), cluster_live."
+          "json refreshed throughout and named the dead rank, and the "
+          "run ledger reconciled with the final counters under the "
+          "sampler overhead budget")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Live terminal dashboard over the `mx.obs` cluster plane.
+
+Renders ``cluster_live.json`` — the file the ``tools/launch.py``
+aggregation sidecar rewrites every couple of seconds during a run —
+as a one-screen fleet view:
+
+  * one row per role-rank: steps, last/avg step time, MFU, dominant
+    phase, serve queue depth, anomaly / retry / failover tickers;
+  * a step-time sparkline per rank from the role's recent sample ring
+    (``MXTPU_OBS_SAMPLE_S`` cadence);
+  * the straggler: the live worker with the slowest average step time
+    is marked ``<``, and the worker MFU spread is printed;
+  * DEAD ranks (endpoint stopped answering mid-run — a SIGKILLed
+    worker) stay on the board, flagged, with their last known numbers.
+
+Usage::
+
+    python tools/dash.py --dir /tmp/run1/telemetry            # live
+    python tools/dash.py --dir /tmp/run1/telemetry --once     # 1 frame
+    python tools/dash.py --file cluster_live.json --once
+
+``--once`` prints a single frame and exits (CI / piping); the default
+loop redraws every ``--interval`` seconds until Ctrl-C.  No
+dependencies beyond the stdlib — works over ssh.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width=16):
+    """Min-max scaled unicode sparkline of the last ``width`` values
+    ('' when fewer than 2 points)."""
+    vals = [float(v) for v in values if v is not None][-width:]
+    if len(vals) < 2:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return SPARK[0] * len(vals)
+    return "".join(SPARK[min(len(SPARK) - 1,
+                             int((v - lo) / (hi - lo)
+                                 * (len(SPARK) - 1)))]
+                   for v in vals)
+
+
+def _fmt(v, spec="%s", dash="-"):
+    return (spec % v) if v not in (None, "") else dash
+
+
+def render(cluster, width=100):
+    """One dashboard frame (a list of lines) from a cluster_live
+    dict."""
+    lines = []
+    ts = cluster.get("ts", 0)
+    age = max(0.0, time.time() - ts) if ts else float("nan")
+    head = "mx.obs dash — run %s   refresh #%s   %.1fs ago   " \
+        "live %d / dead %d" % (
+            cluster.get("run_id") or "?", cluster.get("refreshes", "?"),
+            age, len(cluster.get("live", [])),
+            len(cluster.get("dead", [])))
+    lines.append(head)
+    lines.append("-" * min(width, max(60, len(head))))
+    roles = cluster.get("roles", {})
+    samples = cluster.get("samples", {})
+    dead = set(cluster.get("dead", []))
+    # the straggler: slowest live worker by avg step time
+    worker_avgs = {k: r.get("step_time_avg_ms") or 0
+                   for k, r in roles.items()
+                   if k.startswith("worker") and k not in dead
+                   and r.get("steps")}
+    straggler = max(worker_avgs, key=worker_avgs.get) \
+        if len(worker_avgs) >= 2 else None
+    lines.append("%-12s %7s %9s %9s %6s %-15s %6s %5s %5s %-16s"
+                 % ("rank", "steps", "step(ms)", "avg(ms)", "MFU",
+                    "phase", "queue", "anom", "retry", "step trend"))
+    for key in sorted(roles):
+        r = roles[key]
+        flags = ""
+        if key in dead:
+            flags = "  ** DEAD (endpoint stopped answering)"
+        elif key == straggler:
+            flags = "  < straggler"
+        tail = samples.get(key) or []
+        spark = sparkline([s.get("step_time_ms") for s in tail])
+        lines.append("%-12s %7s %9s %9s %6s %-15s %6s %5s %5s %-16s%s"
+                     % (key,
+                        _fmt(r.get("steps"), "%d"),
+                        _fmt(r.get("step_time_ms"), "%.1f"),
+                        _fmt(r.get("step_time_avg_ms"), "%.1f"),
+                        _fmt(r.get("mfu"), "%.3f"),
+                        _fmt(r.get("dominant_phase")),
+                        _fmt(r.get("queue_depth"), "%d"),
+                        _fmt(r.get("anomalies"), "%d"),
+                        _fmt(r.get("retries"), "%d"),
+                        spark, flags))
+    perf = cluster.get("perf", {})
+    health = cluster.get("health", {})
+    lines.append("-" * 60)
+    lines.append(
+        "MFU spread %s   retries %s   failovers %s   "
+        "serve queue %s   anomalies %s" % (
+            _fmt(perf.get("mfu_spread"), "%.3f"),
+            cluster.get("retry_total", 0),
+            cluster.get("failover_total", 0),
+            cluster.get("serve_queue_depth", 0),
+            health.get("anomaly_total", 0)))
+    gaps = cluster.get("merge_gaps")
+    if gaps:
+        lines.append("merge gaps: %s" % ", ".join(
+            g.get("file", "?") for g in gaps))
+    for key, blame in sorted((health.get("first_nonfinite")
+                              or {}).items()):
+        lines.append("nonfinite @ %s: layer %s step %s" % (
+            key, blame.get("layer"), blame.get("step")))
+    return lines
+
+
+def load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", help="telemetry dir holding "
+                                  "cluster_live.json")
+    ap.add_argument("--file", help="explicit cluster_live.json path")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (CI / piping)")
+    args = ap.parse_args(argv)
+    if not args.file and not args.dir:
+        ap.error("need --dir or --file")
+    path = args.file or os.path.join(args.dir, "cluster_live.json")
+    while True:
+        try:
+            cluster = load(path)
+        except (OSError, ValueError) as e:
+            if args.once:
+                print("dash: cannot read %s: %s" % (path, e),
+                      file=sys.stderr)
+                return 1
+            print("dash: waiting for %s (%s)" % (path, e),
+                  file=sys.stderr)
+            time.sleep(args.interval)
+            continue
+        frame = "\n".join(render(cluster))
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

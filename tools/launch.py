@@ -57,6 +57,54 @@ def _free_port() -> int:
     return port
 
 
+def _arm_obs(base, tdir):
+    """Arm the `mx.obs` live plane for the fleet: stamp ONE run id
+    into every role (so a ``MXTPU_RUN_DIR`` ledger gets one file per
+    run, all roles appending), and start the live-aggregation sidecar
+    that scrapes each role's OpenMetrics endpoint and rewrites
+    ``cluster_live.json`` DURING the run (`tools/dash.py` renders it).
+    Returns the sidecar Popen or None.  The sidecar is a consumer
+    only: telemetry + obs off, telemetry dir unset, so it never
+    pollutes the directory it aggregates."""
+    # EXACTLY base.getenv_bool's disabled spellings (the launcher
+    # never imports the framework, so the rule is replicated): the
+    # launcher and the roles must agree on whether the plane is off —
+    # a divergent spelling would spawn an aggregator over roles that
+    # never export, or roles that export with no aggregator/run id
+    if base.get("MXTPU_OBS") in ("0", "false", "False", "FALSE"):
+        return None
+    base.setdefault("MXTPU_RUN_ID", "run%d" % int(time.time()))
+    if not tdir:
+        return None
+    env = dict(base)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("MXTPU_TELEMETRY_DIR", None)
+    env["MXTPU_TELEMETRY"] = "0"
+    env["MXTPU_OBS"] = "0"
+    try:
+        return subprocess.Popen(
+            [sys.executable, "-c",
+             "import sys; from mxtpu import obs; "
+             "raise SystemExit(obs.aggregator_main(sys.argv[1]))",
+             tdir], env=env)
+    except OSError as e:
+        print("launch.py: obs aggregator failed to start: %s" % e,
+              file=sys.stderr, flush=True)
+        return None
+
+
+def _stop_obs(agg):
+    """Stop the aggregation sidecar (it writes one final pass)."""
+    if agg is None:
+        return
+    try:
+        agg.send_signal(signal.SIGTERM)
+        agg.wait(timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        agg.kill()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, default=0)
@@ -103,7 +151,12 @@ def main(argv=None):
                          "+ cluster.json (per-rank step time, "
                          "straggler spread, counter totals, and the "
                          "mx.perf rollup: per-rank MFU + dominant "
-                         "phase, worker MFU spread)")
+                         "phase, worker MFU spread).  Also arms the "
+                         "mx.obs LIVE plane: every role samples + "
+                         "serves an OpenMetrics endpoint, a sidecar "
+                         "rewrites cluster_live.json DURING the run "
+                         "(tools/dash.py renders it), and "
+                         "MXTPU_RUN_DIR appends a per-run ledger")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
@@ -127,10 +180,12 @@ def main(argv=None):
     })
     if args.pid_dir:
         os.makedirs(args.pid_dir, exist_ok=True)
+    tdir = None
     if args.telemetry_dir:
         tdir = os.path.abspath(args.telemetry_dir)
         os.makedirs(tdir, exist_ok=True)
         base["MXTPU_TELEMETRY_DIR"] = tdir
+    agg = _arm_obs(base, tdir)
 
     procs = []
 
@@ -213,6 +268,7 @@ def main(argv=None):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+        _stop_obs(agg)
     if args.telemetry_dir:
         _merge_telemetry(base, tdir)
     return rc
@@ -233,10 +289,12 @@ def _launch_serve(args):
     base["MXTPU_SERVE_PORTS"] = ",".join(str(p) for p in ports)
     if args.pid_dir:
         os.makedirs(args.pid_dir, exist_ok=True)
+    tdir = None
     if args.telemetry_dir:
         tdir = os.path.abspath(args.telemetry_dir)
         os.makedirs(tdir, exist_ok=True)
         base["MXTPU_TELEMETRY_DIR"] = tdir
+    agg = _arm_obs(base, tdir)
 
     procs = []
     for i in range(args.serve_replicas):
@@ -290,6 +348,7 @@ def _launch_serve(args):
                 p.wait(timeout=15)
             except subprocess.TimeoutExpired:
                 p.kill()
+        _stop_obs(agg)
     if args.telemetry_dir:
         _merge_telemetry(base, tdir)
     return rc
@@ -303,11 +362,14 @@ def _merge_telemetry(env, tdir):
     env = dict(env)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    # the merge helper must not be a telemetry PRODUCER: with the dir
-    # armed its own atexit flush would drop a telemetry_local0.json
-    # into the directory it just merged, polluting later re-merges
+    # the merge helper must not be a telemetry OR obs PRODUCER: with
+    # the dir armed its own atexit flush would drop a
+    # telemetry_local0.json into the directory it just merged (and an
+    # armed obs plane would append bogus local0 rows to the run
+    # ledger), polluting later re-merges and run diffs
     env.pop("MXTPU_TELEMETRY_DIR", None)
     env["MXTPU_TELEMETRY"] = "0"
+    env["MXTPU_OBS"] = "0"
     try:
         r = subprocess.run(
             [sys.executable, "-c",
