@@ -27,7 +27,7 @@ line):
   * the legacy per-step-dispatch fp32 number, so the dispatch-overhead
     win of the fused loop stays visible.
 
-Env knobs: MXTPU_BENCH_BATCH/WARMUP/ITERS/WINDOWS/SPP/SKIP_EXTRA,
+Env knobs: MXTPU_BENCH_BATCH/WARMUP/ITERS/WINDOWS/SPP/SKIP_EXTRA/NET,
 MXTPU_PEAK_TFLOPS.
 """
 import json
@@ -103,6 +103,10 @@ SPP = int(os.environ.get("MXTPU_BENCH_SPP", "16"))  # steps per program
 # downside; staging cost per program doubles but the bench loop
 # reuses a pre-staged stack (see run_config docstring)
 SKIP_EXTRA = os.environ.get("MXTPU_BENCH_SKIP_EXTRA", "0") == "1"
+# model-zoo net for the train bench; the recorded metric name follows,
+# so non-default nets are self-describing (the degraded-path CPU test
+# uses resnet18_v1 to keep its compile inside the tier-1 wall budget)
+NET = os.environ.get("MXTPU_BENCH_NET", "resnet50_v1")
 PEAK_TFLOPS = float(os.environ.get("MXTPU_PEAK_TFLOPS", "197"))
 TRAIN_GFLOP_PER_IMG = 12.3
 
@@ -114,7 +118,7 @@ def _build_module(batch, dtype):
 
     ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
     with mx.amp.scope(dtype if dtype != "float32" else None):
-        net = vision.resnet50_v1(classes=1000)
+        net = getattr(vision, NET)(classes=1000)
         net.initialize(ctx=ctx)
         x_trace = mx.nd.zeros((batch, 3, 224, 224), ctx=ctx)
         out_sym, _, _ = net._trace_symbol(x_trace)
@@ -379,7 +383,7 @@ def main():
     fp32, fp32_windows, fp32_stage_ms = run_config(
         BATCH, "float32", measure_stage=True)
     result = {
-        "metric": "resnet50_train_imgs_per_sec_bs%d" % BATCH,
+        "metric": "%s_train_imgs_per_sec_bs%d" % (NET.split("_v")[0], BATCH),
         "value": round(fp32, 2),
         "unit": "images/sec",
         "vs_baseline": round(fp32 / BASELINE_TRAIN_IMGS_PER_SEC, 3),

@@ -106,6 +106,7 @@ from . import operator
 from . import callback
 from . import profiler
 from . import telemetry
+from . import tracing
 from . import inspect
 from . import health
 from . import perf

@@ -88,6 +88,7 @@ from .base import (KVStoreTimeoutError, PSConnectError, ServerDiedError,
                    getenv)
 from . import resilience as _res
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 
 __all__ = ["Scheduler", "Server", "Worker", "role_from_env",
            "run_scheduler", "run_server"]
@@ -1146,12 +1147,26 @@ class Server(object):
         version still advances and the error is recorded so every worker
         sees it instead of deadlocking the round.  Called with self._cv
         held; mirrors the applied state to the chain successor."""
+        # the wire trace the triggering push stashed (read-and-clear:
+        # a later untraced completion must not inherit it); when
+        # sampled, the apply becomes a server_apply span whose id
+        # rides the replication item so the successor's replicate
+        # span parents under it
+        tr = getattr(self, "_cur_trace", None)
+        self._cur_trace = None
+        t0 = time.perf_counter()
         try:
             self._apply(key, merged)
         except Exception as e:
             self._errors[key] = "server updater failed for %r: %r" % (key, e)
             self._versions[key] = self._versions.get(key, 0) + 1
-        self._enqueue_repl_locked(key)
+        span_ctx = None
+        ctx = _tracing.parse(tr)
+        if ctx is not None and ctx.sampled:
+            span_ctx = _tracing.record_span(
+                ctx, "server_apply", time.perf_counter() - t0,
+                key=str(key), round=self._versions.get(key, 0))
+        self._enqueue_repl_locked(key, span_ctx)
 
     def _required_locked(self, target: int) -> int:
         """Contributors required to complete the round with version
@@ -1201,6 +1216,11 @@ class Server(object):
         wid = msg.get("worker")
         rnd = msg.get("round")
         with self._cv:
+            # stash the wire trace for whichever apply this push
+            # triggers (directly in async mode, via round completion
+            # in sync mode); unconditional so an untraced push clears
+            # a predecessor's leftover
+            self._cur_trace = msg.get("trace")
             if key not in self._store:
                 return {"error": "key %r not initialized on server" % (key,)}
             if wid is not None and wid in self._dead_wids:
@@ -1306,12 +1326,15 @@ class Server(object):
 
         return NDArray(np.array(state), ctx=cpu())
 
-    def _enqueue_repl_locked(self, key):
+    def _enqueue_repl_locked(self, key, trace_ctx=None):
         """Mirror the just-applied (value, version, updater state) to
         the chain successor.  Runs with self._cv held; the wait
         RELEASES the lock, bounding primary-ahead-of-replica staleness
         to MXTPU_PS_REPL_LAG outstanding applies without stalling the
-        server when the successor itself is down."""
+        server when the successor itself is down.  ``trace_ctx`` (the
+        server_apply span's `mx.tracing` context, when that apply was
+        sampled) rides the replication item so the successor's
+        replicate span joins the same trace."""
         if not self._repl_on or self._repl_down:
             return
         state = None
@@ -1322,12 +1345,14 @@ class Server(object):
             except Exception:
                 state = None
         self._repl_epoch += 1
-        self._repl_queue.append(
-            {"op": "replicate", "key": key,
-             "value": np.array(self._store[key]),
-             "version": self._versions.get(key, 0),
-             "state": state, "epoch": self._repl_epoch,
-             "from_rank": self.rank})
+        item = {"op": "replicate", "key": key,
+                "value": np.array(self._store[key]),
+                "version": self._versions.get(key, 0),
+                "state": state, "epoch": self._repl_epoch,
+                "from_rank": self.rank}
+        if trace_ctx is not None:
+            item["trace"] = trace_ctx.traceparent()
+        self._repl_queue.append(item)
         self._cv.notify_all()
         self._cv.wait_for(
             lambda: self._repl_down or self._shutdown or
@@ -1372,12 +1397,19 @@ class Server(object):
     def _replicate(self, msg):
         """Receiver side: store the predecessor's mirrored shard."""
         key = msg["key"]
+        t0 = time.perf_counter()
         with self._cv:
             self._replica[key] = np.array(msg["value"])
             self._replica_versions[key] = int(msg["version"])
             self._replica_state[key] = msg.get("state")
             self._replica_epoch[int(msg["from_rank"])] = \
                 int(msg.get("epoch", 0))
+            ctx = _tracing.parse(msg.get("trace"))
+            if ctx is not None and ctx.sampled:
+                _tracing.record_span(ctx, "replicate",
+                                     time.perf_counter() - t0,
+                                     key=str(key),
+                                     version=int(msg["version"]))
             return {"ok": True, "epoch": int(msg.get("epoch", 0))}
 
     def _promote(self, msg):
@@ -1409,11 +1441,19 @@ class Server(object):
 
     def _pull(self, msg):
         key, min_version = msg["key"], msg.get("min_version", 0)
+        t0 = time.perf_counter()
         with self._cv:
             while (key not in self._store
                    or self._versions.get(key, 0) < min_version) \
                     and not self._shutdown and key not in self._errors:
                 self._cv.wait()
+            # the pull span covers the round-completion WAIT — on a
+            # straggling round this segment IS the critical path
+            ctx = _tracing.parse(msg.get("trace"))
+            if ctx is not None and ctx.sampled:
+                _tracing.record_span(ctx, "server_pull",
+                                     time.perf_counter() - t0,
+                                     key=str(key))
             if key in self._errors:
                 return {"value": None, "error": self._errors[key]}
             if key not in self._store or \
@@ -1445,7 +1485,8 @@ class Server(object):
             ofs += b - a
         return self._push({"key": key, "value": dense, "sync": sync,
                            "worker": msg.get("worker"),
-                           "round": msg.get("round")})
+                           "round": msg.get("round"),
+                           "trace": msg.get("trace")})
 
     def _pull_rows(self, msg):
         """Row-subset pull (reference `src/kvstore/kvstore_dist.h`
@@ -1762,9 +1803,19 @@ class Worker(object):
         if sync:
             self._maybe_join(key)
         version = 0
+        # mx.tracing: a sampled ambient context (the trainer step's
+        # kvstore_push segment) rides the wire as a plain traceparent
+        # string so the server parents its apply span under it; the
+        # failover replay copy (saved below) carries the SAME trace —
+        # one round is one trace even across a server death
+        trc = _tracing.current()
+        tp = trc.traceparent() if trc is not None and trc.sampled \
+            else None
         for sidx, subkey, lo, hi in self._chunks(key, flat.size):
             msg = {"op": "push", "key": subkey, "value": flat[lo:hi],
                    "sync": sync, "worker": self.node_id}
+            if tp is not None:
+                msg["trace"] = tp
             if sync:
                 msg["round"] = max(self._last_version.get(subkey, 0),
                                    self._join_version) + 1
@@ -1802,14 +1853,18 @@ class Worker(object):
         straggler = _straggler_sec()
         if sync:
             self._maybe_join(key)
+        trc = _tracing.current()
+        tp = trc.traceparent() if trc is not None and trc.sampled \
+            else None
         for sidx, subkey, lo, hi in self._chunks(key, size):
             t0 = time.monotonic()
-            rep = self._server_request(
-                sidx, {"op": "pull", "key": subkey,
-                       "min_version":
-                       max(self._last_version.get(subkey, 0),
-                           self._join_version) if sync else 0},
-                timeout=timeout)
+            msg = {"op": "pull", "key": subkey,
+                   "min_version":
+                   max(self._last_version.get(subkey, 0),
+                       self._join_version) if sync else 0}
+            if tp is not None:
+                msg["trace"] = tp
+            rep = self._server_request(sidx, msg, timeout=timeout)
             if time.monotonic() - t0 > straggler:
                 _inc_stat("elastic_straggler_waits")
                 _telemetry.record("kvstore", op="straggler_wait",

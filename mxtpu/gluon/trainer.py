@@ -8,6 +8,7 @@ the kvstore ('device'/'tpu' = XLA collectives — see mxtpu/kvstore.py).
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Any, Dict, List, Optional
 
 from ..base import MXNetError
@@ -16,6 +17,7 @@ from .. import optimizer as opt_mod
 from .. import perf as _perf
 from .. import resilience as _res
 from .. import telemetry as _tel
+from .. import tracing as _tracing
 from ..ndarray.ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
@@ -191,22 +193,40 @@ class Trainer(object):
             # guard off: deferred no-stall grad monitoring on the
             # MXTPU_HEALTH_CHECK_EVERY cadence
             _health.monitor_grads("trainer", self._grad_vals)
+        # causal tracing (mx.tracing): head-sample this step; when
+        # sampled, the ambient context makes the perf phase hooks and
+        # the kvstore wire layer attach child spans (step ->
+        # collective/optimizer -> kvstore round -> server apply).
+        # step_trace() is one float compare when MXTPU_TRACE_SAMPLE=0.
+        trc = _tracing.step_trace()
+        if trc is not None:
+            _tracing.set_current(trc)
+            st0 = _time.perf_counter()
         # perf phase attribution (mx.perf): the two host-side segments
         # of a trainer step outside the compiled forward/backward —
         # gradient allreduce (collective) and the parameter update
         # (optimizer).  begin() is None when MXTPU_PERF=0.
-        pt0 = _perf.begin()
-        self._allreduce_grads()
-        if self._kvstore is not None:
-            _perf.note_phase_since("collective", pt0)
-        # opt-in per-layer grad/param-norm streaming (before the update
-        # so |Δw|/|w| pairs this step's grads with its pre-step params)
-        _health.maybe_stream_stats(
-            self._stats_triple, site="trainer",
-            scale=abs(self.learning_rate * self._optimizer.rescale_grad))
-        pt0 = _perf.begin()
-        self._update(ignore_stale_grad)
-        _perf.note_phase_since("optimizer", pt0)
+        try:
+            pt0 = _perf.begin()
+            self._allreduce_grads()
+            if self._kvstore is not None:
+                _perf.note_phase_since("collective", pt0)
+            # opt-in per-layer grad/param-norm streaming (before the
+            # update so |Δw|/|w| pairs this step's grads with its
+            # pre-step params)
+            _health.maybe_stream_stats(
+                self._stats_triple, site="trainer",
+                scale=abs(self.learning_rate
+                          * self._optimizer.rescale_grad))
+            pt0 = _perf.begin()
+            self._update(ignore_stale_grad)
+            _perf.note_phase_since("optimizer", pt0)
+        finally:
+            if trc is not None:
+                _tracing.set_current(None)
+                _tracing.record_span(
+                    trc, "step", _time.perf_counter() - st0, root=True,
+                    step=_tel.current_step())
         _tel.record_step(batch_size=batch_size, site="trainer")
 
     def _grad_vals(self):

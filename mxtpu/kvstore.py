@@ -32,6 +32,7 @@ store; ``pull`` broadcasts the stored value into the outputs
 from __future__ import annotations
 
 import pickle
+import time as _time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -39,6 +40,7 @@ import numpy as np
 from .base import KVStoreTimeoutError, MXNetError, getenv
 from .ndarray.ndarray import NDArray, zeros
 from . import resilience as _res
+from . import tracing as _tracing
 
 __all__ = ["KVStore", "KVStoreTimeoutError", "create"]
 
@@ -555,10 +557,29 @@ class KVStoreDist(KVStoreDevice):
             # server dedups nothing yet — multi-host idempotency is
             # future work).  Injected faults fire before the send, so
             # injection replay stays exact.
-            _res.guarded("kvstore_push", self._worker.push, k,
-                         merged.asnumpy(), sync=sync,
-                         timeout=_kvstore_timeout(),
-                         _retry_deadline=_wire_deadline())
+            # mx.tracing: the wire round is one child span of the
+            # ambient step trace; the CHILD context goes ambient so
+            # the PS worker stamps ITS span id on the wire and the
+            # server-side spans parent under this segment
+            trc = _tracing.current()
+            if trc is None:
+                _res.guarded("kvstore_push", self._worker.push, k,
+                             merged.asnumpy(), sync=sync,
+                             timeout=_kvstore_timeout(),
+                             _retry_deadline=_wire_deadline())
+            else:
+                kctx = trc.child()
+                t0 = _time.perf_counter()
+                try:
+                    with _tracing.use(kctx):
+                        _res.guarded("kvstore_push", self._worker.push,
+                                     k, merged.asnumpy(), sync=sync,
+                                     timeout=_kvstore_timeout(),
+                                     _retry_deadline=_wire_deadline())
+                finally:
+                    _tracing.record_span(kctx, "kvstore_push",
+                                         _time.perf_counter() - t0,
+                                         root=True, key=str(k))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if out is None:
@@ -567,10 +588,26 @@ class KVStoreDist(KVStoreDevice):
         for k, dsts in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % (k,))
-            arr = _res.guarded("kvstore_pull", self._worker.pull, k,
-                               sync=self._type != "dist_async",
-                               timeout=_kvstore_timeout(),
-                               _retry_deadline=_wire_deadline())
+            trc = _tracing.current()
+            if trc is None:
+                arr = _res.guarded("kvstore_pull", self._worker.pull,
+                                   k, sync=self._type != "dist_async",
+                                   timeout=_kvstore_timeout(),
+                                   _retry_deadline=_wire_deadline())
+            else:
+                kctx = trc.child()
+                t0 = _time.perf_counter()
+                try:
+                    with _tracing.use(kctx):
+                        arr = _res.guarded(
+                            "kvstore_pull", self._worker.pull, k,
+                            sync=self._type != "dist_async",
+                            timeout=_kvstore_timeout(),
+                            _retry_deadline=_wire_deadline())
+                finally:
+                    _tracing.record_span(kctx, "kvstore_pull",
+                                         _time.perf_counter() - t0,
+                                         root=True, key=str(k))
             src = NDArray(np.asarray(arr), ctx=dsts[0].ctx)
             for d in dsts:
                 if d.stype != "default":

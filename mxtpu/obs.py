@@ -65,6 +65,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .base import getenv, getenv_bool, getenv_int, getpid_cached
+from . import tracing as _tracing
 
 __all__ = [
     "enabled",
@@ -218,6 +219,11 @@ def sample() -> Optional[Dict[str, Any]]:
         row["dominant_phase"] = perf["dominant_phase"]
     if perf.get("phases_us_per_step"):
         row["phases_us_per_step"] = perf["phases_us_per_step"]
+    # the role's dominant critical-path segment (mx.tracing): which
+    # named span segment owns the largest share of sampled span time
+    tracing = m.get("tracing") or {}
+    if tracing.get("dominant_segment"):
+        row["critical_path"] = tracing["dominant_segment"]
     if serve:
         row["serve"] = {
             "queue_depth": serve.get("queue_depth", 0),
@@ -409,11 +415,18 @@ def openmetrics() -> str:
             ent = fams.get(fam)
         if ent is None:
             ent = fams[fam] = ("summary", [])
+        # mx.tracing exemplar: the slowest kept request's trace id
+        # rides the p99 quantile sample (`# {trace_id="..."} value`
+        # exemplar syntax) — p99 becomes clickable from Prometheus
+        ex = _tracing.exemplar(name)
         for q, k in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
             lab = dict(base)
             lab.update(labels)
             lab["quantile"] = q
-            ent[1].append((fam, lab, snap[k]))
+            if q == "0.99" and ex is not None:
+                ent[1].append((fam, lab, snap[k], ex))
+            else:
+                ent[1].append((fam, lab, snap[k]))
         lab = dict(base)
         lab.update(labels)
         ent[1].append((fam + "_count", lab, snap["count"]))
@@ -422,9 +435,15 @@ def openmetrics() -> str:
     lines: List[str] = []
     for fam, (mtype, rows) in fams.items():
         lines.append("# TYPE %s %s" % (fam, mtype))
-        for name, labels, value in rows:
-            lines.append("%s%s %s" % (name, _fmt_labels(labels),
-                                      _fmt_value(value)))
+        for row in rows:
+            name, labels, value = row[0], row[1], row[2]
+            line = "%s%s %s" % (name, _fmt_labels(labels),
+                                _fmt_value(value))
+            if len(row) > 3:
+                ex = row[3]
+                line += ' # {trace_id="%s"} %s' % (
+                    ex["trace_id"], _fmt_value(ex["value"]))
+            lines.append(line)
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
@@ -520,7 +539,11 @@ def parse_openmetrics(text: str) -> Dict[str, Dict[str, Any]]:
     ``<family>{quantile=..}``/``_count``/``_sum``, info
     ``<family>_info``), TYPE-before-samples ordering, duplicate
     TYPE/sample detection, float-parseable values, non-negative
-    counters, and the mandatory ``# EOF`` terminator.  Returns
+    counters, and the mandatory ``# EOF`` terminator.  Exemplars
+    (`` # {trace_id="..."} value [ts]`` after a sample, the
+    `mx.tracing` slowest-request annotation) are validated — label
+    syntax, float value, ≤2 trailing tokens, 32-hex ``trace_id`` —
+    and collected under the family's ``"exemplars"`` key.  Returns
     ``{family: {"type": ..., "samples": [(name, labels, value)]}}``.
     Raises ``ValueError`` naming the offending line on any
     violation."""
@@ -559,7 +582,13 @@ def parse_openmetrics(text: str) -> Dict[str, Dict[str, Any]]:
             continue
         if not line.strip():
             raise ValueError("line %d: blank line not allowed" % lineno)
-        # sample line: name[{labels}] value [timestamp]
+        # sample line: name[{labels}] value [ts] [# {exemplar} value]
+        # — split the exemplar off FIRST: its closing brace would
+        # otherwise be the rfind("}") the label parse anchors on
+        exemplar = None
+        if " # {" in line:
+            line, exraw = line.split(" # ", 1)
+            exemplar = _parse_exemplar(exraw, lineno)
         brace = line.find("{")
         if brace >= 0:
             name = line[:brace]
@@ -601,7 +630,46 @@ def parse_openmetrics(text: str) -> Dict[str, Dict[str, Any]]:
                              % (lineno, name, labels))
         seen_samples.add(sig)
         fams[fam]["samples"].append((name, labels, value))
+        if exemplar is not None:
+            # kept OFF the samples tuples so 3-tuple consumers of
+            # ``"samples"`` never see a surprise 4th element
+            fams[fam].setdefault("exemplars", []).append(
+                (name, labels, exemplar))
     return dict(fams)
+
+
+def _parse_exemplar(exraw: str, lineno: int) -> Dict[str, Any]:
+    """Validate one `` # {labels} value [ts]`` exemplar tail."""
+    exraw = exraw.strip()
+    if not exraw.startswith("{"):
+        raise ValueError("line %d: exemplar must start with '{', got "
+                         "%r" % (lineno, exraw))
+    close = exraw.rfind("}")
+    if close < 0:
+        raise ValueError("line %d: unbalanced exemplar braces" % lineno)
+    exlabels = _parse_labels(exraw[1:close], lineno)
+    tid = exlabels.get("trace_id")
+    if tid is not None:
+        if len(tid) != 32:
+            raise ValueError("line %d: exemplar trace_id must be 32 "
+                             "hex chars, got %r" % (lineno, tid))
+        try:
+            int(tid, 16)
+        except ValueError:
+            raise ValueError("line %d: exemplar trace_id %r is not "
+                             "hex" % (lineno, tid))
+    extoks = exraw[close + 1:].split()
+    if not extoks or len(extoks) > 2:
+        raise ValueError("line %d: exemplar needs a value (and at "
+                         "most a timestamp), got %r"
+                         % (lineno, exraw[close + 1:]))
+    try:
+        exval = float(extoks[0])
+    except ValueError:
+        raise ValueError("line %d: unparseable exemplar value %r"
+                         % (lineno, extoks[0]))
+    return {"labels": exlabels, "value": exval,
+            "ts": float(extoks[1]) if len(extoks) == 2 else None}
 
 
 # ---------------------------------------------------------------------------
@@ -1073,6 +1141,11 @@ def aggregate_once(directory: str,
                 m.get("examples_per_sec", 0.0), 1),
             "mfu": perf.get("mfu"),
             "dominant_phase": perf.get("dominant_phase"),
+            # the role's dominant critical-path segment from its
+            # mx.tracing sampled-span summary (the dash crit-path
+            # column)
+            "critical_path": (m.get("tracing") or {}).get(
+                "dominant_segment"),
             "queue_depth": serve.get("queue_depth", 0)
             if isinstance(serve, dict) else 0,
         }

@@ -288,6 +288,14 @@ def note_phase(phase: str, dur_s: float) -> None:
 
     _prof.set_stat("perf_%s_us_last" % phase, int(us))
     _hist("perf_phase_us::%s" % phase).record(us)
+    # when an mx.tracing context is ambient (a sampled trainer step),
+    # the phase doubles as a causal span — phase names ARE the span
+    # vocabulary, so spans and phase gauges reconcile by construction
+    from . import tracing as _tracing
+
+    trc = _tracing.current()
+    if trc is not None:
+        _tracing.record_span(trc, phase, dur_s)
 
 
 def note_phase_since(phase: str, t0: Optional[float]) -> None:

@@ -67,6 +67,7 @@ from .base import (MemoryExhaustedError, MXNetError, RequestShedError,
                    getenv, getenv_int)
 from . import compile_cache as _cc
 from . import perf as _perf
+from . import tracing as _tracing
 
 __all__ = [
     "Server",
@@ -155,15 +156,22 @@ class _Future(object):
 
 
 class _Request(object):
-    __slots__ = ("x", "n", "tenant", "future", "t_enq", "deadline")
+    __slots__ = ("x", "n", "tenant", "future", "t_enq", "deadline",
+                 "trace", "t_pop")
 
-    def __init__(self, x: np.ndarray, tenant: str, deadline: float):
+    def __init__(self, x: np.ndarray, tenant: str, deadline: float,
+                 trace=None):
         self.x = x
         self.n = int(x.shape[0])
         self.tenant = tenant
         self.future = _Future()
         self.t_enq = time.monotonic()
         self.deadline = deadline
+        # mx.tracing context from the frontend's traceparent header
+        # (None when the caller is untraced); t_pop marks when the
+        # batcher popped it — the queue_wait/batch_linger boundary
+        self.trace = trace
+        self.t_pop = 0.0
 
 
 class _ModelEntry(object):
@@ -367,7 +375,7 @@ class Server(object):
     # -- submission / admission control ------------------------------------
 
     def submit(self, model: str, x, tenant: str = "default",
-               timeout: Optional[float] = None) -> _Future:
+               timeout: Optional[float] = None, trace=None) -> _Future:
         """Enqueue rows for ``model`` and return the future.  ``x`` is
         one sample (``sample_shape``) or a batch of rows (leading
         batch dim).  Admission control runs HERE, on the caller's
@@ -400,7 +408,8 @@ class Server(object):
                 "model %r expects sample shape %s, got rows of %s"
                 % (model, entry.sample_shape, tuple(x.shape[1:])))
         budget = self.request_timeout_s if timeout is None else timeout
-        req = _Request(x, tenant, time.monotonic() + budget)
+        req = _Request(x, tenant, time.monotonic() + budget,
+                       trace=trace)
         with entry.cond:
             # checked UNDER the batcher's cond: the batcher exits its
             # loop holding this lock (queue empty + draining), so a
@@ -420,13 +429,13 @@ class Server(object):
         return req.future
 
     def infer(self, model: str, x, tenant: str = "default",
-              timeout: Optional[float] = None):
+              timeout: Optional[float] = None, trace=None):
         """Blocking :meth:`submit` — returns the output rows."""
         budget = self.request_timeout_s if timeout is None else timeout
         # result() gets slack over the queue deadline: an admitted
         # request that expires in-queue is shed by the BATCHER with
         # the typed error, which beats an opaque client TimeoutError
-        return self.submit(model, x, tenant, timeout) \
+        return self.submit(model, x, tenant, timeout, trace=trace) \
             .result(budget + 5.0)
 
     def _shed(self, entry: _ModelEntry, req: _Request, reason: str,
@@ -471,6 +480,7 @@ class Server(object):
             if expired:
                 self._shed(entry, req, "timeout")
                 continue
+            req.t_pop = time.monotonic()
             return req
         return None
 
@@ -543,6 +553,7 @@ class Server(object):
         # the per-program device split comes from the CachedOp hook
         # underneath
         pt0 = _perf.begin()
+        t_disp = time.monotonic()
         try:
             out = _res.guarded("serve", entry.predict, xs)
         except (MemoryExhaustedError, MemoryError) as e:
@@ -560,10 +571,11 @@ class Server(object):
                 entry.inflight_rows = 0
             _prof.set_stat("serve_inflight", self._inflight_rows())
         _perf.end("serve:%s" % entry.name, "serve", pt0)
-        self._fulfill(entry, batch, rows, bucket, out)
+        self._fulfill(entry, batch, rows, bucket, out, t_disp)
 
     def _fulfill(self, entry: _ModelEntry, batch: List[_Request],
-                 rows: int, bucket: int, out: Any) -> None:
+                 rows: int, bucket: int, out: Any,
+                 t_disp: float = 0.0) -> None:
         from . import profiler as _prof
 
         outs = out if isinstance(out, tuple) else (out,)
@@ -586,7 +598,32 @@ class Server(object):
             req.future._set_result(
                 sliced if isinstance(out, tuple) else sliced[0])
             off += req.n
-            entry.hist.record(now - req.t_enq)
+            lat = now - req.t_enq
+            entry.hist.record(lat)
+            # mx.tracing: the replica-side span tree — head-sampled,
+            # or RETRO-kept when the request beat this model's rolling
+            # p95 (the slow tail is always attributable); the segments
+            # end at their true instants via `ago`
+            if req.trace is not None and (
+                    req.trace.sampled or _tracing.slow_keep(
+                        "serve_latency_s::%s" % entry.name,
+                        entry.hist, lat)):
+                _tracing.note_exemplar(
+                    "serve_latency_s::%s" % entry.name,
+                    req.trace.trace_id, lat)
+                t_pop = req.t_pop or now
+                _tracing.record_span(
+                    req.trace, "queue_wait",
+                    max(0.0, t_pop - req.t_enq), ago=now - t_pop,
+                    model=entry.name)
+                if t_disp:
+                    _tracing.record_span(
+                        req.trace, "batch_linger",
+                        max(0.0, t_disp - t_pop), ago=now - t_disp,
+                        model=entry.name)
+                    _tracing.record_span(
+                        req.trace, "device", max(0.0, now - t_disp),
+                        model=entry.name, rows=rows, bucket=bucket)
         # an overwide single request dispatches raw (rows > bucket):
         # its effective width is rows, not the cap — never report >100%
         occupancy = 100.0 * rows / max(1, bucket, rows)
@@ -777,9 +814,14 @@ class HttpFrontend(object):
                 except Exception as e:
                     self._reply(400, {"error": "bad request: %s" % e})
                     return
+                # mx.tracing: continue the caller's trace (W3C
+                # traceparent header) through the batcher; malformed
+                # or absent headers mean an untraced request
+                trc = _tracing.parse(self.headers.get("traceparent"))
                 try:
                     out = srv.infer(model, data,
-                                    tenant=req.get("tenant", "default"))
+                                    tenant=req.get("tenant", "default"),
+                                    trace=trc)
                 except RequestShedError as e:
                     self._reply(503, {"error": str(e), "shed": True,
                                       "reason": e.reason,
@@ -790,10 +832,13 @@ class HttpFrontend(object):
                                       % (type(e).__name__, e)})
                     return
                 outs = out if isinstance(out, tuple) else (out,)
-                self._reply(200, {
+                reply = {
                     "output": outs[0].tolist() if len(outs) == 1
                     else [o.tolist() for o in outs],
-                    "replica": rank, "rows": int(outs[0].shape[0])})
+                    "replica": rank, "rows": int(outs[0].shape[0])}
+                if trc is not None:
+                    reply["trace"] = trc.trace_id
+                self._reply(200, reply)
 
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
@@ -893,12 +938,15 @@ class Client(object):
         self._cur = 0
         self._lock = threading.Lock()
 
-    def _post(self, url: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+    def _post(self, url: str, payload: Dict[str, Any],
+              trace=None) -> Dict[str, Any]:
         import urllib.request
 
+        headers = {"Content-Type": "application/json"}
+        if trace is not None:
+            headers["traceparent"] = trace.traceparent()
         req = urllib.request.Request(
-            url, data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"})
+            url, data=json.dumps(payload).encode(), headers=headers)
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
             return json.loads(r.read())
 
@@ -914,6 +962,11 @@ class Client(object):
         from . import telemetry as _tel
 
         payload = {"data": np.asarray(x).tolist(), "tenant": tenant}
+        # mx.tracing: ONE context for the whole call — a failover
+        # replay stamps the ORIGINAL trace id, so one user request is
+        # one trace fleet-wide no matter how many replicas it crossed
+        trc = _tracing.start_request()
+        t_req = time.monotonic()
         with self._lock:
             start = self._cur
         n = len(self.endpoints)
@@ -922,9 +975,12 @@ class Client(object):
             idx = (start + attempt) % n
             url = "%s/v1/%s:predict" % (self.endpoints[idx], model)
             try:
-                out = self._post(url, payload)
+                out = self._post(url, payload, trace=trc)
                 with self._lock:
                     self._cur = idx  # stickiness: stay on a live one
+                _tracing.finish_request(
+                    trc, time.monotonic() - t_req, name="client",
+                    model=model, replica=out.get("replica"))
                 return np.asarray(out["output"])
             except urllib.error.HTTPError as e:
                 detail = {}
@@ -950,12 +1006,16 @@ class Client(object):
                     # HTTPException, NOT an OSError) — replay it too
                     http.client.HTTPException) as e:
                 last_err = e
-            # this replica failed us: name it and move on
+            # this replica failed us: name it and move on (the trace
+            # id on the event ties the failover to the SAME trace the
+            # replay continues)
             _prof.inc_stat("serve_failover::serve%d" % idx)
             _tel.record("failover", site="serve",
                         replica="serve%d" % idx,
                         to="serve%d" % ((idx + 1) % n),
-                        error=type(last_err).__name__)
+                        error=type(last_err).__name__,
+                        trace=trc.trace_id if trc is not None
+                        else None)
             if attempt + 1 >= n:  # every replica seen at least once:
                 time.sleep(0.05 * (attempt // n + 1))  # back off a bit
         raise ConnectionError(

@@ -123,10 +123,14 @@ __all__ = [
 #: (``perf`` = an `mx.perf` sampled device-sync point: per-program
 #: host_dispatch/device_compute/wall spans + MFU when known, rendered
 #: as chrome-trace counter tracks by :func:`merge_dir`.)
+#: (``span`` = one finished `mx.tracing` causal span: trace/span/parent
+#: ids + name + ``dur_s``, ts = the span's END like ``step`` records;
+#: :func:`merge_dir` renders them as X spans and has
+#: ``tracing.stitch`` join cross-process traces with flow events.)
 EVENT_KINDS = ("step", "compile", "kvstore", "kvstore_round", "retry",
                "failover", "membership", "checkpoint", "monitor",
                "timeout", "flight", "anomaly", "tensor_stats", "serve",
-               "reshard", "perf")
+               "reshard", "perf", "span")
 
 #: ``profiler.stats()`` keys that are point-in-time gauges, not
 #: additive counters: cluster aggregation takes their MAX, and counter
@@ -1196,6 +1200,15 @@ def _events_to_chrome(snap: Dict[str, Any], t0: float) -> List[Dict]:
                             "ph": "C", "ts": ts_us, "pid": pid,
                             "tid": 0, "args": {"mfu": ev["mfu"]}})
             continue
+        if ev.get("kind") == "span" and dur:
+            # mx.tracing causal spans: same END-timestamp convention
+            # as step records; the trace id stays in args so the flow
+            # events tracing.stitch emits can be matched to these
+            start = max(0.0, ts_us - float(dur) * 1e6)
+            out.append({"name": ev.get("name", "span"), "cat": "trace",
+                        "ph": "X", "ts": start, "dur": ts_us - start,
+                        "pid": pid, "tid": 0, "args": args})
+            continue
         if ev.get("kind") == "step" and dur:
             # the record's ts is the step's END; when the start would
             # fall before the merged origin, clip the DURATION too so
@@ -1332,6 +1345,17 @@ def merge_dir(directory: str, out_trace: str = "merged_trace.json",
             if ev.get("ph") != "M" and "ts" in ev:
                 ev["ts"] = float(ev["ts"]) + shift_us
             trace_events.append(ev)
+    # mx.tracing: stitch the span records from every snapshot into
+    # chrome-trace flow events by trace id (lazy import — tracing
+    # imports telemetry at module level, not the other way around)
+    span_evs = [ev for s in snaps.values()
+                for ev in s.get("events", [])
+                if ev.get("kind") == "span"]
+    tracing_rollup = None
+    if span_evs:
+        from . import tracing as _tracing
+        flows, tracing_rollup = _tracing.stitch(span_evs, t0)
+        trace_events.extend(flows)
     merged = {"traceEvents": trace_events, "displayTimeUnit": "ms",
               "otherData": {"epoch_origin_s": t0}}
     _write_json(os.path.join(directory, out_trace), merged)
@@ -1382,6 +1406,10 @@ def merge_dir(directory: str, out_trace: str = "merged_trace.json",
         # spread is the straggler signal (one slow rank drags every
         # synchronous collective down to its speed)
         "perf": perf_rollup(snaps),
+        # causal-tracing rollup (mx.tracing): trace/span totals, how
+        # many traces crossed a process boundary, and the critical
+        # path of the largest stitched traces
+        "tracing": tracing_rollup,
         "flights": flights,
         # files that could not be merged (truncated by a SIGKILL,
         # torn, non-JSON): the survivors above are complete, and the

@@ -1,0 +1,561 @@
+"""End-to-end causal tracing: cross-process spans with critical-path
+and tail-latency attribution.
+
+Every observability plane so far answers "what is each process doing"
+— gauges (`mx.telemetry`), phases (`mx.perf`), live scrapes
+(`mx.obs`).  This module answers "where did THIS p99 request or THIS
+slow training round spend its time" across process boundaries:
+
+  * **Causal context** — a W3C-``traceparent``-style :class:`Context`
+    (32-hex trace id, 16-hex span id, sampled flag) propagated over
+    BOTH wire protocols: the serve HTTP path (`mx.serve.Client` stamps
+    the ``traceparent`` header; the frontend/batcher/dispatch continue
+    the trace) and the PS socket protocol (push/pull messages carry a
+    ``trace`` field into the server apply and chain-replication
+    spans).  In-process, the gluon Trainer opens a per-step span tree
+    (step → collective/optimizer/kvstore round) and parks the context
+    in a thread-local (:func:`current`) so the kvstore and `mx.perf`
+    phase hooks attach children without signature churn.
+
+  * **Spans on the telemetry ring** — each finished span is ONE
+    ``span`` record (:data:`telemetry.EVENT_KINDS`): trace/span/parent
+    ids, a name from the `mx.perf` phase vocabulary where one applies
+    (so spans and phase gauges reconcile), duration, and the existing
+    step/round correlation ids.  Spans ride the per-role telemetry
+    files; ``telemetry.merge_dir`` calls :func:`stitch` to join them
+    into chrome-trace flow events by trace id and a ``tracing`` rollup
+    in cluster.json.
+
+  * **Sampling** — head-based: :func:`start_request` /
+    :func:`step_trace` flip a deterministic per-process RNG
+    (``MXTPU_TRACE_SAMPLE``, default 0.01; ``MXTPU_TRACE_SEED`` pins
+    the decision sequence).  The tail-latency escape hatch is
+    RETRO-KEEP: an unsampled request still carries an (unsampled)
+    context, the completion site measures its wall, and anything over
+    the rolling per-window p95 (:func:`slow_keep`, fed by the
+    histogram the site already records into) gets its spans emitted
+    after the fact — p99s are always attributable even at a 1%% head
+    rate.  ``MXTPU_TRACE_SAMPLE=0`` (or ``MXTPU_TRACE=0``) reduces
+    every hook to one bool check (<10us/step budget, asserted by
+    ``tools/check_trace.py``).
+
+  * **Critical path** — :func:`critical_path` walks one stitched span
+    tree and attributes each span's SELF time (duration minus direct
+    children) to its segment name, yielding the dominant chain, e.g.
+    ``queue_wait 41% -> batch_linger 22% -> device 30%``
+    (``tools/trace_path.py`` is the CLI).  Per-role dominant segments
+    flow through the registered ``tracing`` metrics provider into
+    heartbeats, ``/snapshot.json`` and ``cluster_live.json`` (the
+    `tools/dash.py` crit-path column).
+
+See `docs/observability.md` §Tracing.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .base import getenv, getenv_bool
+
+__all__ = [
+    "Context",
+    "enabled",
+    "sample_rate",
+    "set_sample_rate",
+    "seed",
+    "start_request",
+    "step_trace",
+    "parse",
+    "current",
+    "set_current",
+    "use",
+    "record_span",
+    "finish_request",
+    "slow_keep",
+    "note_exemplar",
+    "exemplar",
+    "critical_path",
+    "stitch",
+    "metrics_block",
+    "reset",
+]
+
+_ENABLED = getenv_bool("MXTPU_TRACE", True)
+
+
+def _env_rate() -> float:
+    try:
+        return float(getenv("MXTPU_TRACE_SAMPLE", "0.01") or 0.01)
+    except ValueError:
+        return 0.01
+
+
+_RATE = _env_rate() if _ENABLED else 0.0
+
+# deterministic sampling under a fixed seed (tests / reproducing a
+# sampled run); unset = OS entropy
+_seed_env = getenv("MXTPU_TRACE_SEED")
+_rng = random.Random(int(_seed_env)) if _seed_env else random.Random()
+# id generation is SEPARATE from the sampling decision stream so a
+# fixed seed pins which calls sample without making every process
+# mint the same trace ids
+_idrng = random.Random(os.urandom(8))
+
+_lock = threading.Lock()
+_tls = threading.local()
+
+# per-role segment accumulators (name -> [count, sum_s, first_ts]) —
+# the metrics-provider / dash substrate
+_SEG: Dict[str, List[float]] = {}
+# counters mirrored into profiler.stats() too; kept here for the
+# metrics block so a heartbeat never needs the profiler
+_COUNTS = {"sampled": 0, "retro_kept": 0, "spans": 0}
+
+# slowest-kept-request exemplars per histogram name:
+# name -> {"trace_id", "value", "ts"} (the OpenMetrics exemplar store)
+_EXEMPLAR: Dict[str, Dict[str, Any]] = {}
+_EXEMPLAR_WINDOW_S = 60.0
+
+# rolling-p95 state per histogram name for retro-keep:
+# name -> [hist_state, p95_or_None, last_refresh_monotonic]
+_P95: Dict[str, list] = {}
+_P95_REFRESH_S = 2.0
+
+
+def enabled() -> bool:
+    """Tracing armed?  ``MXTPU_TRACE=0`` or ``MXTPU_TRACE_SAMPLE=0``
+    reduces every producer hook to one bool/float check."""
+    return _ENABLED and _RATE > 0.0
+
+
+def sample_rate() -> float:
+    return _RATE
+
+
+def set_sample_rate(rate: float) -> None:
+    """Flip the head-sampling rate at runtime (tests / check tools)."""
+    global _RATE
+    _RATE = max(0.0, min(1.0, float(rate)))
+
+
+def seed(n: int) -> None:
+    """Pin the sampling-decision stream (``MXTPU_TRACE_SEED``
+    equivalent): after ``seed(n)``, the sampled/unsampled sequence of
+    :func:`start_request` / :func:`step_trace` calls is
+    deterministic."""
+    global _rng
+    _rng = random.Random(int(n))
+
+
+class Context(object):
+    """One causal trace position: the trace id shared by every span of
+    one request/round fleet-wide, this hop's span id, the head-sample
+    decision, and (when continued from the wire) the parent span id."""
+
+    __slots__ = ("trace_id", "span_id", "sampled", "parent")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool,
+                 parent: Optional[str] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = bool(sampled)
+        self.parent = parent
+
+    def traceparent(self) -> str:
+        """W3C-style header/wire value:
+        ``00-<trace id>-<span id>-<01|00>``."""
+        return "00-%s-%s-%s" % (self.trace_id, self.span_id,
+                                "01" if self.sampled else "00")
+
+    def child(self) -> "Context":
+        """A new context one hop below this one (fresh span id, this
+        span id as the parent) — the value to put on the wire so the
+        remote side's spans parent under the local segment."""
+        return Context(self.trace_id, _new_id(16), self.sampled,
+                       parent=self.span_id)
+
+    def __repr__(self):
+        return "Context(%s)" % self.traceparent()
+
+
+def _new_id(nhex: int) -> str:
+    return "%0*x" % (nhex, _idrng.getrandbits(nhex * 4))
+
+
+def start_request(sampled: Optional[bool] = None) -> Optional[Context]:
+    """Open a trace for one client request.  Returns None only when
+    tracing is disabled; otherwise ALWAYS returns a context — an
+    unsampled one still rides the wire so the completion site can
+    retro-keep a slow tail (:func:`slow_keep`)."""
+    if not _ENABLED or _RATE <= 0.0:
+        return None
+    if sampled is None:
+        sampled = _rng.random() < _RATE
+    if sampled:
+        _COUNTS["sampled"] += 1
+    return Context(_new_id(32), _new_id(16), sampled)
+
+
+def step_trace() -> Optional[Context]:
+    """Head-sample one trainer step.  None unless this step sampled —
+    the unsampled path is one float compare plus one RNG draw, and
+    ``MXTPU_TRACE_SAMPLE=0`` short-circuits before the draw (the
+    <10us/step always-on budget)."""
+    if not _ENABLED or _RATE <= 0.0:
+        return None
+    if _rng.random() >= _RATE:
+        return None
+    _COUNTS["sampled"] += 1
+    return Context(_new_id(32), _new_id(16), True)
+
+
+def parse(tp: Any) -> Optional[Context]:
+    """``traceparent`` string -> :class:`Context`, or None on anything
+    malformed (an unparseable header must never fail a request)."""
+    if not tp or not isinstance(tp, str):
+        return None
+    parts = tp.strip().split("-")
+    if len(parts) != 4 or parts[0] != "00":
+        return None
+    tid, sid, flags = parts[1], parts[2], parts[3]
+    if len(tid) != 32 or len(sid) != 16 or len(flags) != 2:
+        return None
+    try:
+        int(tid, 16), int(sid, 16)
+        sampled = bool(int(flags, 16) & 1)
+    except ValueError:
+        return None
+    if not _ENABLED:
+        return None
+    return Context(tid.lower(), sid.lower(), sampled)
+
+
+# -- ambient context (trainer step -> kvstore/perf hooks) -----------------
+
+def current() -> Optional[Context]:
+    """The thread's ambient context (set by the Trainer around its
+    step, by the kvstore around a wire round) — how deep layers attach
+    child spans without threading a ctx argument through every
+    signature."""
+    return getattr(_tls, "ctx", None)
+
+
+def set_current(ctx: Optional[Context]) -> None:
+    _tls.ctx = ctx
+
+
+class use(object):
+    """``with tracing.use(ctx): ...`` — scoped :func:`set_current`
+    that restores the previous ambient context (None-safe)."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx: Optional[Context]):
+        self._ctx = ctx
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "ctx", None)
+        _tls.ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _tls.ctx = self._prev
+        return False
+
+
+# -- span emission --------------------------------------------------------
+
+def record_span(ctx: Optional[Context], name: str, dur_s: float,
+                root: bool = False, ago: float = 0.0,
+                **fields) -> Optional[Context]:
+    """Emit one finished span as a telemetry ``span`` record and
+    return the context OF THAT SPAN (chainable: pass its
+    ``.traceparent()`` downstream so the next hop parents here).
+
+    ``root=True`` records under ``ctx``'s own span id (the segment the
+    wire context names); default mints a child id under it.  ``ago``
+    shifts the span's END ``ago`` seconds before now — batch completion
+    sites emit queue_wait/linger/dispatch segments together at fulfill
+    time, each ending at its true instant.  Like ``step`` records, a
+    span record's ``ts`` is its END; renderers subtract ``dur_s``."""
+    if ctx is None:
+        return None
+    from . import telemetry as _tel
+
+    if root:
+        span_ctx = ctx
+    else:
+        span_ctx = Context(ctx.trace_id, _new_id(16), ctx.sampled,
+                           parent=ctx.span_id)
+    ev = _tel.record("span", name=name, dur_s=round(float(dur_s), 9),
+                     trace=span_ctx.trace_id, span=span_ctx.span_id,
+                     parent=span_ctx.parent, **fields)
+    if ev is not None and ago:
+        ev["ts"] = ev["ts"] - float(ago)
+    with _lock:
+        _COUNTS["spans"] += 1
+        acc = _SEG.get(name)
+        if acc is None:
+            acc = _SEG[name] = [0, 0.0, time.time()]
+        acc[0] += 1
+        acc[1] += float(dur_s)
+    from . import profiler as _prof
+
+    _prof.inc_stat("trace_spans")
+    return span_ctx
+
+
+# -- tail-latency retro-keep ---------------------------------------------
+
+def slow_keep(name: str, hist, value: float) -> bool:
+    """The always-sample-slow escape hatch: True when ``value``
+    exceeds the rolling per-window p95 of ``hist`` (a
+    :class:`telemetry.Histogram` the completion site records into
+    anyway).  The p95 refreshes from the histogram's interval window
+    at most every ``_P95_REFRESH_S`` seconds, so the steady-state cost
+    is one dict lookup and one float compare.  False until a first
+    window exists (nothing to be slow against)."""
+    now = time.monotonic()
+    with _lock:
+        st = _P95.get(name)
+        if st is None:
+            st = _P95[name] = [hist.state(), None, now]
+            return False
+        if now - st[2] >= _P95_REFRESH_S:
+            snap, st[0] = hist.interval(st[0])
+            if snap["count"]:
+                st[1] = snap["p95"]
+            st[2] = now
+        p95 = st[1]
+    if p95 is None or value <= p95:
+        return False
+    _COUNTS["retro_kept"] += 1
+    from . import profiler as _prof
+
+    _prof.inc_stat("trace_retro_keep")
+    return True
+
+
+_CLIENT_HIST = None
+
+
+def finish_request(ctx: Optional[Context], wall_s: float,
+                   name: str = "client", **fields) -> bool:
+    """Client-side request completion: keep the trace when it head-
+    sampled OR its wall beat the rolling p95 of this client's own
+    request history (retro-keep), and emit the ROOT span (the wall the
+    stitched tree reconciles against).  Returns whether it was kept."""
+    if ctx is None:
+        return False
+    global _CLIENT_HIST
+    if _CLIENT_HIST is None:
+        from . import telemetry as _tel
+
+        _CLIENT_HIST = _tel.histogram("trace_client_wall_s")
+    keep = ctx.sampled or slow_keep("trace_client_wall_s",
+                                    _CLIENT_HIST, wall_s)
+    _CLIENT_HIST.record(wall_s)
+    if keep:
+        record_span(ctx, name, wall_s, root=True,
+                    retro=None if ctx.sampled else True, **fields)
+    return keep
+
+
+# -- OpenMetrics exemplars ------------------------------------------------
+
+def note_exemplar(name: str, trace_id: str, value: float) -> None:
+    """Remember the slowest kept request for histogram ``name`` so the
+    OpenMetrics exposition (`mx.obs`) can attach its trace id as an
+    exemplar — p99 becomes clickable from Prometheus.  Keeps the max
+    value within a ``_EXEMPLAR_WINDOW_S`` window (an old record does
+    not pin the exemplar forever)."""
+    now = time.time()
+    with _lock:
+        cur = _EXEMPLAR.get(name)
+        if cur is None or value >= cur["value"] \
+                or now - cur["ts"] > _EXEMPLAR_WINDOW_S:
+            _EXEMPLAR[name] = {"trace_id": str(trace_id),
+                               "value": float(value), "ts": now}
+
+
+def exemplar(name: str) -> Optional[Dict[str, Any]]:
+    """The current exemplar for histogram ``name`` (or None)."""
+    with _lock:
+        cur = _EXEMPLAR.get(name)
+        return dict(cur) if cur else None
+
+
+# -- critical-path analysis ----------------------------------------------
+
+def _spans_of(events, trace_id: Optional[str]):
+    spans = [e for e in events if e.get("kind") == "span"
+             and e.get("trace") and e.get("dur_s") is not None]
+    if not spans:
+        return None, []
+    if trace_id is None:
+        by_trace: Dict[str, int] = {}
+        for s in spans:
+            by_trace[s["trace"]] = by_trace.get(s["trace"], 0) + 1
+        trace_id = max(by_trace, key=lambda t: by_trace[t])
+    return trace_id, [s for s in spans if s["trace"] == trace_id]
+
+
+def critical_path(events, trace_id: Optional[str] = None
+                  ) -> Optional[Dict[str, Any]]:
+    """Attribute one stitched span tree.  ``events`` is a list of span
+    records (telemetry events, possibly merged across roles);
+    ``trace_id=None`` picks the trace with the most spans.
+
+    Each span contributes its SELF time — duration minus its direct
+    children's durations, clamped at 0 (children on another process
+    clock may not nest exactly) — to its segment name, so the segment
+    sum reconciles with the root span's wall by construction.  Returns
+    ``{"trace", "wall_s", "spans", "pids", "segments": [{"name",
+    "self_s", "frac"}...] (by share, desc), "dominant", "chain"}``
+    where ``chain`` is the causal-order report string, e.g.
+    ``queue_wait 41% -> batch_linger 22% -> device 30%``.  None when
+    the trace has no spans."""
+    trace_id, spans = _spans_of(events, trace_id)
+    if not spans:
+        return None
+    by_id = {s.get("span"): s for s in spans if s.get("span")}
+    child_sum: Dict[str, float] = {}
+    for s in spans:
+        p = s.get("parent")
+        if p in by_id:
+            child_sum[p] = child_sum.get(p, 0.0) + float(s["dur_s"])
+    roots = [s for s in spans if s.get("parent") not in by_id]
+    wall = max((float(s["dur_s"]) for s in roots), default=0.0)
+    if wall <= 0.0:
+        wall = sum(float(s["dur_s"]) for s in spans) or 1e-12
+    segs: Dict[str, List[float]] = {}  # name -> [self_s, first_start]
+    for s in spans:
+        self_s = max(0.0, float(s["dur_s"])
+                     - child_sum.get(s.get("span"), 0.0))
+        start = float(s.get("ts", 0.0)) - float(s["dur_s"])
+        acc = segs.get(s.get("name", "span"))
+        if acc is None:
+            segs[s.get("name", "span")] = [self_s, start]
+        else:
+            acc[0] += self_s
+            acc[1] = min(acc[1], start)
+    ordered = sorted(segs.items(), key=lambda kv: kv[1][1])
+    chain = " -> ".join("%s %d%%" % (n, round(100.0 * v[0] / wall))
+                        for n, v in ordered if v[0] / wall >= 0.01)
+    by_share = sorted(segs.items(), key=lambda kv: -kv[1][0])
+    return {
+        "trace": trace_id,
+        "wall_s": wall,
+        "spans": len(spans),
+        "pids": len({s.get("pid") for s in spans}),
+        "segments": [{"name": n, "self_s": round(v[0], 6),
+                      "frac": round(v[0] / wall, 4)}
+                     for n, v in by_share],
+        "dominant": by_share[0][0] if by_share else None,
+        "chain": chain,
+    }
+
+
+# -- merge-time stitching (telemetry.merge_dir) ---------------------------
+
+def stitch(span_events: List[Dict[str, Any]], t0: float
+           ) -> Tuple[List[Dict], Dict[str, Any]]:
+    """Join span records from MANY per-role snapshots into chrome-trace
+    flow events (one ``s``/``t``/``f`` arrow chain per cross-process
+    trace id, binding the X spans `telemetry._events_to_chrome`
+    already emitted) plus the ``tracing`` rollup for cluster.json:
+    trace/span totals, how many traces crossed a process boundary, and
+    the critical path of the largest traces."""
+    flows: List[Dict] = []
+    by_trace: Dict[str, List[Dict]] = {}
+    for ev in span_events:
+        by_trace.setdefault(ev.get("trace"), []).append(ev)
+    by_trace.pop(None, None)
+    cross = 0
+    flow_id = 0
+    for tid, evs in sorted(by_trace.items()):
+        pids = {e.get("pid") for e in evs}
+        if len(pids) < 2:
+            continue
+        cross += 1
+        flow_id += 1
+        seq = sorted(evs, key=lambda e: float(e.get("ts", 0.0))
+                     - float(e.get("dur_s", 0.0)))
+        for i, ev in enumerate(seq):
+            start_us = (float(ev.get("ts", t0))
+                        - float(ev.get("dur_s", 0.0)) - t0) * 1e6
+            ph = "s" if i == 0 else ("f" if i == len(seq) - 1 else "t")
+            flow = {"name": "trace", "cat": "trace", "ph": ph,
+                    "id": flow_id, "ts": max(0.0, start_us),
+                    "pid": int(ev.get("pid", 0)), "tid": 0,
+                    "args": {"trace": tid}}
+            if ph == "f":
+                flow["bp"] = "e"
+            flows.append(flow)
+    biggest = sorted(by_trace.items(), key=lambda kv: -len(kv[1]))[:3]
+    paths = {}
+    for tid, evs in biggest:
+        cp = critical_path(evs, tid)
+        if cp:
+            paths[tid] = {"chain": cp["chain"],
+                          "dominant": cp["dominant"],
+                          "wall_s": round(cp["wall_s"], 6),
+                          "spans": cp["spans"], "pids": cp["pids"]}
+    rollup = {
+        "traces": len(by_trace),
+        "spans": sum(len(v) for v in by_trace.values()),
+        "cross_process_traces": cross,
+        "critical_paths": paths,
+    }
+    return flows, rollup
+
+
+# -- metrics provider (heartbeats / obs snapshot / cluster_live) ----------
+
+def metrics_block() -> Dict[str, Any]:
+    """This role's tracing summary for ``telemetry.metrics()`` (and
+    therefore heartbeats, ``/snapshot.json`` and cluster_live.json):
+    sample counters plus the LOCAL dominant critical-path segment —
+    which named segment owns the largest share of this role's sampled
+    span time (the `tools/dash.py` crit-path column)."""
+    with _lock:
+        segs = {n: v[1] for n, v in _SEG.items()}
+        counts = dict(_COUNTS)
+    out: Dict[str, Any] = {
+        "enabled": enabled(),
+        "sample_rate": _RATE,
+        "sampled": counts["sampled"],
+        "retro_kept": counts["retro_kept"],
+        "spans": counts["spans"],
+    }
+    total = sum(segs.values())
+    if total > 0.0:
+        top = sorted(segs.items(), key=lambda kv: -kv[1])[:3]
+        out["dominant_segment"] = "%s %d%%" % (
+            top[0][0], round(100.0 * top[0][1] / total))
+        out["critical_path"] = " -> ".join(
+            "%s %d%%" % (n, round(100.0 * v / total)) for n, v in top)
+        out["segments_s"] = {n: round(v, 6)
+                             for n, v in sorted(segs.items())}
+    return out
+
+
+def reset() -> None:
+    """Clear accumulators + exemplars + retro-keep windows (tests)."""
+    with _lock:
+        _SEG.clear()
+        _EXEMPLAR.clear()
+        _P95.clear()
+        for k in _COUNTS:
+            _COUNTS[k] = 0
+
+
+# register last: telemetry never imports tracing at module level, the
+# provider closes the loop (the mx.perf idiom)
+from . import telemetry as _tel  # noqa: E402
+
+_tel.register_metrics_provider("tracing", metrics_block)

@@ -19,6 +19,12 @@ def test_degraded_cpu_bench_emits_one_valid_json_line():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["MXTPU_BENCH_TPU_WAIT"] = "3"
+    # the contract is the degraded JSON record, not throughput: the
+    # smallest batch and the shallowest zoo resnet keep the CPU
+    # fallback's XLA compile inside the tier-1 wall budget (resnet50
+    # bs8 ran ~100s, bs2 ~58s, resnet18 bs2 ~25s — compile dominates)
+    env["MXTPU_BENCH_BATCH"] = "2"
+    env["MXTPU_BENCH_NET"] = "resnet18_v1"
     r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                        capture_output=True, text=True, timeout=540,
                        env=env, cwd=REPO)

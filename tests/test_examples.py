@@ -25,8 +25,11 @@ def _run(script, *extra, timeout=560):
 
 
 def test_train_imagenet_kvstore_tpu_8dev():
+    # 2 batches exercise the same compile + 8-device kvstore=tpu path
+    # as 4 did (the wall is compile-dominated); trimmed for the tier-1
+    # 870s suite budget
     out = _run("train_imagenet.py", "--benchmark", "1", "--num-epochs", "1",
-               "--max-batches", "4", "--batch-size", "16",
+               "--max-batches", "2", "--batch-size", "16",
                "--image-shape", "3,32,32", "--num-classes", "16",
                "--num-examples", "64", "--num-layers", "18",
                "--kv-store", "tpu", "--disp-batches", "2")
@@ -126,6 +129,8 @@ def test_example_text_cnn():
 
 
 def test_example_matrix_factorization():
+    # keep 5 epochs: the script itself asserts final MSE < 0.5x the
+    # first epoch's, which 2 epochs does not reach
     out = _run_example("recommenders/matrix_factorization.py",
                        "--epochs", "5")
     assert "MSE" in out
@@ -164,7 +169,9 @@ def test_example_quantization():
 
 
 def test_example_ssd_multibox_family():
-    out = _run_example("ssd/ssd_mini.py", "--epochs", "4",
+    # smoke (detection-count line presence); 2 epochs, trimmed for the
+    # tier-1 870s suite budget
+    out = _run_example("ssd/ssd_mini.py", "--epochs", "2",
                        "--det-threshold", "0.05")
     assert "detections per image" in out
 
@@ -189,8 +196,10 @@ def test_example_remat_composes_with_training():
 
 
 def test_example_neural_style():
+    # smoke (loss line presence; 15 steps still show loss falling
+    # 0.024 -> 0.003); trimmed for the tier-1 870s suite budget
     out = _run_example("neural-style/neural_style_mini.py",
-                       "--steps", "40")
+                       "--steps", "15")
     assert "loss" in out
 
 
@@ -209,7 +218,9 @@ def _final_metric(out, tag):
 def test_example_faster_rcnn():
     """Proposal -> ROIPooling -> cls+bbox heads must beat chance (1/3
     background-free classes) by a wide margin."""
-    out = _run_example("rcnn/faster_rcnn_mini.py", "--epochs", "6")
+    # 4 epochs land at 0.60 vs the 0.5 gate; trimmed for the tier-1
+    # 870s suite budget
+    out = _run_example("rcnn/faster_rcnn_mini.py", "--epochs", "4")
     assert _final_metric(out, "FINAL_ROI_ACCURACY") > 0.5
 
 
@@ -234,13 +245,17 @@ def test_example_ner():
 
 
 def test_example_capsnet():
-    out = _run_example("capsnet/capsnet_mini.py", "--epochs", "6",
+    # 4 epochs land at 0.727 vs the 0.55 gate (chance 1/3); trimmed
+    # for the tier-1 870s suite budget
+    out = _run_example("capsnet/capsnet_mini.py", "--epochs", "4",
                        timeout=560)
     assert _final_metric(out, "FINAL_ACCURACY") > 0.55  # chance = 1/3
 
 
 def test_example_captcha():
-    out = _run_example("captcha/captcha_cnn.py", "--epochs", "8",
+    # 5 epochs land at 0.768 vs the 0.6 gate (chance 0.1); trimmed
+    # for the tier-1 870s suite budget
+    out = _run_example("captcha/captcha_cnn.py", "--epochs", "5",
                        timeout=560)
     assert _final_metric(out, "FINAL_DIGIT_ACCURACY") > 0.6  # chance 0.1
 
@@ -252,13 +267,13 @@ def test_example_rbm():
 
 
 def test_example_sgld():
-    # 250 iters / 120 burn-in land at the same ~0.905 ensemble
-    # accuracy as the old 1000- and 400-iter runs (gate 0.8; the
+    # 100 iters / 60 burn-in land at the same ~0.90 ensemble accuracy
+    # as the old 1000-, 400- and 250-iter runs (gate 0.8; the
     # posterior ensemble converges early) — this eager per-op loop is
     # still among the slowest tier-1 tests, and the suite has to fit
     # its 870s wall budget
     out = _run_example("bayesian-methods/sgld_logistic.py",
-                       "--iters", "250", "--burn-in", "120")
+                       "--iters", "100", "--burn-in", "60")
     assert _final_metric(out, "FINAL_ENSEMBLE_ACCURACY") > 0.8
 
 
@@ -269,19 +284,25 @@ def test_example_dec():
 
 def test_example_lstnet():
     """LSTNet must beat the naive last-value forecaster (RSE < 1)."""
+    # 7 epochs land at RSE 0.48 vs the 0.95 gate; trimmed for the
+    # tier-1 870s suite budget
     out = _run_example("multivariate_time_series/lstnet_mini.py",
-                       "--epochs", "10", timeout=560)
+                       "--epochs", "7", timeout=560)
     assert _final_metric(out, "FINAL_RSE") < 0.95
 
 
 def test_example_char_cnn():
+    # 4 epochs land at 1.000 vs the 0.7 gate; trimmed for the tier-1
+    # 870s suite budget
     out = _run_example("cnn_chinese_text_classification/char_cnn.py",
-                       "--epochs", "6")
+                       "--epochs", "4")
     assert _final_metric(out, "FINAL_ACCURACY") > 0.7  # chance 1/3
 
 
 def test_example_vae_gan():
-    out = _run_example("vae-gan/vae_gan_mini.py", "--epochs", "4",
+    # 2 epochs land at recon 0.141 vs the 0.2 gate; trimmed for the
+    # tier-1 870s suite budget
+    out = _run_example("vae-gan/vae_gan_mini.py", "--epochs", "2",
                        timeout=560)
     assert _final_metric(out, "FINAL_PIXEL_RECON") < 0.2
 
@@ -302,7 +323,9 @@ def test_example_dsd():
 
 
 def test_example_kaggle_ndsb():
-    out = _run_example("kaggle-ndsb1/plankton_cnn.py", "--epochs", "5")
+    # 3 epochs land at logloss 0.358 vs the 0.8 gate; trimmed for the
+    # tier-1 870s suite budget
+    out = _run_example("kaggle-ndsb1/plankton_cnn.py", "--epochs", "3")
     assert _final_metric(out, "FINAL_LOGLOSS") < 0.8
 
 
@@ -310,7 +333,9 @@ def test_example_large_word_lm():
     """Sampled-softmax LM (reference example/rnn/large_word_lm): full
     validation perplexity over the 10k vocab must fall far below
     uniform (10000) with training cost independent of vocab size."""
-    out = _run_example("rnn/large_word_lm/train.py", "--epochs", "2",
+    # 1 epoch lands at PPL 3684 vs the 5000 gate (uniform 10000);
+    # trimmed for the tier-1 870s suite budget
+    out = _run_example("rnn/large_word_lm/train.py", "--epochs", "1",
                        timeout=560)
     assert _final_metric(out, "FINAL_VALID_PPL") < 5000
 
@@ -319,17 +344,21 @@ def test_example_factorization_machine():
     """FM on sparse features (reference example/sparse/
     factorization_machine): interactions-only labels — a linear model
     is stuck at the majority baseline (~0.76), the FM must crack 0.9."""
-    # 12 epochs land at 0.983 vs the 20-epoch 0.993 — both far past
-    # the 0.9 gate (linear baseline ~0.76); the shorter run keeps the
-    # tier-1 suite inside its wall budget
+    # 5 epochs land at 0.976 vs the 12-epoch 0.983 and 20-epoch 0.993
+    # — all far past the 0.9 gate (linear baseline ~0.76); the wall is
+    # compile-dominated, the shorter run keeps the tier-1 suite inside
+    # its wall budget
     out = _run_example("sparse/factorization_machine.py",
-                       "--epochs", "12", timeout=560)
+                       "--epochs", "5", timeout=560)
     assert _final_metric(out, "FINAL_ACCURACY") > 0.9
 
 
 def test_example_wide_deep():
     """Wide&Deep (reference example/sparse/wide_deep): joint arms must
     beat the majority baseline (~0.58) by a wide margin."""
+    # keep 10 epochs: the run is NOT shuffle-deterministic across
+    # processes and 6 epochs measured anywhere from 0.93 down to a
+    # stuck-at-majority 0.59 — 10 epochs has passed every round
     out = _run_example("sparse/wide_deep.py", "--epochs", "10",
                        timeout=560)
     assert _final_metric(out, "FINAL_ACCURACY") > 0.8
@@ -338,8 +367,10 @@ def test_example_wide_deep():
 def test_example_kaggle_ndsb2():
     """MRI-sequence volume regression (reference example/kaggle-ndsb2):
     CRPS must beat the predict-the-mean baseline (~0.22)."""
+    # 6 epochs land at CRPS 0.154 vs the 0.18 gate; trimmed for the
+    # tier-1 870s suite budget
     out = _run_example("kaggle-ndsb2/heart_volume_rnn.py",
-                       "--epochs", "10", timeout=560)
+                       "--epochs", "6", timeout=560)
     assert _final_metric(out, "FINAL_CRPS") < 0.18
 
 

@@ -1,0 +1,423 @@
+"""`mx.tracing` (`mxtpu/tracing.py`): end-to-end causal tracing —
+traceparent wire format, head sampling + slow-tail retro-keep, span
+trees over both wire protocols (serve HTTP in-process, PS sockets in a
+subprocess), critical-path attribution, merge-time stitching, and the
+OpenMetrics exemplar round-trip.  The full multi-process contract
+(2-replica serve fleet + 2x2 dist_sync with replication) lives in
+`tools/check_trace.py`, wired into `tests/test_tools.py`."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import telemetry, tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    rate = tracing.sample_rate()
+    tracing.set_current(None)
+    tracing.reset()
+    telemetry.clear()
+    yield
+    tracing.set_sample_rate(rate)
+    tracing.set_current(None)
+    tracing.reset()
+    telemetry.clear()
+
+
+def _spans():
+    return [e for e in telemetry.events() if e.get("kind") == "span"]
+
+
+# -- wire format ------------------------------------------------------------
+
+def test_traceparent_roundtrip():
+    ctx = tracing.Context("ab" * 16, "cd" * 8, True)
+    tp = ctx.traceparent()
+    assert tp == "00-%s-%s-01" % ("ab" * 16, "cd" * 8)
+    back = tracing.parse(tp)
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled is True
+    assert tracing.parse(ctx.__class__("ab" * 16, "cd" * 8,
+                                       False).traceparent()).sampled \
+        is False
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", 7, "01-%s-%s-01" % ("ab" * 16, "cd" * 8),   # version
+    "00-%s-%s" % ("ab" * 16, "cd" * 8),                   # 3 parts
+    "00-%s-%s-01" % ("ab" * 15, "cd" * 8),                # short tid
+    "00-%s-%s-01" % ("ab" * 16, "cd" * 7),                # short sid
+    "00-%s-%s-zz" % ("ab" * 16, "cd" * 8),                # bad flags
+    "00-%s-%s-01" % ("gg" * 16, "cd" * 8),                # non-hex
+])
+def test_parse_rejects_malformed(bad):
+    """An unparseable header must never fail a request — parse()
+    returns None for anything that is not a well-formed traceparent."""
+    assert tracing.parse(bad) is None
+
+
+def test_child_parents_under_local_span():
+    root = tracing.Context("ab" * 16, "cd" * 8, True)
+    kid = root.child()
+    assert kid.trace_id == root.trace_id
+    assert kid.parent == root.span_id
+    assert kid.span_id != root.span_id
+    assert kid.sampled is True
+
+
+# -- sampling ---------------------------------------------------------------
+
+def test_sampling_determinism_under_seed():
+    """tracing.seed() pins the sampled/unsampled DECISION stream
+    (MXTPU_TRACE_SEED) without pinning the id stream — two processes
+    with the same seed sample the same steps but mint distinct ids."""
+    tracing.set_sample_rate(0.2)
+    tracing.seed(42)
+    d1 = [tracing.step_trace() is not None for _ in range(80)]
+    ids1 = [c.trace_id for c in
+            (tracing.step_trace() for _ in range(80)) if c]
+    tracing.seed(42)
+    d2 = [tracing.step_trace() is not None for _ in range(80)]
+    ids2 = [c.trace_id for c in
+            (tracing.step_trace() for _ in range(80)) if c]
+    assert d1 == d2
+    assert any(d1) and not all(d1)
+    assert ids1 and ids2 and set(ids1).isdisjoint(ids2)
+
+
+def test_disabled_mode_zero_records_and_overhead():
+    """MXTPU_TRACE_SAMPLE=0: no contexts, no span records, and the
+    per-step probe stays far under the 10us always-on budget."""
+    tracing.set_sample_rate(0.0)
+    assert not tracing.enabled()
+    assert tracing.start_request() is None
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tracing.step_trace()
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 10e-6, "unsampled step_trace() %.2fus" \
+        % (per_call * 1e6)
+    assert _spans() == []
+    assert tracing.metrics_block()["spans"] == 0
+
+
+def test_record_span_noop_without_context():
+    assert tracing.record_span(None, "x", 0.1) is None
+    assert _spans() == []
+
+
+# -- slow-tail retro-keep ---------------------------------------------------
+
+def test_retro_keep_slow_tail(monkeypatch):
+    """An UNSAMPLED request whose wall beats the rolling p95 is kept
+    anyway (always-sample-slow): slow_keep() fires once a first
+    interval window exists, and finish_request() marks the kept root
+    span ``retro``."""
+    monkeypatch.setattr(tracing, "_P95_REFRESH_S", 0.0)
+    tracing.set_sample_rate(1.0)
+    hist = telemetry.histogram("rk_test_s")
+    assert tracing.slow_keep("rk_test_s", hist, 0.5) is False  # seeds
+    for _ in range(40):   # the p95 window: values AFTER the seed state
+        hist.record(0.010)
+    assert tracing.slow_keep("rk_test_s", hist, 0.005) is False
+    assert tracing.slow_keep("rk_test_s", hist, 0.5) is True
+    assert tracing.metrics_block()["retro_kept"] >= 1
+
+    # finish_request: unsampled ctx + slow wall -> kept, retro-marked
+    monkeypatch.setattr(tracing, "_CLIENT_HIST", None)
+    chist = telemetry.histogram("trace_client_wall_s")
+    tracing.slow_keep("trace_client_wall_s", chist, 0.01)  # seed window
+    for _ in range(40):
+        chist.record(0.010)
+    ctx = tracing.start_request(sampled=False)
+    assert tracing.finish_request(ctx, 0.9) is True
+    roots = [e for e in _spans() if e["name"] == "client"]
+    assert len(roots) == 1 and roots[0].get("retro") is True
+    # a fast unsampled request is NOT kept
+    assert tracing.finish_request(tracing.start_request(sampled=False),
+                                  0.001) is False
+    assert len([e for e in _spans() if e["name"] == "client"]) == 1
+
+
+# -- in-process span trees --------------------------------------------------
+
+def test_trainer_step_span_tree_reconciles():
+    """One sampled gluon Trainer step yields a root `step` span whose
+    children (the mx.perf phase spans + kvstore round) parent under it
+    on the SAME trace, with child durations bounded by the root wall —
+    the in-process half of the span/phase reconciliation."""
+    from mxtpu import autograd
+    from mxtpu.gluon import nn, Trainer
+
+    tracing.set_sample_rate(1.0)
+    net = nn.Sequential()
+    with net.name_scope():
+        net.add(nn.Dense(3))
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1}, kvstore="device")
+    x = mx.nd.ones((2, 4))
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    telemetry.clear()
+    trainer.step(2)
+    spans = _spans()
+    roots = [e for e in spans if e["name"] == "step"]
+    assert len(roots) == 1
+    root = roots[0]
+    assert root.get("parent") is None
+    kids = [e for e in spans if e is not root]
+    assert kids, "no child spans under the step root"
+    assert {e["trace"] for e in spans} == {root["trace"]}
+    assert all(e["parent"] == root["span"] for e in kids)
+    assert "optimizer" in {e["name"] for e in kids}
+    assert sum(e["dur_s"] for e in kids) <= root["dur_s"] * 1.05
+    assert tracing.current() is None  # ambient ctx restored
+
+
+def test_http_wire_propagation_in_process():
+    """serve HTTP: the client stamps `traceparent`, the replica's
+    queue_wait/batch_linger/device spans continue THAT trace parented
+    under the client root — over a real localhost HTTP round trip."""
+    tracing.set_sample_rate(1.0)
+    mx.random.seed(0)
+    from mxtpu.gluon import nn
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4))
+    net.initialize(mx.initializer.Xavier(rnd_type="uniform"))
+    net.hybridize()
+    srv = mx.serve.Server(max_batch=4, batch_wait_s=0.002)
+    try:
+        srv.add_model("m", net, input_shape=(3,))
+        front = mx.serve.HttpFrontend(srv, port=0).start()
+        client = mx.serve.Client(["127.0.0.1:%d" % front.port],
+                                 timeout=10)
+        t0 = time.monotonic()
+        out = client.predict("m", np.ones((2, 3), "f"))
+        wall = time.monotonic() - t0
+        assert out.shape == (2, 4)
+    finally:
+        srv.close()
+    spans = _spans()
+    roots = [e for e in spans if e["name"] == "client"]
+    assert len(roots) == 1
+    root = roots[0]
+    by_name = {e["name"]: e for e in spans}
+    assert {"client", "queue_wait", "batch_linger",
+            "device"} <= set(by_name)
+    assert {e["trace"] for e in spans} == {root["trace"]}
+    for name in ("queue_wait", "batch_linger", "device"):
+        assert by_name[name]["parent"] == root["span"]
+    # the root span IS the measured client wall
+    assert abs(root["dur_s"] - wall) <= 0.10 * wall + 1e-3
+    cp = tracing.critical_path(spans, root["trace"])
+    assert cp["dominant"] in ("client", "queue_wait", "batch_linger",
+                              "device")
+    assert abs(sum(s["self_s"] for s in cp["segments"])
+               - cp["wall_s"]) <= 0.10 * cp["wall_s"] + 1e-6
+
+
+def test_ps_wire_propagation_subprocess(tmp_path):
+    """PS sockets: a kvstore push/pull under an ambient step context
+    must land `server_apply` / `server_pull` spans on the SERVER
+    process carrying the worker's trace id — one 1x1 dist_sync fleet
+    via tools/launch.py."""
+    child = tmp_path / "child.py"
+    child.write_text(
+        "import mxtpu as mx\n"
+        "from mxtpu import telemetry, tracing\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "kv.init(3, mx.nd.zeros((4, 4)))\n"
+        "ctx = tracing.step_trace()\n"
+        "assert ctx is not None, 'sample rate 1 must sample'\n"
+        "with tracing.use(ctx):\n"
+        "    kv.push(3, mx.nd.ones((4, 4)))\n"
+        "    out = mx.nd.empty((4, 4))\n"
+        "    kv.pull(3, out=out)\n"
+        "print('TRACE', ctx.trace_id)\n"
+        "kv.barrier()\n"
+        "kv.close()\n"
+        "telemetry.flush()\n")
+    tdir = tmp_path / "tel"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["MXTPU_COMPILE_CACHE"] = "0"
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "1", "-s", "1", "--trace-sample", "1",
+         "--telemetry-dir", str(tdir), sys.executable, str(child)],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert res.returncode == 0, res.stdout + res.stderr
+    tid = [ln.split()[1] for ln in res.stdout.splitlines()
+           if ln.startswith("TRACE ")][0]
+    spans = []
+    for name in os.listdir(tdir):
+        if name.startswith("telemetry_") and name.endswith(".json"):
+            snap = json.load(open(os.path.join(tdir, name)))
+            spans += [e for e in snap.get("events") or []
+                      if e.get("kind") == "span"
+                      and e.get("trace") == tid]
+    names = {e["name"] for e in spans}
+    assert {"kvstore_push", "kvstore_pull", "server_apply",
+            "server_pull"} <= names, names
+    assert len({e["pid"] for e in spans}) >= 2  # worker AND server
+    # server spans parent under the worker's wire span ids
+    by_id = {e["span"]: e for e in spans}
+    for e in spans:
+        if e["name"] in ("server_apply", "server_pull"):
+            assert by_id[e["parent"]]["name"].startswith("kvstore_")
+
+
+# -- critical path + stitching ---------------------------------------------
+
+def _mk_span(trace, span, parent, name, dur, ts, pid=1):
+    return {"kind": "span", "trace": trace, "span": span,
+            "parent": parent, "name": name, "dur_s": dur, "ts": ts,
+            "pid": pid}
+
+
+def test_critical_path_self_time_attribution():
+    """Segments carry SELF time (children subtracted): a 100ms root
+    with 40ms + 30ms children attributes 30ms to itself, and the
+    segment sum reconciles with the wall exactly."""
+    t = "aa" * 16
+    evs = [
+        _mk_span(t, "r" * 16, None, "client", 0.100, 10.100, pid=1),
+        _mk_span(t, "b" * 16, "r" * 16, "queue_wait", 0.040, 10.042,
+                 pid=2),
+        _mk_span(t, "c" * 16, "r" * 16, "device", 0.030, 10.095,
+                 pid=2),
+    ]
+    cp = tracing.critical_path(evs)
+    assert cp["trace"] == t
+    assert cp["wall_s"] == pytest.approx(0.100)
+    assert cp["pids"] == 2
+    segs = {s["name"]: s["self_s"] for s in cp["segments"]}
+    assert segs["client"] == pytest.approx(0.030)
+    assert segs["queue_wait"] == pytest.approx(0.040)
+    assert sum(segs.values()) == pytest.approx(cp["wall_s"])
+    assert cp["dominant"] == "queue_wait"
+    # chain is causal (earliest start first), with percentages
+    assert cp["chain"].startswith("client 30% -> queue_wait 40%")
+    assert tracing.critical_path([], None) is None
+
+
+def test_stitch_flow_events_and_rollup():
+    """Cross-process traces become one chrome flow chain (s/t/f, one
+    id); single-process traces count in the rollup but draw no arrow."""
+    t0 = 100.0
+    cross, local = "ab" * 16, "cd" * 16
+    evs = [
+        _mk_span(cross, "a" * 16, None, "client", 0.05, 100.05, pid=1),
+        _mk_span(cross, "b" * 16, "a" * 16, "device", 0.02, 100.04,
+                 pid=2),
+        _mk_span(local, "c" * 16, None, "step", 0.01, 100.2, pid=3),
+    ]
+    flows, rollup = tracing.stitch(evs, t0)
+    assert rollup["traces"] == 2
+    assert rollup["spans"] == 3
+    assert rollup["cross_process_traces"] == 1
+    assert [f["ph"] for f in flows] == ["s", "f"]
+    assert len({f["id"] for f in flows}) == 1
+    assert flows[-1]["bp"] == "e"
+    assert cross in rollup["critical_paths"]
+    assert rollup["critical_paths"][cross]["dominant"]
+
+
+def test_merge_dir_stitches_cross_process_spans(tmp_path):
+    """telemetry.merge_dir folds per-role span records into
+    merged_trace.json (X spans + flow arrows) and a cluster.json
+    `tracing` rollup naming the critical path."""
+    t = "ee" * 16
+    base = time.time()
+    snaps = [
+        ("client", 0, 11, [_mk_span(t, "a" * 16, None, "client", 0.08,
+                                    base + 0.08, pid=11)]),
+        ("serve", 0, 22, [_mk_span(t, "b" * 16, "a" * 16, "device",
+                                   0.03, base + 0.06, pid=22)]),
+    ]
+    for role, rank, pid, evs in snaps:
+        path = tmp_path / ("telemetry_%s%d.json" % (role, rank))
+        path.write_text(json.dumps(
+            {"role": role, "rank": rank, "pid": pid, "ts": base,
+             "events": evs, "stats": {}, "metrics": {}}))
+    cluster = telemetry.merge_dir(str(tmp_path))
+    roll = cluster["tracing"]
+    assert roll["cross_process_traces"] == 1
+    assert roll["critical_paths"][t]["dominant"] == "client"
+    trace = json.load(open(tmp_path / "merged_trace.json"))
+    evs = trace["traceEvents"]
+    xs = [e for e in evs if e.get("cat") == "trace"
+          and e.get("ph") == "X"]
+    assert {e["name"] for e in xs} == {"client", "device"}
+    arrows = [e for e in evs if e.get("ph") in ("s", "t", "f")]
+    assert {a["pid"] for a in arrows} == {11, 22}
+
+
+# -- exemplars + metrics surface -------------------------------------------
+
+def test_openmetrics_exemplar_roundtrip():
+    """The serve SLO p99 row carries the slow request's trace id as an
+    OpenMetrics exemplar, and the strict parser validates + returns
+    it."""
+    from mxtpu import obs
+
+    hist = telemetry.histogram("exm_latency_s")
+    for v in (0.01, 0.02, 0.5):
+        hist.record(v)
+    tid = "fa" * 16
+    tracing.note_exemplar("exm_latency_s", tid, 0.5)
+    assert tracing.exemplar("exm_latency_s")["trace_id"] == tid
+    text = obs.openmetrics()
+    line = [ln for ln in text.splitlines()
+            if "exm_latency_s" in ln and 'quantile="0.99"' in ln][0]
+    assert '# {trace_id="%s"}' % tid in line
+    fams = obs.parse_openmetrics(text)
+    exs = fams["mxtpu_exm_latency_s"]["exemplars"]
+    assert any(ex["labels"]["trace_id"] == tid
+               and ex["value"] == pytest.approx(0.5)
+               for _, _, ex in exs)
+    # samples stay 3-tuples for existing consumers
+    assert all(len(s) == 3
+               for s in fams["mxtpu_exm_latency_s"]["samples"])
+
+
+def test_parse_openmetrics_rejects_corrupt_exemplar():
+    from mxtpu import obs
+
+    good = obs.openmetrics().splitlines()
+    bad = 'mxtpu_x_total{role="w",rank="0"} 1 # {trace_id="zz"} 0.5'
+    with pytest.raises(ValueError):
+        obs.parse_openmetrics("\n".join(good + [bad]))
+
+
+def test_metrics_block_names_dominant_segment():
+    """The tracing metrics provider rides telemetry.metrics() — the
+    dash's crit-path column and cluster_live roles get the dominant
+    segment without extra wiring."""
+    ctx = tracing.Context("ab" * 16, "cd" * 8, True)
+    tracing.record_span(ctx, "device", 0.09, root=True)
+    tracing.record_span(ctx, "queue_wait", 0.01)
+    block = telemetry.metrics()["tracing"]
+    assert block["spans"] == 2
+    assert block["dominant_segment"].startswith("device 90%")
+    assert block["critical_path"].startswith("device 90% -> ")
+    from mxtpu import obs
+
+    row = obs.sample()
+    assert row["critical_path"].startswith("device")

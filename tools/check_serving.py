@@ -33,6 +33,10 @@ import threading
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# full-rate trace sampling: the client span per request must not evict
+# the mid-run failover event from the telemetry ring before the
+# end-of-load trace assertions read it
+os.environ.setdefault("MXTPU_TELEMETRY_RING", "32768")
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
@@ -128,6 +132,7 @@ def main():
     })
     cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
            "--serve-replicas", "2", "--allow-serve-failures", "1",
+           "--trace-sample", "1",
            "--pid-dir", pid_dir, "--telemetry-dir", tdir,
            sys.executable, os.path.abspath(__file__),
            "--child", "serve", "--ready-dir", ready_dir]
@@ -143,6 +148,10 @@ def main():
         print("check_serving: 2 replicas up on %s" % endpoints)
 
         telemetry.set_identity(role="client", rank=0)
+        # head-sample every request: a failover replay must keep the
+        # ORIGINAL trace id (one user request == one trace)
+        from mxtpu import tracing
+        tracing.set_sample_rate(1.0)
         client = mx.serve.Client(endpoints, timeout=10)
         hist = telemetry.histogram("client_latency_s")
         results = []   # (x, out) pairs for the oracle check
@@ -194,6 +203,30 @@ def main():
         if fo < 1:
             failures.append("client never recorded a failover off "
                             "replica 0")
+
+        # tracing across the replay: the failover event must carry the
+        # request's trace id, and that trace must have exactly ONE
+        # client root span — the replay rides the original trace, it
+        # does NOT mint a second request
+        evs = telemetry.events()
+        fo_traces = [e.get("trace") for e in evs
+                     if e.get("kind") == "failover"
+                     and e.get("site") == "serve" and e.get("trace")]
+        if not fo_traces:
+            failures.append("no failover event carries a trace id")
+        else:
+            tid = fo_traces[0]
+            roots = [e for e in evs if e.get("kind") == "span"
+                     and e.get("name") == "client"
+                     and e.get("trace") == tid]
+            if len(roots) != 1:
+                failures.append(
+                    "failover trace %s has %d client root spans "
+                    "(want exactly 1: replay must not mint a new "
+                    "trace)" % (tid, len(roots)))
+            else:
+                print("check_serving: failover replay kept trace %s "
+                      "(1 client root span)" % tid)
 
         # oracle: every output must match the local model bit-for-bit
         oracle = build_model()

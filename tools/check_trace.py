@@ -1,0 +1,409 @@
+#!/usr/bin/env python
+"""Causal-tracing guard: one sampled request / training round must
+yield ONE stitched cross-process span tree, and unsampled tracing
+must cost (almost) nothing.
+
+Three parts, each against REAL multi-process fleets:
+
+  1. **serve**: a 2-replica `mx.serve` fleet (tools/launch.py
+     --serve-replicas 2 --trace-sample 1).  The parent plays the
+     client with 100% head sampling, times one request wall-clock,
+     and after the merge asserts the stitched tree for that trace id
+     covers client -> queue_wait -> batch_linger -> device across >=2
+     pids, that `mx.tracing.critical_path()` names a dominant segment,
+     and that the tree's segment sum reconciles with the measured
+     client wall within 10%.
+  2. **train**: a 2x2 `dist_sync` run (gluon Trainer, so step spans
+     set the ambient trace that the kvstore wire layer propagates)
+     with ``MXTPU_PS_REPLICATION=1``.  One training round must stitch
+     worker (step/kvstore_push) -> server (server_apply) -> replica
+     (replicate on the OTHER server pid) into a single trace.
+  3. **overhead**: with ``MXTPU_TRACE_SAMPLE=0`` the per-step cost of
+     `mx.tracing.step_trace()` must stay under 10us and emit ZERO
+     span records.
+
+Usage: python tools/check_trace.py [--steps N] [--requests N]
+"""
+import argparse
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SEED = 7
+SAMPLE = (10,)
+
+BASE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "MXTPU_PS_HEARTBEAT_INTERVAL": "0.2",
+    "MXTPU_DEAD_TIMEOUT": "1.5",
+    # guard children stay out of the shared persistent compile cache
+    "MXTPU_COMPILE_CACHE": "0",
+}
+
+
+def build_model():
+    import mxtpu as mx
+    from mxtpu.gluon import nn
+
+    mx.random.seed(SEED)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.initializer.Xavier(rnd_type="uniform"))
+    net.hybridize()
+    return net
+
+
+# ---------------------------------------------------------------------------
+# children
+# ---------------------------------------------------------------------------
+
+def run_replica(args):
+    import mxtpu as mx
+
+    def build(server):
+        server.add_model("mlp", build_model(), input_shape=SAMPLE)
+
+    rank = int(os.environ.get("MXTPU_SERVE_RANK", "0"))
+    ready = os.path.join(args.ready_dir, "ready-%d.port" % rank) \
+        if args.ready_dir else None
+    mx.serve.serve_forever(build, ready_file=ready)
+    return 0
+
+
+def run_worker(args):
+    """One dist_sync gluon-Trainer worker: `trainer.step()` opens the
+    step span, which the kvstore wire layer propagates to the
+    servers (server_apply) and their replicas (replicate)."""
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import autograd, telemetry
+    from mxtpu.gluon import nn, Trainer
+
+    kv = mx.kv.create("dist_sync")
+    mx.random.seed(11)
+    net = nn.Sequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize(mx.initializer.Uniform(0.1))
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05}, kvstore=kv)
+    rng = np.random.RandomState(kv.rank)
+    for _ in range(args.steps):
+        xb = mx.nd.array(rng.rand(4, 10).astype("float32"))
+        yb = mx.nd.array(rng.rand(4, 3).astype("float32"))
+        with autograd.record():
+            loss = ((net(xb) - yb) ** 2).mean()
+        loss.backward()
+        trainer.step(4)
+    kv.barrier()
+    kv.close()
+    telemetry.flush()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent helpers
+# ---------------------------------------------------------------------------
+
+def _wait_ports(ready_dir, n, deadline_s=120):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        ports = {}
+        for i in range(n):
+            path = os.path.join(ready_dir, "ready-%d.port" % i)
+            try:
+                ports[i] = int(open(path).read())
+            except (OSError, ValueError):
+                break
+        if len(ports) == n:
+            return ports
+        time.sleep(0.1)
+    raise RuntimeError("replicas not ready within %ds" % deadline_s)
+
+
+def _span_events(tdir):
+    """All span records from the per-role telemetry dumps in a
+    telemetry dir, each annotated with its writer's pid."""
+    spans = []
+    for path in sorted(glob.glob(os.path.join(tdir, "telemetry_*.json"))):
+        try:
+            snap = json.load(open(path))
+        except (OSError, ValueError):
+            continue
+        pid = snap.get("pid")
+        for ev in snap.get("events") or []:
+            if ev.get("kind") == "span":
+                ev = dict(ev)
+                ev.setdefault("pid", pid)
+                spans.append(ev)
+    return spans
+
+
+def _launch(cmd, env, workdir, tag):
+    logf = open(os.path.join(workdir, "log_" + tag), "wb")
+    proc = subprocess.Popen(cmd, env=env, stdout=logf,
+                            stderr=subprocess.STDOUT,
+                            start_new_session=True)
+    return proc, logf
+
+
+def _reap(proc, logf, timeout, failures, tag):
+    try:
+        rc = proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait()
+        failures.append("%s: launcher hung past %ds" % (tag, timeout))
+        rc = -9
+    finally:
+        logf.close()
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# part 1: serve fleet
+# ---------------------------------------------------------------------------
+
+def check_serve(args, workdir, failures):
+    import mxtpu as mx
+    from mxtpu import telemetry, tracing
+
+    tdir = os.path.join(workdir, "tel_serve")
+    pid_dir = os.path.join(workdir, "pids")
+    ready_dir = os.path.join(workdir, "ready")
+    os.makedirs(ready_dir, exist_ok=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(BASE_ENV)
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "--serve-replicas", "2", "--trace-sample", "1",
+           "--pid-dir", pid_dir, "--telemetry-dir", tdir,
+           sys.executable, os.path.abspath(__file__),
+           "--child", "serve", "--ready-dir", ready_dir]
+    launcher, logf = _launch(cmd, env, workdir, "serve")
+    wall = trace_id = None
+    try:
+        ports = _wait_ports(ready_dir, 2)
+        endpoints = ["127.0.0.1:%d" % ports[i] for i in sorted(ports)]
+        assert mx.serve.wait_ready(endpoints, 60, ["mlp"]), \
+            "healthz never came up"
+
+        telemetry.set_identity(role="client", rank=0)
+        tracing.set_sample_rate(1.0)   # head-sample every request
+        import numpy as np
+        client = mx.serve.Client(endpoints, timeout=10)
+        x = np.random.RandomState(0).rand(2, *SAMPLE).astype("float32")
+        for _ in range(max(1, args.requests)):
+            t0 = time.monotonic()
+            client.predict("mlp", x)
+            wall = time.monotonic() - t0
+        # the client root span of the LAST request carries the trace id
+        roots = [ev for ev in telemetry.events()
+                 if ev.get("kind") == "span" and ev.get("name") == "client"]
+        if not roots:
+            failures.append("serve: client recorded no root span")
+        else:
+            trace_id = roots[-1]["trace"]
+        telemetry.flush(tdir)
+        for i in (0, 1):   # drain both replicas so the launcher merges
+            pid = int(open(os.path.join(pid_dir,
+                                        "serve-%d.pid" % i)).read())
+            os.kill(pid, signal.SIGTERM)
+        rc = _reap(launcher, logf, 120, failures, "serve")
+        if rc != 0:
+            failures.append("serve: launcher exited %d" % rc)
+    finally:
+        if launcher.poll() is None:
+            try:
+                os.killpg(launcher.pid, signal.SIGKILL)
+            except OSError:
+                launcher.kill()
+            launcher.wait()
+        tracing.set_sample_rate(0.01)
+
+    if trace_id is None:
+        return
+    spans = [ev for ev in _span_events(tdir)
+             if ev.get("trace") == trace_id]
+    names = {ev.get("name") for ev in spans}
+    pids = {ev.get("pid") for ev in spans}
+    want = {"client", "queue_wait", "batch_linger", "device"}
+    if not want <= names:
+        failures.append("serve: stitched tree %s missing %s"
+                        % (trace_id, sorted(want - names)))
+    if len(pids) < 2:
+        failures.append("serve: trace %s does not cross processes "
+                        "(pids=%s)" % (trace_id, sorted(pids)))
+    cp = tracing.critical_path(spans, trace_id)
+    if cp is None or not cp.get("dominant"):
+        failures.append("serve: critical_path() named no dominant "
+                        "segment for %s" % trace_id)
+    else:
+        seg_sum = sum(s["self_s"] for s in cp["segments"])
+        drift = abs(seg_sum - wall) / max(wall, 1e-9)
+        print("check_trace: serve trace %s wall=%.1fms tree=%.1fms "
+              "(drift %.1f%%) chain: %s"
+              % (trace_id, wall * 1e3, seg_sum * 1e3, drift * 100,
+                 cp["chain"]))
+        if drift > 0.10:
+            failures.append("serve: tree segment sum %.4fs vs client "
+                            "wall %.4fs drifts %.0f%% (>10%%)"
+                            % (seg_sum, wall, drift * 100))
+    try:
+        cluster = json.load(open(os.path.join(tdir, "cluster.json")))
+    except (OSError, ValueError) as e:
+        failures.append("serve: cluster.json unreadable: %s" % e)
+        return
+    roll = cluster.get("tracing") or {}
+    if roll.get("cross_process_traces", 0) < 1:
+        failures.append("serve: cluster.json tracing rollup shows no "
+                        "cross-process trace: %s" % roll)
+
+
+# ---------------------------------------------------------------------------
+# part 2: dist_sync training round
+# ---------------------------------------------------------------------------
+
+def check_train(args, workdir, failures):
+    from mxtpu import tracing
+
+    tdir = os.path.join(workdir, "tel_train")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(BASE_ENV)
+    env["MXTPU_PS_REPLICATION"] = "1"
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "-s", "2", "--trace-sample", "1",
+           "--telemetry-dir", tdir,
+           sys.executable, os.path.abspath(__file__),
+           "--child", "worker", "--steps", str(args.steps)]
+    launcher, logf = _launch(cmd, env, workdir, "train")
+    rc = _reap(launcher, logf, 300, failures, "train")
+    if rc != 0:
+        failures.append("train: launcher exited %d" % rc)
+
+    spans = _span_events(tdir)
+    by_trace = {}
+    for ev in spans:
+        by_trace.setdefault(ev.get("trace"), []).append(ev)
+    # one round must stitch worker -> server -> replica
+    best = None
+    for tid, evs in by_trace.items():
+        names = {e.get("name") for e in evs}
+        if {"step", "kvstore_push", "server_apply"} <= names:
+            best = (tid, evs, names)
+            if "replicate" in names:
+                break
+    if best is None:
+        failures.append("train: no trace stitches step + kvstore_push "
+                        "+ server_apply (traces: %s)"
+                        % {t: sorted({e.get('name') for e in evs})
+                           for t, evs in list(by_trace.items())[:4]})
+        return
+    tid, evs, names = best
+    if "replicate" not in names:
+        failures.append("train: trace %s never reached the replica "
+                        "(names=%s)" % (tid, sorted(names)))
+        return
+    apply_pids = {e.get("pid") for e in evs
+                  if e.get("name") == "server_apply"}
+    repl_pids = {e.get("pid") for e in evs
+                 if e.get("name") == "replicate"}
+    if not (repl_pids - apply_pids):
+        failures.append("train: replicate spans landed on the applying "
+                        "server itself (apply=%s repl=%s)"
+                        % (sorted(apply_pids), sorted(repl_pids)))
+    worker_pids = {e.get("pid") for e in evs if e.get("name") == "step"}
+    pids = {e.get("pid") for e in evs}
+    if len(pids) < 2 or not worker_pids:
+        failures.append("train: trace %s not cross-process (pids=%s)"
+                        % (tid, sorted(pids)))
+    cp = tracing.critical_path(evs, tid)
+    if cp is None or not cp.get("dominant"):
+        failures.append("train: critical_path() named no dominant "
+                        "segment for %s" % tid)
+    else:
+        print("check_trace: train trace %s spans %d pids (%s); "
+              "chain: %s" % (tid, len(pids), sorted(names),
+                             cp["chain"]))
+
+
+# ---------------------------------------------------------------------------
+# part 3: unsampled overhead
+# ---------------------------------------------------------------------------
+
+def check_overhead(args, failures):
+    from mxtpu import telemetry, tracing
+
+    tracing.set_sample_rate(0.0)
+    before = sum(1 for e in telemetry.events()
+                 if e.get("kind") == "span")
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tracing.step_trace()
+    per_call = (time.perf_counter() - t0) / n
+    after = sum(1 for e in telemetry.events()
+                if e.get("kind") == "span")
+    print("check_trace: unsampled step_trace() costs %.3fus/call "
+          "(budget 10us), %d span records" % (per_call * 1e6,
+                                              after - before))
+    if per_call > 10e-6:
+        failures.append("overhead: unsampled step_trace() %.2fus/call "
+                        "blows the 10us budget" % (per_call * 1e6))
+    if after != before:
+        failures.append("overhead: disabled sampling still recorded "
+                        "%d spans" % (after - before))
+    tracing.set_sample_rate(0.01)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--child", default=None,
+                    choices=[None, "serve", "worker"])
+    ap.add_argument("--ready-dir", default=None)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--requests", type=int, default=3)
+    args = ap.parse_args()
+    if args.child == "serve":
+        return run_replica(args)
+    if args.child == "worker":
+        return run_worker(args)
+
+    failures = []
+    workdir = tempfile.mkdtemp(prefix="check_trace_")
+    # overhead first: the probe wants the module's default state
+    check_overhead(args, failures)
+    check_serve(args, workdir, failures)
+    check_train(args, workdir, failures)
+
+    if failures:
+        print("check_trace FAILED:")
+        for f in failures:
+            print("  - " + f)
+        for tag in ("serve", "train"):
+            path = os.path.join(workdir, "log_" + tag)
+            if os.path.exists(path):
+                tail = open(path, "rb").read()[-2000:]
+                print("--- log_%s tail ---" % tag)
+                print(tail.decode(errors="replace"))
+        return 1
+    print("check_trace OK: one sampled request / training round == one "
+          "stitched cross-process span tree; unsampled overhead within "
+          "budget")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

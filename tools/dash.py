@@ -6,7 +6,8 @@ aggregation sidecar rewrites every couple of seconds during a run —
 as a one-screen fleet view:
 
   * one row per role-rank: steps, last/avg step time, MFU, dominant
-    phase, serve queue depth, anomaly / retry / failover tickers;
+    phase, dominant critical-path segment (`mx.tracing` sampled-span
+    summary), serve queue depth, anomaly / retry / failover tickers;
   * a step-time sparkline per rank from the role's recent sample ring
     (``MXTPU_OBS_SAMPLE_S`` cadence);
   * the straggler: the live worker with the slowest average step time
@@ -77,9 +78,10 @@ def render(cluster, width=100):
                    and r.get("steps")}
     straggler = max(worker_avgs, key=worker_avgs.get) \
         if len(worker_avgs) >= 2 else None
-    lines.append("%-12s %7s %9s %9s %6s %-15s %6s %5s %5s %-16s"
+    lines.append("%-12s %7s %9s %9s %6s %-15s %-14s %6s %5s %5s %-16s"
                  % ("rank", "steps", "step(ms)", "avg(ms)", "MFU",
-                    "phase", "queue", "anom", "retry", "step trend"))
+                    "phase", "crit-path", "queue", "anom", "retry",
+                    "step trend"))
     for key in sorted(roles):
         r = roles[key]
         flags = ""
@@ -89,13 +91,17 @@ def render(cluster, width=100):
             flags = "  < straggler"
         tail = samples.get(key) or []
         spark = sparkline([s.get("step_time_ms") for s in tail])
-        lines.append("%-12s %7s %9s %9s %6s %-15s %6s %5s %5s %-16s%s"
+        lines.append("%-12s %7s %9s %9s %6s %-15s %-14s %6s %5s %5s "
+                     "%-16s%s"
                      % (key,
                         _fmt(r.get("steps"), "%d"),
                         _fmt(r.get("step_time_ms"), "%.1f"),
                         _fmt(r.get("step_time_avg_ms"), "%.1f"),
                         _fmt(r.get("mfu"), "%.3f"),
                         _fmt(r.get("dominant_phase")),
+                        # the role's dominant critical-path segment
+                        # (mx.tracing sampled-span summary)
+                        _fmt(r.get("critical_path")),
                         _fmt(r.get("queue_depth"), "%d"),
                         _fmt(r.get("anomalies"), "%d"),
                         _fmt(r.get("retries"), "%d"),

@@ -141,6 +141,15 @@ def main(argv=None):
                          "(client failover absorbs them — the chaos "
                          "contract tools/check_serving.py tests) "
                          "instead of failing the launch")
+    ap.add_argument("--trace-sample", type=float, default=None,
+                    metavar="RATE",
+                    help="arm mx.tracing causal spans fleet-wide at "
+                         "this head-sampling rate (sets "
+                         "MXTPU_TRACE_SAMPLE in every role; 1 = every "
+                         "request/step, 0 = off); merged spans land "
+                         "in merged_trace.json + the cluster.json "
+                         "tracing rollup — see docs/observability.md "
+                         "§Tracing")
     ap.add_argument("--telemetry-dir", default=None, metavar="DIR",
                     help="unified telemetry (docs/observability.md): "
                          "every role dumps telemetry_<role><rank>.json "
@@ -178,6 +187,8 @@ def main(argv=None):
         "MXTPU_NUM_WORKER": str(args.num_workers),
         "MXTPU_NUM_SERVER": str(ns),
     })
+    if args.trace_sample is not None:
+        base["MXTPU_TRACE_SAMPLE"] = repr(args.trace_sample)
     if args.pid_dir:
         os.makedirs(args.pid_dir, exist_ok=True)
     tdir = None
@@ -287,6 +298,8 @@ def _launch_serve(args):
     ports = [_free_port() for _ in range(args.serve_replicas)]
     base = dict(os.environ)
     base["MXTPU_SERVE_PORTS"] = ",".join(str(p) for p in ports)
+    if args.trace_sample is not None:
+        base["MXTPU_TRACE_SAMPLE"] = repr(args.trace_sample)
     if args.pid_dir:
         os.makedirs(args.pid_dir, exist_ok=True)
     tdir = None

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Critical-path report over `mx.tracing` sampled spans.
+
+Reads the per-role ``telemetry_*.json`` dumps a run left in its
+``--telemetry-dir`` (the same files ``telemetry.merge_dir`` stitches
+into ``merged_trace.json``), groups span records by trace id, and for
+each trace prints the dominant-segment chain the way
+``mx.tracing.critical_path()`` attributes it — each segment's SELF
+time (child spans subtracted) as a fraction of the end-to-end wall:
+
+    trace 4bf92f3577b34da6a3ce929d0e0e4736  wall 12.4ms  3 pids
+      chain: client 31% -> queue_wait 42% -> device 27%
+      client       3.8ms  31%   queue_wait   5.2ms  42%  ...
+
+Usage::
+
+    python tools/trace_path.py --dir /tmp/run1/telemetry          # all
+    python tools/trace_path.py --dir ... --trace 4bf92f35...      # one
+    python tools/trace_path.py --dir ... --top 3                  # slowest 3
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def load_spans(directory):
+    """All span records from a telemetry dir's per-role dumps."""
+    spans = []
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "telemetry_*.json"))):
+        try:
+            snap = json.load(open(path))
+        except (OSError, ValueError) as e:
+            print("trace_path: skipping %s: %s" % (path, e),
+                  file=sys.stderr)
+            continue
+        for ev in snap.get("events") or []:
+            if ev.get("kind") == "span":
+                ev = dict(ev)
+                ev.setdefault("pid", snap.get("pid"))
+                spans.append(ev)
+    return spans
+
+
+def report(cp):
+    lines = ["trace %s  wall %.1fms  %d spans  %d pids"
+             % (cp["trace"], cp["wall_s"] * 1e3, cp["spans"],
+                cp["pids"])]
+    lines.append("  chain: %s" % (cp["chain"] or "(single segment)"))
+    for seg in cp["segments"]:
+        lines.append("  %-20s %9.3fms  %4.0f%%"
+                     % (seg["name"], seg["self_s"] * 1e3,
+                        seg["frac"] * 100))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    from mxtpu import tracing
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", required=True,
+                    help="telemetry dir holding telemetry_*.json")
+    ap.add_argument("--trace", default=None,
+                    help="report just this 32-hex trace id")
+    ap.add_argument("--top", type=int, default=10,
+                    help="show the N slowest traces (by wall)")
+    args = ap.parse_args(argv)
+
+    spans = load_spans(args.dir)
+    if not spans:
+        print("trace_path: no span records under %s (tracing off, or "
+              "sample rate 0?)" % args.dir, file=sys.stderr)
+        return 1
+    if args.trace:
+        cp = tracing.critical_path(spans, args.trace)
+        if cp is None:
+            print("trace_path: no spans for trace %s" % args.trace,
+                  file=sys.stderr)
+            return 1
+        print(report(cp))
+        return 0
+    ids = sorted({ev.get("trace") for ev in spans if ev.get("trace")})
+    paths = [cp for cp in (tracing.critical_path(spans, t)
+                           for t in ids) if cp is not None]
+    paths.sort(key=lambda c: c["wall_s"], reverse=True)
+    shown = paths[:max(1, args.top)]
+    for i, cp in enumerate(shown):
+        if i:
+            print()
+        print(report(cp))
+    if len(paths) > len(shown):
+        print("\n(%d more traces; raise --top to see them)"
+              % (len(paths) - len(shown)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
