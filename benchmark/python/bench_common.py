@@ -85,6 +85,12 @@ def row(bench: str, metric: str, value: float, unit: str,
             mfu = p.get("mfu")
         if phases is None:
             phases = p.get("phases_us_per_step")
+    # an `mx.tune` trial subprocess stamps its trial id into the row
+    # so ledger rows are attributable to the trial that produced them
+    trial = os.environ.get("MXTPU_TUNE_TRIAL")
+    if trial:
+        extra = dict(extra or {})
+        extra.setdefault("tune_trial", trial)
     return {
         "schema": SCHEMA,
         "bench": bench,

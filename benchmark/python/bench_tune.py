@@ -1,0 +1,147 @@
+"""`mx.tune` benchmark seed: tuned-vs-default step time for one real
+measured-trial search session.
+
+What the autotuner buys (ROADMAP item 2 direction): the knob space
+(donation, pass pipeline, steps-per-program batching, ...) is searched
+with REAL subprocess trials instead of hand-tuning, and the winner is
+persisted for auto-apply at bind.  The number that matters is the
+step-time of the searched config against the all-defaults baseline —
+plus how many trials the cost-model-seeded successive-halving search
+spent to find it.
+
+Runs a full `mx.tune.tune()` session over this file's own ``--bench``
+child mode (a small MLP train step; fwd+bwd+update, median-of-windows
+timing) and reports the winner.  On the CPU CI image the spread
+between knob settings is modest — the seed exists to track that the
+LOOP stays sound and cheap; on TPU hardware the same harness measures
+real donation/batching wins.
+
+Emits ONE JSON line (driver contract):
+  {"metric": "tuned_step_time_us", "value": <best>, "unit": "us",
+   "vs_baseline": <default-config step time>,
+   "extra": {"config": ..., "improved": ..., "trials": ...,
+             "search_wall_s": ...}}
+
+Env knobs: MXTPU_BENCH_TUNE_KNOBS ("donate,passes,steps_per_program"),
+MXTPU_BENCH_TUNE_TRIALS (6), MXTPU_BENCH_TUNE_STEPS (12),
+MXTPU_BENCH_TUNE_HIDDEN (64), MXTPU_BENCH_TUNE_BATCH (32).
+"""
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+KNOBS = os.environ.get("MXTPU_BENCH_TUNE_KNOBS",
+                       "donate,passes,steps_per_program").split(",")
+TRIALS = int(os.environ.get("MXTPU_BENCH_TUNE_TRIALS", "6"))
+STEPS = int(os.environ.get("MXTPU_BENCH_TUNE_STEPS", "12"))
+HIDDEN = int(os.environ.get("MXTPU_BENCH_TUNE_HIDDEN", "64"))
+BATCH = int(os.environ.get("MXTPU_BENCH_TUNE_BATCH", "32"))
+FEAT = 32
+
+
+def _model():
+    from mxtpu import sym
+
+    x = sym.Variable("data")
+    h = sym.FullyConnected(data=x, num_hidden=HIDDEN, name="fc1")
+    h = sym.Activation(data=h, act_type="relu", name="r1")
+    h = sym.FullyConnected(data=h, num_hidden=HIDDEN, name="fc2")
+    h = sym.Activation(data=h, act_type="relu", name="r2")
+    h = sym.FullyConnected(data=h, num_hidden=10, name="fc3")
+    return sym.SoftmaxOutput(data=h, label=sym.Variable(
+        "softmax_label"), name="softmax")
+
+
+def mode_bench():
+    """Trial body the TrialRunner forks: measure the train step under
+    whatever knob env the runner injected, emit the bench row."""
+    import numpy as np
+
+    import jax
+
+    import bench_common
+
+    import mxtpu as mx
+    from mxtpu.io.io import DataBatch
+
+    mod = mx.mod.Module(_model(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (BATCH, FEAT))],
+             label_shapes=[("softmax_label", (BATCH,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01})
+    rng = np.random.RandomState(0)
+    batch = DataBatch(
+        data=[mx.nd.array(rng.rand(BATCH, FEAT).astype("float32"))],
+        label=[mx.nd.array(rng.randint(0, 10, BATCH).astype("float32"))])
+
+    def step():
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+
+    def sync():
+        jax.block_until_ready(
+            [a._data for a in mod._exec_group.execs[0].arg_arrays])
+
+    for _ in range(max(3, STEPS // 2)):
+        step()
+    sync()
+    windows = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            step()
+        sync()
+        windows.append((time.perf_counter() - t0) / STEPS * 1e6)
+    us = sorted(windows)[1]
+    bench_common.emit_result(
+        "bench_tune", "mlp_train_step_time_us", round(us, 1), "us",
+        step_time_us=round(us, 1), extra={"steps": STEPS})
+    return 0
+
+
+def main():
+    import bench_common
+
+    import mxtpu as mx
+
+    net = _model()
+    profile = mx.tune.profile_of_shapes([("data", (BATCH, FEAT))])
+    with tempfile.TemporaryDirectory(prefix="bench_tune_") as tmp:
+        run_dir = os.path.join(tmp, "runs")
+        db_dir = os.path.join(tmp, "db")
+        t0 = time.perf_counter()
+        res = mx.tune.tune(
+            [sys.executable, os.path.abspath(__file__), "--bench"],
+            symbol=net, profile=profile, knob_names=KNOBS,
+            max_trials=TRIALS, run_dir=run_dir, db_dir=db_dir, seed=0)
+        wall = time.perf_counter() - t0
+    failed = [t.trial_id for t in res.trials if not t.ok]
+    for t in res.trials:
+        print("%s: rc=%d %s -> %s"
+              % (t.trial_id, t.returncode, t.config,
+                 "%.1f us" % t.score if t.ok else "failed"),
+              file=sys.stderr)
+    print("best %s: %.1f us vs baseline %.1f us (improved=%s, "
+          "%d trials in %.1f s)"
+          % (res.config, res.score, res.baseline_score, res.improved,
+             len(res.trials), wall), file=sys.stderr)
+    bench_common.emit_result(
+        "bench_tune", "tuned_step_time_us", round(res.score, 1), "us",
+        vs_baseline=round(res.baseline_score, 1),
+        step_time_us=round(res.score, 1),
+        extra={"config": res.config, "improved": res.improved,
+               "trials": len(res.trials), "failed_trials": failed,
+               "knobs": KNOBS, "search_wall_s": round(wall, 1)})
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(mode_bench() if "--bench" in sys.argv[1:] else main())

@@ -61,12 +61,17 @@ class FusedTrainLoop(object):
     {write, null}.  Raises MXNetError otherwise.
     """
 
-    def __init__(self, module, steps_per_program: int = 8,
+    def __init__(self, module, steps_per_program: Optional[int] = None,
                  collect_outputs: bool = True, unroll: Optional[int] = None):
         import os
 
         import jax
 
+        if steps_per_program is None:
+            # MXTPU_STEPS_PER_PROGRAM: the `mx.tune` registered knob —
+            # an explicit constructor arg always wins over the env
+            steps_per_program = int(
+                os.environ.get("MXTPU_STEPS_PER_PROGRAM", "8") or 8)
         if not (module.binded and module.params_initialized and
                 module.optimizer_initialized):
             raise MXNetError("FusedTrainLoop: module must be bound, "

@@ -20,6 +20,7 @@ a ~1 ms pure-python transform per sample is already ~2x faster with
 from __future__ import annotations
 
 import multiprocessing
+import os
 import queue
 import threading
 import time
@@ -182,6 +183,14 @@ class DataLoader(object):
         from ... import telemetry as _tel
 
         it = self._iter_impl()
+        # MXTPU_PREFETCH_DEVICE=N (an `mx.tune` registered knob):
+        # a lookahead thread pulls the NEXT batch and completes its
+        # host->device transfer while the consumer computes on the
+        # current one, so the input_wait gauge below measures only
+        # what the pipeline could NOT hide
+        depth = int(os.environ.get("MXTPU_PREFETCH_DEVICE", "0") or 0)
+        if depth > 0:
+            it = self._device_prefetch_iter(it, depth)
         while True:
             # nesting-guarded scope: when this fetch itself drives an
             # inner DataIter (dataset backed by one), only THIS
@@ -192,6 +201,65 @@ class DataLoader(object):
             except StopIteration:
                 return
             yield batch
+
+    @staticmethod
+    def _force_device(batch):
+        """Complete a batch's host->device transfer (NDArray creation
+        dispatches ``device_put`` asynchronously; blocking HERE, on
+        the prefetch thread, is the whole point — the consumer thread
+        receives a device-resident, ready batch)."""
+        if isinstance(batch, (list, tuple)):
+            for b in batch:
+                DataLoader._force_device(b)
+        elif isinstance(batch, NDArray):
+            batch.wait_to_read()
+        return batch
+
+    def _device_prefetch_iter(self, it, depth: int):
+        """Async host->device prefetch: a daemon thread runs ``depth``
+        batches ahead, batchifying AND device-transferring each, with a
+        bounded queue for backpressure.  Errors cross over and re-raise
+        in the consumer; an abandoned consumer unblocks the worker via
+        the stop event (the queue put polls it)."""
+        from ... import profiler as _prof
+
+        out_q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        stop = threading.Event()
+        _DONE = object()
+
+        def worker():
+            try:
+                for batch in it:
+                    self._force_device(batch)
+                    _prof.inc_stat("dataloader_device_prefetch")
+                    while not stop.is_set():
+                        try:
+                            out_q.put((batch, None), timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+                out_q.put((_DONE, None))
+            except BaseException as e:  # surface in the consumer
+                try:
+                    out_q.put((_DONE, e), timeout=1.0)
+                except queue.Full:
+                    pass
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="mxtpu-device-prefetch")
+        t.start()
+        try:
+            while True:
+                batch, err = out_q.get()
+                if batch is _DONE:
+                    if err is not None:
+                        raise err
+                    return
+                yield batch
+        finally:
+            stop.set()
 
     def _iter_impl(self):
         if self._num_workers == 0:

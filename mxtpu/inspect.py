@@ -395,6 +395,11 @@ class ProgramRecord(object):
         # registration), e.g. "zero1:n=4,axis=dp" — rides every
         # telemetry ``compile`` event as the ``sharding`` field
         self.sharding: Optional[str] = None
+        # tuning provenance (mx.tune): the auto-applied tuning-DB
+        # config this program was built under, e.g.
+        # "tune:key=ab12cd34,donate=0,passes=default" — set by
+        # program() when `MXTPU_TUNE=apply` resolved a DB entry
+        self.tuning: Optional[str] = None
         self.hits = 0          # unlocked bump: the <10us hot path
         self.compiles = 0      # dispatch-path compiles (ticks *_trace)
         self.aot_compiles = 0  # warmup/AOT builds (ticks *_warmup)
@@ -458,7 +463,8 @@ class ProgramRecord(object):
         ev = _tel.record("compile", site=site, step=_tel.current_step(),
                          program=self.name, variant=kind, flops=0.0,
                          peak_bytes=0, compile_s=0.0, blame=blame,
-                         passes=pass_prov, sharding=self.sharding)
+                         passes=pass_prov, sharding=self.sharding,
+                         tuning=self.tuning)
         if not _ENABLED:
             return None
         _prof.inc_stat("inspect_compiles")
@@ -524,6 +530,8 @@ class ProgramRecord(object):
             d["passes"] = _passes.provenance_summary(self.pass_report)
         if self.sharding is not None:
             d["sharding"] = self.sharding
+        if self.tuning is not None:
+            d["tuning"] = self.tuning
         if analyze and sig_infos:
             analysis = sig_infos[-1].analyze()
             d.update({k: v for k, v in analysis.items() if k != "error"})
@@ -631,6 +639,18 @@ def program(site: str, name: str,
                 plan = _cur_plan()
                 if plan is not None:
                     rec.sharding = plan.describe()
+    except Exception:
+        pass
+    # tuning provenance: the auto-applied `mx.tune` DB config active
+    # in this process (knobs are process-global env, so every program
+    # registered after the apply was built under it)
+    try:
+        if rec.tuning is None:
+            from . import tune as _tune
+
+            prov = _tune.current_applied()
+            if prov is not None:
+                rec.tuning = prov
     except Exception:
         pass
     return rec
@@ -995,6 +1015,8 @@ def report(name_or_record=None, kind: Optional[str] = None) -> Dict[str, Any]:
         out["blame"] = blames
     if rec.pass_report is not None:
         out["pass_report"] = rec.pass_report
+    if rec.tuning is not None:
+        out["tuning"] = rec.tuning
     try:
         out.update(hlo_histogram(si.hlo_text()))
     except Exception as e:

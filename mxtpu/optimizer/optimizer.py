@@ -179,6 +179,15 @@ class Optimizer(object):
             kw["clip_gradient"] = self.clip_gradient
         return kw
 
+    #: True when `update()` on a DENSE grad funnels its entire math
+    #: through exactly ONE `_apply` call (no eager NDArray arithmetic
+    #: outside it).  Such updates can be captured and replayed batched
+    #: inside a single jitted program with BITWISE-identical results —
+    #: the ZeRO-1 per-rank fusion (mxtpu/sharding/zero1.py) requires
+    #: it.  Optimizers with side computations (LARS norms, SGLD noise,
+    #: DCASGD previous-weight tracking) must leave this False.
+    single_apply_update = False
+
     def fused_update_multi(self, indices, weights, grads, states) -> bool:
         """Update many params in ONE jitted call (whole-tree fusion).
         Returns False when this optimizer has no fused path (caller
@@ -415,6 +424,8 @@ class SGD(Optimizer):
     """SGD with momentum and optional multi-precision (reference
     `optimizer.py:451-549`; fused ops sgd_update/sgd_mom_update/mp_*)."""
 
+    single_apply_update = True  # dense update() is one _apply call
+
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -620,6 +631,8 @@ class FTML(Optimizer):
 
 @register
 class NAG(Optimizer):
+    single_apply_update = True  # update() is one _apply call
+
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -694,6 +707,8 @@ class DCASGD(Optimizer):
 
 @register
 class Adam(Optimizer):
+    single_apply_update = True  # dense update() is one _apply call
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -980,6 +995,7 @@ class LBSGD(SGD):
     (reference `optimizer.py:683`; simplified warmup handling)."""
 
     zero1_compatible = False  # LARS scales by WHOLE-weight norms
+    single_apply_update = False  # eager LARS norm math outside _apply
 
     def __init__(self, momentum=0.0, multi_precision=False, warmup_strategy
                  ="linear", warmup_epochs=5, batch_scale=1, updates_per_epoch

@@ -41,6 +41,7 @@ per-replica payload, ``(n-1)/n * bytes``, same convention as
 """
 from __future__ import annotations
 
+import functools
 import pickle
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -52,6 +53,37 @@ from .. import optimizer as opt_mod
 from .plan import ShardingPlan
 
 __all__ = ["ZeRO1Updater", "tree_nbytes", "state_nbytes"]
+
+
+@functools.lru_cache(maxsize=4096)
+def _fused_apply_plan(plan_key: Tuple[Tuple[str, Tuple], ...]):
+    """Jitted executor for one rank's captured optimizer applies:
+    ``plan_key`` is ``((op_name, canonical_attrs), ...)`` and the
+    returned callable maps ``(per-op (weight, grad, *states) jax
+    arrays, ...)`` to a matching tuple of output tuples.  Each op body
+    is built exactly like the eager cache builds it (`ops.registry.
+    _jitted`: ``partial(op.fn, **attrs)`` with scalar attrs baked as
+    constants) so every per-param subgraph — and therefore every
+    result — is bitwise identical to the eager dispatch; only the
+    dispatch count changes.  Optimizers with a per-step attr (Adam's
+    bias-corrected lr) key a new plan per step, the same retrace the
+    eager cache pays; the lru bound keeps both from growing without
+    limit."""
+    import jax
+
+    from ..ops.registry import get_op
+
+    bodies = [functools.partial(get_op(name).fn, **dict(attrs_key))
+              for name, attrs_key in plan_key]
+
+    def step(arg_lists):
+        outs = []
+        for body, args in zip(bodies, arg_lists):
+            res = body(*args)
+            outs.append(res if isinstance(res, tuple) else (res,))
+        return tuple(outs)
+
+    return jax.jit(step)
 
 
 def tree_nbytes(obj) -> int:
@@ -191,11 +223,160 @@ class ZeRO1Updater(object):
         path) means the grad replicas already hold the merged sum;
         False makes this updater sum them first (the reduce half of
         the reduce-scatter).  Weights of every replica are left
-        identical after the call."""
-        from .. import profiler as _prof
+        identical after the call.
 
+        Dense sharded params are updated in ONE jitted program per
+        rank (`_update_batched` capture-and-replay over the whole
+        rank-r slice tree) instead of one eager dispatch per
+        (param, rank) — the dispatch-bound hot spot on small-param
+        trees (ROADMAP item 3).  Batching changes dispatch count, not
+        math: each param's subgraph is the optimizer's own `_apply`
+        op built exactly as the eager cache builds it (bitwise parity
+        asserted by tests/test_sharding.py and
+        tools/check_sharding.py).  Params the batched path cannot
+        take (sparse grads, unsharded state, optimizers without
+        `single_apply_update`) keep the per-param path."""
+        from .. import profiler as _prof
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        batchable = []  # (index, merged grad, w0, weight replicas)
         for index, grads, weights in triples:
-            self._update_one(index, grads, weights, _prof, pre_reduced)
+            g0, w0 = grads[0], weights[0]
+            if isinstance(g0, BaseSparseNDArray):
+                self._update_one(index, grads, weights, _prof,
+                                 pre_reduced)
+                continue
+            if not pre_reduced and len(grads) > 1:
+                from ..kvstore import _fused_sum
+
+                g0 = NDArray(_fused_sum([g._data for g in grads]),
+                             ctx=g0.ctx, _committed=True)
+            if self._dim_for(index, w0) is None or \
+                    self.shard_dims.get(index) is None:
+                self._update_one(index, [g0], weights, _prof, True)
+                continue
+            batchable.append((index, g0, w0, weights))
+        if not batchable:
+            return
+        if len(batchable) == 1 or \
+                not self._update_batched(batchable, _prof):
+            # one param fuses nothing; a False fused_update_multi
+            # (no fused form / mixed mp tree) mutated nothing yet
+            for index, g0, w0, weights in batchable:
+                self._update_one(index, [g0], weights, _prof, True)
+
+    def _update_batched(self, items, _prof) -> bool:
+        """One jitted program per RANK covering every dense sharded
+        param's rank-r slice update, instead of one eager dispatch per
+        (param, rank).
+
+        Bitwise parity with the eager path is BY CONSTRUCTION, not by
+        reimplementation: the optimizer's own ``update()`` runs with
+        ``_apply`` shimmed to CAPTURE its single (op, attrs) call, and
+        the batched program replays exactly those ops built the same
+        way the eager cache builds them — ``functools.partial(op.fn,
+        **attrs)`` with scalars (lr/wd/beta) baked as compile-time
+        constants (see ``ops.registry._jitted``).  Passing scalars as
+        jit *arguments* instead lets XLA constant-fold differently
+        (~1 ulp/step drift), which is why this does not reuse
+        ``fused_update_multi``.
+
+        Returns False — with counters restored, nothing else mutated —
+        when the optimizer cannot be captured (no
+        ``single_apply_update`` declaration, or mp low-precision
+        weights whose master-copy cast-back happens outside
+        ``_apply``); the caller then falls back per-param."""
+        import jax.numpy as jnp
+
+        from ..optimizer.optimizer import _is_lowp
+        from ..ops import registry as _reg
+
+        opt = self.optimizer
+        n = self.n
+        if not getattr(opt, "single_apply_update", False):
+            return False
+        for index, _, w0, _ in items:
+            self._ensure_state(index, w0)
+        if opt.multi_precision and any(_is_lowp(it[2].dtype)
+                                       for it in items):
+            return False
+        indices = [it[0] for it in items]
+        counts_before = {i: opt._index_update_count.get(i)
+                         for i in indices}
+
+        def _rewind():
+            # every rank applies the SAME logical step: restore the
+            # counters so bias correction / schedules see one advance
+            # per wall step no matter how many ranks ran
+            for i in indices:
+                cb = counts_before[i]
+                if cb is None:
+                    opt._index_update_count.pop(i, None)
+                else:
+                    opt._index_update_count[i] = cb
+
+        new_slices: Dict[Any, list] = {i: [] for i in indices}
+        for r in range(n):
+            if r > 0:
+                _rewind()
+            w_sls, g_sls, st_r = [], [], []
+            for index, g0, w0, _ in items:
+                dim = self.shard_dims[index]
+                idx = self.plan.shard_slice(w0.shape, dim, r)
+                w_sls.append(NDArray(w0._data[idx], ctx=w0.ctx,
+                                     _committed=True))
+                g_sls.append(NDArray(g0._data[idx], ctx=g0.ctx,
+                                     _committed=True))
+                st_r.append(self.states[index][r])
+            captured: list = []
+            opt._apply = lambda op_name, weight, grad, states, **at: \
+                captured.append((op_name, weight, grad,
+                                 tuple(states), at))
+            try:
+                for (index, _, _, _), w_sl, g_sl, st in zip(
+                        items, w_sls, g_sls, st_r):
+                    opt.update_multi_precision(index, w_sl, g_sl, st)
+            finally:
+                del opt._apply  # restore the class staticmethod
+            ok = len(captured) == len(items) and all(
+                c[1] is w and not _reg.get_op(c[0]).needs_rng
+                for c, w in zip(captured, w_sls))
+            if not ok:
+                # the update did eager math outside its one _apply
+                # (contract violation of single_apply_update); only
+                # the counters advanced, so undo them and fall back
+                if r == 0:
+                    _rewind()
+                    return False
+                raise MXNetError(
+                    "zero1 batched update: optimizer %s captured "
+                    "inconsistently across ranks" % type(opt).__name__)
+            plan_key = tuple((c[0], _reg.canonical_attrs(c[4]))
+                             for c in captured)
+            outs = _fused_apply_plan(plan_key)(
+                tuple(tuple([c[1]._data, c[2]._data]
+                            + [s._data for s in c[3]])
+                      for c in captured))
+            for c, out in zip(captured, outs):
+                c[1]._set_jax(out[0])
+                for st, new in zip(c[3], out[1:]):
+                    st._set_jax(new)
+            for (index, _, _, _), w_sl in zip(items, w_sls):
+                new_slices[index].append(w_sl._data)
+        _prof.inc_stat("zero1_fused_rank_updates", n)
+        ring = (n - 1) / float(n)
+        for index, g0, w0, weights in items:
+            dim = self.shard_dims[index]
+            # allgather: chunks -> full param, broadcast to replicas
+            full = jnp.concatenate(new_slices[index], axis=dim)
+            w0._set_jax(full)
+            self._broadcast(w0, weights[1:])
+            nbytes = int(np.prod(w0.shape)) * w0.dtype.itemsize
+            _prof.inc_stat("allgather_bytes", int(nbytes * ring))
+            _prof.inc_stat("reduce_scatter_bytes",
+                           int(g0.dtype.itemsize
+                               * int(np.prod(g0.shape)) * ring))
+        return True
 
     def _update_one(self, index, grads, weights, _prof,
                     pre_reduced: bool = True) -> None:

@@ -127,10 +127,15 @@ __all__ = [
 #: ids + name + ``dur_s``, ts = the span's END like ``step`` records;
 #: :func:`merge_dir` renders them as X spans and has
 #: ``tracing.stitch`` join cross-process traces with flow events.)
+#: (``tuning`` = one `mx.tune` lifecycle point: a measured trial
+#: (``action="trial"``, trial id + score + config), a finished search
+#: session (``action="session"``), or a DB config auto-applied at
+#: bind/hybridize/add_model (``action="apply"``, with the same
+#: provenance string `mx.inspect` stamps on program records).)
 EVENT_KINDS = ("step", "compile", "kvstore", "kvstore_round", "retry",
                "failover", "membership", "checkpoint", "monitor",
                "timeout", "flight", "anomaly", "tensor_stats", "serve",
-               "reshard", "perf", "span")
+               "reshard", "perf", "span", "tuning")
 
 #: ``profiler.stats()`` keys that are point-in-time gauges, not
 #: additive counters: cluster aggregation takes their MAX, and counter

@@ -1,0 +1,240 @@
+"""Knob registry: every subsystem's tunables, declared in one place.
+
+A :class:`Knob` is the unit the autotuner searches over: a name, the
+subsystem that owns it, the env var(s) that carry it into a process,
+a CLOSED domain of legal values, and an optional in-process apply
+hook for knobs whose consumers latch the env at import/bind time
+(e.g. ``compile_cache.set_bucket_policy``).  Values are STRINGS —
+exactly what lands in the environment — so a trial subprocess, a
+ledger row's ``knobs`` dict, and a tuning-DB entry all speak the same
+representation.
+
+The registry is seeded below with every performance knob the repo
+has accumulated (`docs/env_vars.md`): ``steps_per_program``, shape
+buckets, the ``MXTPU_PASSES`` pipeline, remat policy, donation,
+layout, the serve batcher's wait/cap, and the DataLoader device
+prefetch.  Future subsystems declare theirs with :func:`declare` —
+one call, and `mx.tune.tune()` searches it for free.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..base import MXNetError
+
+__all__ = ["Knob", "declare", "get", "knobs", "names", "defaults",
+           "env_for_config", "apply_config", "current_config",
+           "validate_config"]
+
+#: value meaning "unset this env var" (the knob's consumer falls back
+#: to its own default) — distinct from "0", which many knobs treat as
+#: an explicit opt-out
+UNSET = ""
+
+
+class Knob(object):
+    """One tunable.
+
+    ``env_of(value)`` maps a domain value to the env dict the trial
+    subprocess (or :meth:`apply`) installs — by default ``{env:
+    value}`` with ``UNSET`` deleting the var; multi-var knobs (remat =
+    mirror flag + policy) override it via the ``env_map`` callable.
+    ``apply_hook(value)`` additionally pokes in-process state for
+    consumers that latched the env already.
+    """
+
+    __slots__ = ("name", "subsystem", "env", "domain", "default",
+                 "description", "env_map", "apply_hook")
+
+    def __init__(self, name: str, subsystem: str, env: str,
+                 domain: Sequence[str], default: str,
+                 description: str = "",
+                 env_map: Optional[Callable[[str], Dict[str, str]]] = None,
+                 apply_hook: Optional[Callable[[str], None]] = None):
+        self.name = name
+        self.subsystem = subsystem
+        self.env = env
+        self.domain = [str(v) for v in domain]
+        self.default = str(default)
+        self.description = description
+        self.env_map = env_map
+        self.apply_hook = apply_hook
+        if self.default not in self.domain:
+            raise MXNetError("knob %r: default %r not in domain %s"
+                             % (name, default, self.domain))
+
+    def validate(self, value: str) -> str:
+        value = str(value)
+        if value not in self.domain:
+            raise MXNetError("knob %r: value %r not in domain %s"
+                             % (self.name, value, self.domain))
+        return value
+
+    def env_of(self, value: str) -> Dict[str, str]:
+        value = self.validate(value)
+        if self.env_map is not None:
+            return dict(self.env_map(value))
+        return {self.env: value}
+
+    def current(self) -> str:
+        """The value the environment currently carries (default when
+        unset or out of domain — an exotic hand-set env value is not
+        this knob's business to police)."""
+        v = os.environ.get(self.env)
+        if v is None:
+            return self.default
+        return v if v in self.domain else self.default
+
+    def apply(self, value: str) -> None:
+        """Install ``value``: env var(s) first (so forked trial/worker
+        processes inherit it), then the in-process hook."""
+        for k, v in self.env_of(value).items():
+            if v == UNSET:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if self.apply_hook is not None:
+            self.apply_hook(value)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "subsystem": self.subsystem,
+                "env": self.env, "domain": list(self.domain),
+                "default": self.default, "description": self.description}
+
+
+_lock = threading.Lock()
+_REGISTRY: "collections.OrderedDict[str, Knob]" = collections.OrderedDict()
+
+
+def declare(knob: Knob) -> Knob:
+    """Register (or replace — subsystems may re-declare with a wider
+    domain) one knob."""
+    with _lock:
+        _REGISTRY[knob.name] = knob
+    return knob
+
+
+def get(name: str) -> Knob:
+    with _lock:
+        knob = _REGISTRY.get(name)
+    if knob is None:
+        raise MXNetError("unknown knob %r (declared: %s)"
+                         % (name, names()))
+    return knob
+
+
+def knobs(subset: Optional[Sequence[str]] = None) -> List[Knob]:
+    """All declared knobs (declaration order), or the named subset."""
+    if subset is not None:
+        return [get(n) for n in subset]
+    with _lock:
+        return list(_REGISTRY.values())
+
+
+def names() -> List[str]:
+    with _lock:
+        return list(_REGISTRY)
+
+
+def defaults(subset: Optional[Sequence[str]] = None) -> Dict[str, str]:
+    return {k.name: k.default for k in knobs(subset)}
+
+
+def current_config(subset: Optional[Sequence[str]] = None) -> Dict[str, str]:
+    return {k.name: k.current() for k in knobs(subset)}
+
+
+def validate_config(config: Dict[str, str]) -> Dict[str, str]:
+    return {name: get(name).validate(val)
+            for name, val in sorted(config.items())}
+
+
+def env_for_config(config: Dict[str, str]) -> Dict[str, str]:
+    """The flat env-var dict a config resolves to (``UNSET`` values
+    included, so callers know what to DELETE from a child env)."""
+    out: Dict[str, str] = {}
+    for name, val in sorted(config.items()):
+        out.update(get(name).env_of(val))
+    return out
+
+
+def apply_config(config: Dict[str, str]) -> Dict[str, str]:
+    """Validate then install every knob of ``config`` in this process.
+    Returns the validated config."""
+    cfg = validate_config(config)
+    for name, val in cfg.items():
+        get(name).apply(val)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Seed declarations — the repo's accumulated knob space
+# ---------------------------------------------------------------------------
+
+def _apply_buckets(value: str) -> None:
+    # clear any set_bucket_policy override so the env value just
+    # installed is what get_bucket_policy resolves
+    from .. import compile_cache as _cc
+
+    _cc.set_bucket_policy(None)
+
+
+def _remat_env(value: str) -> Dict[str, str]:
+    if value == "off":
+        return {"MXTPU_BACKWARD_DO_MIRROR": UNSET,
+                "MXTPU_REMAT_POLICY": UNSET}
+    return {"MXTPU_BACKWARD_DO_MIRROR": "1", "MXTPU_REMAT_POLICY": value}
+
+
+def _declare_seed_knobs() -> None:
+    declare(Knob(
+        "steps_per_program", "fused_train", "MXTPU_STEPS_PER_PROGRAM",
+        ["1", "2", "4", "8", "16", "32"], "8",
+        "batches one FusedTrainLoop XLA program scans over "
+        "(amortizes host dispatch; raises per-program HBM)"))
+    declare(Knob(
+        "shape_buckets", "compile_cache", "MXTPU_SHAPE_BUCKETS",
+        [UNSET, "pow2", "mult:8", "mult:16"], UNSET,
+        "ragged-batch bucket policy (bounds the compiled-program set "
+        "under variable batch sizes)",
+        apply_hook=_apply_buckets))
+    declare(Knob(
+        "passes", "passes", "MXTPU_PASSES",
+        ["default", "default,-fuse", "default,-fold", "dce,cse", "off"],
+        "default",
+        "graph-rewrite pipeline subset run ahead of tracing"))
+    declare(Knob(
+        "remat", "executor", "MXTPU_BACKWARD_DO_MIRROR",
+        ["off", "dots", "dots_no_batch", "full"], "off",
+        "gradient-checkpoint policy of the fused train step "
+        "(trade recompute FLOPs for activation HBM)",
+        env_map=_remat_env))
+    declare(Knob(
+        "donate", "executor", "MXTPU_DONATE",
+        ["1", "0"], "1",
+        "donate aux buffers into the training programs (in-place "
+        "updates instead of fresh HBM per step)"))
+    declare(Knob(
+        "layout", "passes", "MXTPU_LAYOUT",
+        [UNSET, "nhwc"], UNSET,
+        "NHWC layout propagation over the conv stack"))
+    declare(Knob(
+        "serve_batch_wait_us", "serve", "MXTPU_SERVE_BATCH_WAIT_US",
+        ["0", "500", "2000", "8000"], "2000",
+        "how long the serve batcher lingers for more rows below the "
+        "bucket cap (latency vs occupancy)"))
+    declare(Knob(
+        "serve_max_batch", "serve", "MXTPU_SERVE_MAX_BATCH",
+        ["8", "16", "32", "64"], "32",
+        "serve bucket cap: largest batch one dispatch packs"))
+    declare(Knob(
+        "prefetch_device", "io", "MXTPU_PREFETCH_DEVICE",
+        ["0", "1", "2"], "0",
+        "DataLoader async host->device prefetch depth (overlaps the "
+        "input copy with the step; attacks input_wait_frac)"))
+
+
+_declare_seed_knobs()

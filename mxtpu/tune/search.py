@@ -1,0 +1,242 @@
+"""Search loop: cost-model-seeded successive halving over knob configs.
+
+TVM-style measured search (arXiv 1802.04799) scaled down to a knob
+space of closed domains: candidates are generated as single-knob
+mutations of the baseline config (plus a few epsilon-greedy random
+combos), RANKED by a zero-cost model before any wall-clock is spent,
+then run through successive halving — every surviving config is
+re-measured each round and the field is cut by ``eta`` until one
+winner remains.
+
+The cost model spends no trials: it reads the BASELINE measurement's
+phase attribution (``input_wait``/``host_dispatch``/... from the
+`mx.perf` observatory riding the bench row) plus the program's
+``inspect.cost_analysis`` figures (FLOPs vs bytes-accessed ->
+arithmetic intensity), and scores each knob by how directly it
+attacks the dominant cost: input-bound runs try the DataLoader
+prefetch first, dispatch-bound runs try ``steps_per_program``/shape
+buckets, memory-bound runs try remat/layout.  Ranking only ORDERS the
+candidate queue — every candidate inside the trial budget still gets
+measured, so a wrong prior costs position, not correctness.
+
+The contract the CI guard (`tools/check_tune.py`) enforces: the
+returned config is NEVER worse than the measured baseline — when no
+candidate beats it, the baseline config itself wins.
+"""
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import registry
+from .trial import Trial, TrialRunner
+
+__all__ = ["SearchResult", "cost_model_priors", "rank_candidates",
+           "candidates_for", "search"]
+
+# phase name -> the knobs that most directly attack it (cost-model
+# prior table; phases are the `mx.perf` attribution keys)
+_PHASE_KNOBS = {
+    "input_wait": ("prefetch_device",),
+    "host_dispatch": ("steps_per_program", "donate", "shape_buckets"),
+    "optimizer": ("steps_per_program", "donate"),
+    "device_compute": ("passes", "layout", "remat"),
+    "compile": ("shape_buckets", "passes"),
+}
+
+#: arithmetic intensity (FLOPs/byte) below which a program counts as
+#: memory-bound for the prior (CPU/TPU ridge points are far higher,
+#: but the prior only orders the queue)
+_MEM_BOUND_INTENSITY = 16.0
+
+
+def cost_model_priors(baseline_row: Optional[Dict[str, Any]],
+                      analysis: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, float]:
+    """Per-knob prior weight (higher = try earlier), from the baseline
+    row's phase attribution and the program's cost analysis."""
+    priors = {k.name: 1.0 for k in registry.knobs()}
+    phases = (baseline_row or {}).get("phases") or {}
+    total = sum(v for v in phases.values()
+                if isinstance(v, (int, float))) or 0.0
+    if total > 0:
+        for phase, us in sorted(phases.items(),
+                                key=lambda kv: -(kv[1] or 0)):
+            if not isinstance(us, (int, float)) or us <= 0:
+                continue
+            frac = us / total
+            for knob in _PHASE_KNOBS.get(phase, ()):
+                if knob in priors:
+                    # dominant phases push their knobs to the front
+                    priors[knob] += 4.0 * frac
+    if analysis:
+        flops = float(analysis.get("flops") or 0.0)
+        bytes_acc = float(analysis.get("bytes_accessed") or 0.0)
+        if bytes_acc > 0 and flops > 0:
+            intensity = flops / bytes_acc
+            if intensity < _MEM_BOUND_INTENSITY:
+                for knob in ("remat", "layout"):
+                    if knob in priors:
+                        priors[knob] += 2.0
+            else:
+                for knob in ("steps_per_program", "donate"):
+                    if knob in priors:
+                        priors[knob] += 2.0
+    mfu = (baseline_row or {}).get("mfu")
+    if isinstance(mfu, (int, float)) and mfu and mfu < 0.05:
+        # far off the roofline: dispatch/input overheads dominate
+        for knob in ("steps_per_program", "prefetch_device", "donate"):
+            if knob in priors:
+                priors[knob] += 1.0
+    return priors
+
+
+def candidates_for(base: Dict[str, str],
+                   knob_names: Sequence[str]) -> List[Dict[str, str]]:
+    """Single-knob mutations of ``base`` over the given knobs' full
+    domains (the search never proposes an out-of-domain value)."""
+    out = []
+    for name in knob_names:
+        knob = registry.get(name)
+        cur = base.get(name, knob.default)
+        for val in knob.domain:
+            if val != cur:
+                cand = dict(base)
+                cand[name] = val
+                out.append(cand)
+    return out
+
+
+def rank_candidates(cands: Sequence[Dict[str, str]],
+                    base: Dict[str, str],
+                    priors: Dict[str, float]) -> List[Dict[str, str]]:
+    """Order candidates by the summed prior of the knobs they mutate
+    (stable within equal scores: registry declaration order)."""
+    def score(cand: Dict[str, str]) -> float:
+        return sum(priors.get(name, 1.0)
+                   for name, val in cand.items()
+                   if base.get(name) != val)
+
+    return sorted(cands, key=score, reverse=True)
+
+
+class SearchResult(object):
+    """Outcome of one tuning session."""
+
+    __slots__ = ("config", "score", "baseline_config", "baseline_score",
+                 "improved", "trials", "run_ids", "priors")
+
+    def __init__(self, config, score, baseline_config, baseline_score,
+                 trials: List[Trial], priors):
+        self.config = dict(config)
+        self.score = score
+        self.baseline_config = dict(baseline_config)
+        self.baseline_score = baseline_score
+        self.improved = score < baseline_score
+        self.trials = list(trials)
+        self.run_ids = [t.run_id for t in trials]
+        self.priors = dict(priors)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"config": self.config, "score": self.score,
+                "baseline_config": self.baseline_config,
+                "baseline_score": self.baseline_score,
+                "improved": self.improved,
+                "n_trials": len(self.trials),
+                "run_ids": self.run_ids}
+
+
+def _avg(scores: Sequence[float]) -> float:
+    finite = [s for s in scores if s != float("inf")]
+    if not finite:
+        return float("inf")
+    return sum(finite) / len(finite)
+
+
+def search(runner: TrialRunner,
+           knob_names: Optional[Sequence[str]] = None,
+           base: Optional[Dict[str, str]] = None,
+           max_trials: int = 16,
+           eta: int = 2,
+           epsilon: float = 0.1,
+           seed: int = 0,
+           analysis: Optional[Dict[str, Any]] = None) -> SearchResult:
+    """Run one tuning session; returns the winning config.
+
+    1. Measure ``base`` (registry defaults when not given) — the
+       baseline every candidate must beat.
+    2. Generate single-knob mutations over ``knob_names`` (all
+       declared knobs by default); with probability ``epsilon`` per
+       slot, inject a random multi-knob combo (the greedy queue can't
+       see interactions).
+    3. Rank by :func:`cost_model_priors` on the baseline row +
+       ``analysis`` and truncate to the trial budget.
+    4. Successive halving: measure the field, keep the best
+       ``1/eta``, re-measure survivors (scores average across
+       rounds — re-measurement is the noise control), repeat until
+       one remains or the budget is spent.
+    """
+    rng = random.Random(seed)
+    if base is None:
+        base = registry.defaults(knob_names)
+    base = registry.validate_config(base)
+    names = list(knob_names) if knob_names is not None \
+        else registry.names()
+
+    baseline_trial = runner.run(base)
+    baseline_score = baseline_trial.score
+    priors = cost_model_priors(baseline_trial.row, analysis)
+
+    cands = candidates_for(base, names)
+    cands = rank_candidates(cands, base, priors)
+    # epsilon-greedy: splice random 2-knob combos into the tail so
+    # interactions the single-mutation queue can't express get a shot
+    n_random = sum(1 for _ in cands if rng.random() < epsilon)
+    for _ in range(min(n_random, 4)):
+        if len(names) < 2:
+            break
+        combo = dict(base)
+        for name in rng.sample(list(names), 2):
+            combo[name] = rng.choice(registry.get(name).domain)
+        if combo != base and combo not in cands:
+            cands.append(combo)
+
+    budget = max(1, int(max_trials) - 1)  # baseline already spent
+    field: List[Tuple[Dict[str, str], List[float]]] = []
+    spent = 0
+    # first round takes as many (ranked) candidates as halving can
+    # afford: k + k/eta + k/eta^2 + ... <= budget
+    k = 0
+    while k < len(cands):
+        cost, width = 0, k + 1
+        while width >= 1:
+            cost += width
+            width //= eta
+        if cost > budget:
+            break
+        k += 1
+    field = [(c, []) for c in cands[:max(1, k)]]
+
+    while field and spent < budget:
+        survivors: List[Tuple[Dict[str, str], List[float]]] = []
+        for config, scores in field:
+            if spent >= budget:
+                survivors.append((config, scores))
+                continue
+            trial = runner.run(config)
+            spent += 1
+            survivors.append((config, scores + [trial.score]))
+        survivors.sort(key=lambda cs: _avg(cs[1]))
+        if len(survivors) == 1:
+            field = survivors
+            break
+        field = survivors[:max(1, len(survivors) // eta)]
+
+    best_config, best_score = base, baseline_score
+    for config, scores in field:
+        s = _avg(scores)
+        if s < best_score:
+            best_config, best_score = config, s
+    # never-worse contract: an empty/failed field falls back to base
+    return SearchResult(best_config, best_score, base, baseline_score,
+                        runner.trials, priors)

@@ -251,6 +251,21 @@ def test_check_elastic_full_guard():
     assert "check_elastic OK" in out
 
 
+def test_check_tune_guard():
+    """tools/check_tune.py: a short REAL tuning session over >= 2
+    knobs (donate x passes) must (a) persist a valid tuning-DB entry
+    keyed on (graph fingerprint, backend, batch profile) with every
+    trial left as a ledger row carrying its knob set, (b) auto-apply
+    on a FRESH bind in a new process under MXTPU_TUNE=apply with the
+    provenance string visible on mx.inspect.programs() records, and
+    (c) never regress: the tuned config re-measured against the
+    untuned baseline via compare_runs.py --fail-on-slower (see
+    mxtpu/tune/, docs/tuning.md)."""
+    out = _run(["tools/check_tune.py", "--steps", "6", "--trials", "4"],
+               timeout=420)
+    assert "check_tune OK" in out
+
+
 def test_launch_propagates_child_exit(tmp_path):
     """Satellite: a nonzero worker exit must surface as a nonzero
     launcher exit (silent child death looked like success before)."""

@@ -1,0 +1,303 @@
+#!/usr/bin/env python
+"""`mx.tune` closed-loop acceptance guard (tier-1 via tests/test_tools.py).
+
+Runs a REAL (CPU-sized) tuning session end to end and asserts the
+three contracts that make the autotuner trustworthy:
+
+  1. **A valid DB entry is written** — a short search over >= 2 knobs
+     (``donate`` x ``passes`` by default) persists a winning config
+     under the (graph fingerprint, backend, batch profile) key, and
+     every trial lands as a ``kind="bench"`` ledger row (with its
+     knob set) consumable by `tools/compare_runs.py`.
+  2. **Auto-apply reproduces it on a fresh bind** — a NEW process run
+     with ``MXTPU_TUNE=apply`` binds the same architecture, picks the
+     entry up, and the provenance string is visible on
+     ``mx.inspect.programs()`` records.
+  3. **The tuned config never regresses** — the auto-applied config is
+     re-measured and gated against the session's baseline trial with
+     ``compare_runs.py --fail-on-slower`` (re-measured once more on a
+     first failure: micro-bench noise must not fail CI, a real
+     regression fails twice).
+
+Modes (subprocess entry points of the same file):
+  ``--bench``   one bench_common-speaking measurement run (the trial
+                body the TrialRunner forks; knobs arrive via env)
+  ``--verify``  fresh-bind auto-apply check: bind under
+                MXTPU_TUNE=apply, assert provenance, emit a tuned row
+
+Usage: python tools/check_tune.py [--steps N] [--trials N]
+           [--tolerance PCT]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "benchmark", "python"))
+
+KNOBS = ("donate", "passes")
+BATCH, FEATS = 16, 32
+
+
+def build_net():
+    from mxtpu import sym
+
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data=data, num_hidden=32, name="fc1")
+    h = sym.Activation(data=h, act_type="relu", name="relu1")
+    h = sym.FullyConnected(data=h, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(data=h, label=sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def data_shapes():
+    return [("data", (BATCH, FEATS))]
+
+
+def train_module():
+    import mxtpu as mx
+
+    mod = mx.mod.Module(build_net(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=data_shapes(),
+             label_shapes=[("softmax_label", (BATCH,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01})
+    return mod
+
+
+def measure(mod, steps):
+    """Median-of-3 windows step time (us) of fwd+bwd+update — short
+    windows + median beat one long mean against scheduler noise."""
+    import numpy as np
+
+    import jax
+    import mxtpu as mx
+    from mxtpu.io.io import DataBatch
+
+    rng = np.random.RandomState(0)
+    batch = DataBatch(
+        data=[mx.nd.array(rng.rand(BATCH, FEATS).astype("float32"))],
+        label=[mx.nd.array(rng.randint(0, 10, BATCH).astype("float32"))])
+
+    def step():
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+
+    def sync():
+        jax.block_until_ready(
+            [a._data for a in mod._exec_group.execs[0].arg_arrays])
+
+    for _ in range(max(3, steps // 2)):
+        step()
+    sync()
+    windows = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            step()
+        sync()
+        windows.append((time.perf_counter() - t0) / steps * 1e6)
+    return sorted(windows)[1]
+
+
+def mode_bench(args):
+    import bench_common
+
+    mod = train_module()
+    us = measure(mod, args.steps)
+    bench_common.emit_result(
+        "check_tune", "mlp_train_step_time_us", round(us, 1), "us",
+        step_time_us=round(us, 1),
+        extra={"steps": args.steps})
+    return 0
+
+
+def mode_verify(args):
+    """Fresh process under MXTPU_TUNE=apply: bind must pick the DB
+    entry up, stamp provenance, and the tuned measurement is emitted
+    as this run's bench row."""
+    import bench_common
+
+    import mxtpu as mx
+
+    assert mx.tune.apply_enabled(), \
+        "verify mode must run with MXTPU_TUNE=apply"
+    mod = train_module()
+    prov = mx.tune.current_applied()
+    assert prov is not None, \
+        "MXTPU_TUNE=apply bind did not apply the DB entry (db=%s)" \
+        % os.environ.get("MXTPU_TUNE_DB")
+    us = measure(mod, args.steps)
+    stamped = [p for p in mx.inspect.programs(analyze=False)
+               if p.get("tuning") == prov]
+    assert stamped, ("no mx.inspect program record carries tuning "
+                     "provenance %r" % prov)
+    bench_common.emit_result(
+        "check_tune", "mlp_train_step_time_us_tuned", round(us, 1),
+        "us", step_time_us=round(us, 1),
+        extra={"steps": args.steps, "provenance": prov})
+    # NOT the bench row: parseable marker line for the parent BEFORE it
+    print(json.dumps({"verify": True, "provenance": prov,
+                      "stamped_programs": [p["name"] for p in stamped],
+                      "step_time_us": round(us, 1)}), file=sys.stderr)
+    return 0
+
+
+def _self_argv(mode, args):
+    return [sys.executable, os.path.abspath(__file__), mode,
+            "--steps", str(args.steps)]
+
+
+def _run_verify(args, db_dir, run_dir, run_id):
+    env = dict(os.environ)
+    env.update({"MXTPU_TUNE": "apply", "MXTPU_TUNE_DB": db_dir,
+                "MXTPU_RUN_DIR": run_dir, "MXTPU_RUN_ID": run_id})
+    env.pop("MXTPU_BENCH_OUT", None)
+    proc = subprocess.run(_self_argv("--verify", args), env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          timeout=300)
+    if proc.returncode != 0:
+        print(proc.stderr.decode("utf-8", "replace"), file=sys.stderr)
+        raise SystemExit("FAIL: verify subprocess exited %d"
+                         % proc.returncode)
+    marker = None
+    for line in proc.stderr.decode("utf-8", "replace").splitlines():
+        line = line.strip()
+        if line.startswith("{") and '"verify"' in line:
+            marker = json.loads(line)
+    assert marker and marker.get("provenance"), \
+        "verify subprocess printed no provenance marker"
+    return marker
+
+
+def _rerun_baseline(args, run_dir, run_id):
+    """One more untuned measurement (noise control for the gate)."""
+    env = dict(os.environ)
+    env.update({"MXTPU_TUNE": "0", "MXTPU_RUN_DIR": run_dir,
+                "MXTPU_RUN_ID": run_id})
+    env.pop("MXTPU_BENCH_OUT", None)
+    proc = subprocess.run(_self_argv("--bench", args), env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          timeout=300)
+    if proc.returncode != 0:
+        print(proc.stderr.decode("utf-8", "replace"), file=sys.stderr)
+        raise SystemExit("FAIL: baseline re-measure exited %d"
+                         % proc.returncode)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bench", dest="mode", action="store_const",
+                    const="bench", default="check")
+    ap.add_argument("--verify", dest="mode", action="store_const",
+                    const="verify")
+    ap.add_argument("--steps", type=int, default=10,
+                    help="measured steps per window (3 windows/trial)")
+    ap.add_argument("--trials", type=int, default=6,
+                    help="tuning-session trial budget (incl. baseline)")
+    ap.add_argument("--tolerance", type=float, default=60.0,
+                    help="--fail-on-slower budget, pct (CPU micro noise)")
+    args = ap.parse_args()
+
+    if args.mode == "bench":
+        return mode_bench(args)
+    if args.mode == "verify":
+        return mode_verify(args)
+
+    import tempfile
+
+    import mxtpu as mx
+    from mxtpu import tune
+
+    work = tempfile.mkdtemp(prefix="check_tune_")
+    db_dir = os.path.join(work, "db")
+    run_dir = os.path.join(work, "runs")
+    os.makedirs(run_dir, exist_ok=True)
+    os.environ["MXTPU_TUNE_DB"] = db_dir
+
+    # ---- 1. the tuning session -------------------------------------
+    net = build_net()
+    profile = tune.profile_of_shapes(data_shapes())
+    result = tune.tune(_self_argv("--bench", args), symbol=net,
+                       profile=profile, knob_names=list(KNOBS),
+                       max_trials=args.trials, run_dir=run_dir,
+                       db_dir=db_dir, seed=0)
+    print("check_tune: %d trials, baseline %.1f us -> winner %.1f us "
+          "%s" % (len(result.trials), result.baseline_score,
+                  result.score, result.config), file=sys.stderr)
+    failed = [t for t in result.trials if not t.ok]
+    assert not failed, "trials failed: %s" % [
+        (t.trial_id, t.error) for t in failed]
+
+    # DB entry valid + keyed correctly
+    entry = tune.lookup(tune.fingerprint_of(net), "cpu", profile,
+                        db_dir)
+    assert entry is not None, "tuning session wrote no DB entry"
+    assert set(entry["config"]) == set(KNOBS), entry["config"]
+    assert entry["config"] == result.config
+
+    # every trial is a ledger row with its knob set recorded
+    for t in result.trials:
+        path = os.path.join(run_dir, t.run_id + ".jsonl")
+        rows = mx.obs.read_ledger(path)
+        benches = [r for r in rows if r.get("kind") == "bench"]
+        assert benches, "trial %s left no bench ledger row" % t.run_id
+        knobs = benches[-1].get("knobs") or {}
+        assert knobs.get("MXTPU_TUNE_TRIAL") == t.trial_id
+        assert benches[-1].get("extra", {}).get("tune_trial") \
+            == t.trial_id
+        for env_k, env_v in tune.env_for_config(t.config).items():
+            if env_v == "":
+                assert env_k not in knobs, (env_k, knobs)
+            else:
+                assert knobs.get(env_k) == env_v, (env_k, knobs)
+    print("check_tune: DB entry + %d trial ledger rows verified"
+          % len(result.trials), file=sys.stderr)
+
+    # ---- 2. auto-apply on a fresh bind, provenance visible ----------
+    marker = _run_verify(args, db_dir, run_dir, "tuned_verify")
+    key8 = entry["key"][:8]
+    assert ("key=%s" % key8) in marker["provenance"], marker
+    print("check_tune: fresh bind auto-applied %s (programs %s)"
+          % (marker["provenance"], marker["stamped_programs"]),
+          file=sys.stderr)
+
+    # ---- 3. never-regress gate --------------------------------------
+    import compare_runs
+
+    baseline_ledger = os.path.join(run_dir,
+                                   result.trials[0].run_id + ".jsonl")
+    tuned_ledger = os.path.join(run_dir, "tuned_verify.jsonl")
+    rc = compare_runs.main([baseline_ledger, tuned_ledger,
+                            "--fail-on-slower", str(args.tolerance)])
+    if rc != 0:
+        # one-off micro-bench noise must not fail CI: re-measure BOTH
+        # sides fresh; a real regression fails again
+        print("check_tune: gate tripped, re-measuring both sides",
+              file=sys.stderr)
+        _rerun_baseline(args, run_dir, "baseline_remeasure")
+        _run_verify(args, db_dir, run_dir, "tuned_remeasure")
+        rc = compare_runs.main(
+            [os.path.join(run_dir, "baseline_remeasure.jsonl"),
+             os.path.join(run_dir, "tuned_remeasure.jsonl"),
+             "--fail-on-slower", str(args.tolerance)])
+    if rc != 0:
+        print("FAIL: tuned config measured slower than the untuned "
+              "default beyond %.0f%% noise budget" % args.tolerance,
+              file=sys.stderr)
+        return 1
+    print("check_tune OK (%d trials, winner %s, provenance %s)"
+          % (len(result.trials), result.config, marker["provenance"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,7 +40,11 @@ sys.path.insert(0, ROOT)
 
 KNOB_KEYS_SKIP = ("MXTPU_RUN_ID", "MXTPU_TELEMETRY_DIR",
                   "MXTPU_PS_ROOT_PORT", "MXTPU_SERVE_PORT",
-                  "MXTPU_SERVE_PORTS", "MXTPU_SERVE_RANK")
+                  "MXTPU_SERVE_PORTS", "MXTPU_SERVE_RANK",
+                  # tuner bookkeeping, not perf knobs: every trial
+                  # differs in these by construction
+                  "MXTPU_TUNE", "MXTPU_TUNE_TRIAL", "MXTPU_TUNE_DB",
+                  "MXTPU_BENCH_OUT")
 
 
 def _read(path):
