@@ -112,6 +112,7 @@ from . import health
 from . import perf
 from . import tune
 from . import resilience
+from . import checkpoint
 from . import monitor
 from . import visualization
 from . import sharding

@@ -73,6 +73,7 @@ from __future__ import annotations
 import hashlib
 import hmac as hmac_mod
 import json
+import logging
 import os
 import pickle
 import socket
@@ -477,6 +478,10 @@ class Scheduler(object):
         self._threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
         self._last_beat: Dict[int, float] = {}
+        # round -> fleet checkpoint stamp (idempotent: every worker
+        # asking at the same round boundary gets the SAME id) — see
+        # _ckpt_stamp / mxtpu/checkpoint.py
+        self._ckpt_stamps: Dict[int, Dict[str, Any]] = {}
         # node id -> latest heartbeat-shipped telemetry snapshot (the
         # cluster view `kv.telemetry()` merges, and the source of the
         # posthumous flight record when a node is declared dead)
@@ -590,6 +595,8 @@ class Scheduler(object):
                 elif op == "group_info":
                     with self._cv:
                         _send_msg(conn, self._group_info_locked())
+                elif op == "ckpt":
+                    _send_msg(conn, self._ckpt_stamp(msg))
                 elif op == "barrier":
                     _send_msg(conn, self._barrier(msg))
                 elif op == "done":
@@ -618,6 +625,29 @@ class Scheduler(object):
                 self._conns.remove(conn)
             except ValueError:
                 pass
+
+    def _ckpt_stamp(self, msg):
+        """Stamp a fleet checkpoint id for a round boundary —
+        IDEMPOTENT per round, so every worker snapshotting at that
+        round receives the identical (round, generation,
+        live-worker-set) stamp.  The round number already totally
+        orders the PS protocol, so the stamp IS the fleet consistency
+        barrier: no extra rendezvous round trip (see
+        mxtpu/checkpoint.py, docs/checkpoint.md)."""
+        rnd = int(msg.get("round", 0))
+        with self._cv:
+            stamp = self._ckpt_stamps.get(rnd)
+            if stamp is None:
+                stamp = {"id": "r%08d_g%03d" % (rnd, self._gen),
+                         "round": rnd, "gen": self._gen,
+                         "workers": [[nid, r] for r, nid in
+                                     enumerate(self._worker_order)],
+                         "num_workers": self._live_workers(),
+                         "num_servers": len(self._servers)}
+                self._ckpt_stamps[rnd] = stamp
+                while len(self._ckpt_stamps) > 8:
+                    self._ckpt_stamps.pop(min(self._ckpt_stamps))
+            return dict(stamp)
 
     def _group_info_locked(self):
         return {"gen": self._gen,
@@ -1036,6 +1066,12 @@ class Server(object):
         self._succ_rank = (self.rank + 1) % ns if ns else self.rank
         self._succ_addr = servers[self._succ_rank] if self._repl_on \
             else None
+        # fleet-checkpoint restore (mxtpu/checkpoint.py): rank is
+        # known now, so the matching shard snapshot can be loaded
+        # before any worker traffic arrives
+        self._restored_keys: set = set()
+        self._restored_updater_state = None
+        self._maybe_restore()
         if self._repl_on:
             threading.Thread(target=self._repl_loop, daemon=True).start()
         _start_heartbeat(self.node_id, lambda: self._shutdown,
@@ -1077,9 +1113,17 @@ class Server(object):
                 if op == "init":
                     with self._cv:
                         key = msg["key"]
-                        self._store[key] = np.array(msg["value"])
-                        self._versions[key] = 0
-                        self._enqueue_repl_locked(key)
+                        if key in self._restored_keys:
+                            # checkpoint-restored state is
+                            # authoritative: rank 0's re-init after a
+                            # fleet resume must not clobber the value
+                            # or reset the version vector the workers
+                            # re-anchor against (docs/checkpoint.md)
+                            pass
+                        else:
+                            self._store[key] = np.array(msg["value"])
+                            self._versions[key] = 0
+                            self._enqueue_repl_locked(key)
                     _send_msg(conn, {"ok": True})
                 elif op == "push":
                     _send_msg(conn, self._push(msg))
@@ -1126,6 +1170,47 @@ class Server(object):
                 self._conns.remove(conn)
             except ValueError:
                 pass
+
+    def _maybe_restore(self):
+        """``MXTPU_CKPT_RESTORE``: repopulate this shard's store +
+        version vector (and stash the updater state for when
+        ``set_optimizer`` installs the updater) from the fleet
+        checkpoint's ``server<rank>`` bundle.  Resumed workers anchor
+        their push rounds at the same checkpoint round
+        (`KVStoreDist.resume_at_version`), so the first post-resume
+        push lands as round R+1 against these restored versions."""
+        d = os.environ.get("MXTPU_CKPT_RESTORE")
+        if not d:
+            return
+        try:
+            from . import checkpoint as _ckpt
+
+            found = _ckpt.load_server_snapshot(d, self.rank)
+        except Exception as e:
+            logging.getLogger(__name__).warning(
+                "server %d: checkpoint restore from %s failed: %s",
+                self.rank, d, e)
+            return
+        if found is None:
+            logging.getLogger(__name__).warning(
+                "server %d: no valid shard snapshot under %s",
+                self.rank, d)
+            return
+        blob, rnd = found
+        snap = pickle.loads(blob)
+        with self._cv:
+            for key, val in (snap.get("store") or {}).items():
+                self._store[key] = np.array(val)
+            for key, v in (snap.get("versions") or {}).items():
+                self._versions[key] = int(v)
+            self._restored_keys = set(self._store)
+            self._restored_updater_state = snap.get("updater") or None
+        _telemetry.record("resume", role="server", rank=self.rank,
+                          round=rnd, keys=len(self._restored_keys),
+                          dir=d)
+        logging.getLogger(__name__).info(
+            "server %d: restored %d keys at round %d from %s",
+            self.rank, len(self._restored_keys), rnd, d)
 
     def _apply(self, key, merged: np.ndarray):
         """ApplyUpdates (`kvstore_dist_server.h:346-358`): updater if
@@ -1526,12 +1611,69 @@ class Server(object):
             optimizer = pickle.loads(body)
             with self._lock:
                 self._updater = opt_mod.get_updater(optimizer)
+                if self._restored_updater_state:
+                    # apply the checkpoint-restored per-key optimizer
+                    # state now that an updater exists (same pattern
+                    # as replica promotion)
+                    for key, wire in self._restored_updater_state \
+                            .items():
+                        st = self._state_from_wire(wire)
+                        if st is not None:
+                            self._updater.states[key] = st
+                            self._updater.states_synced[key] = True
+                    self._restored_updater_state = None
+        elif head == "mxtpu_ckpt":
+            return self._checkpoint_cmd(body)
         elif self._controller is not None:
             try:
                 self._controller(head, body)
             except Exception as e:  # a controller bug must not kill
                 return {"error": "controller failed: %s" % e}
         return {"ok": True}
+
+    def _checkpoint_cmd(self, body):
+        """Fleet checkpoint (mxtpu/checkpoint.py): capture this
+        shard's (store, version vector, updater state) CONSISTENTLY
+        under the lock — state is exactly at the stamped round
+        boundary; contributions already pending for the NEXT round are
+        deliberately excluded (resumed workers re-push that round) —
+        then land it on a background thread so the round pipeline
+        never waits on the disk."""
+        try:
+            if isinstance(body, (bytes, bytearray)):
+                body = json.loads(bytes(body).decode("utf-8"))
+            d = body["dir"]
+            rnd = int(body["round"])
+        except (KeyError, TypeError, ValueError) as e:
+            return {"error": "bad mxtpu_ckpt body: %s" % e}
+        with self._cv:
+            store = {k: np.array(v) for k, v in self._store.items()}
+            versions = dict(self._versions)
+            updater_state = None
+            if self._updater is not None:
+                try:
+                    updater_state = {
+                        k: self._state_to_wire(v)
+                        for k, v in self._updater.states.items()}
+                except Exception:
+                    updater_state = None
+        blob = pickle.dumps({"store": store, "versions": versions,
+                             "updater": updater_state,
+                             "rank": self.rank, "round": rnd})
+
+        def _land():
+            try:
+                from . import checkpoint as _ckpt
+
+                _ckpt.write_server_snapshot(d, self.rank, rnd, blob)
+            except Exception as e:
+                logging.getLogger(__name__).warning(
+                    "server %d: checkpoint write failed (%s): %s",
+                    self.rank, d, e)
+
+        threading.Thread(target=_land, daemon=True,
+                         name="mxtpu-server-ckpt").start()
+        return {"ok": True, "round": rnd}
 
 
 # ---------------------------------------------------------------------------
@@ -1983,6 +2125,22 @@ class Worker(object):
             if rep.get("error"):
                 raise ConnectionError("command %r rejected: %s"
                                       % (head, rep["error"]))
+
+    def checkpoint_stamp(self, rnd: int):
+        """Ask the scheduler for the fleet checkpoint stamp of round
+        ``rnd`` (idempotent — every worker gets the same id; see
+        Scheduler._ckpt_stamp, mxtpu/checkpoint.py)."""
+        return self._sched.request({"op": "ckpt", "round": int(rnd)})
+
+    def resume_at_version(self, version: int) -> None:
+        """Anchor push/pull round numbering after a fleet-checkpoint
+        restore: with the servers' version vectors restored at round R,
+        the first post-resume push must land as round R+1 (the `_push`
+        idempotency check drops ``rnd <= version`` as a duplicate) and
+        sync pulls must require ``>= R``.  Reuses the join-version
+        mechanism — push rounds are computed as
+        ``max(last_version, join_version) + 1``."""
+        self._join_version = max(self._join_version, int(version))
 
     def close(self):
         self._closed = True  # stop the heartbeat thread

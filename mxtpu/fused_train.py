@@ -42,6 +42,7 @@ import numpy as np
 from .base import MXNetError
 from .executor import _build_graph_fn
 from .ndarray.ndarray import NDArray
+from . import checkpoint as _ckpt
 from . import health as _health
 from . import perf as _perf
 from . import resilience as _res
@@ -529,6 +530,11 @@ class FusedTrainLoop(object):
                 self._guard.record(not bool(bad))
         elif prev_health is not None:
             self._check_pending(prev_health)
+        # mx.checkpoint boundary: the end of a K-step chunk is the only
+        # point where host copies of params/opt-state are coherent, so
+        # periodic snapshots and SIGTERM flushes both anchor here
+        if _ckpt.active():
+            _ckpt.on_boundary(self._t)
         if self._collect:
             ctx = self._exec._ctx
             return [NDArray(o, ctx=ctx, _committed=True) for o in outs]

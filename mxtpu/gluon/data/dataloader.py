@@ -147,15 +147,16 @@ class DataLoader(object):
     def __init__(self, dataset: Dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
                  batchify_fn=None, num_workers=0, pin_memory=False,
-                 prefetch=None, thread_pool=True):
+                 prefetch=None, thread_pool=True, seed=None):
         self._dataset = dataset
+        self._seed = seed
         if batch_sampler is None:
             if batch_size is None:
                 raise MXNetError("batch_size is required when batch_sampler "
                                  "is not given")
             if sampler is None:
-                sampler = RandomSampler(len(dataset)) if shuffle else \
-                    SequentialSampler(len(dataset))
+                sampler = RandomSampler(len(dataset), seed=seed) if shuffle \
+                    else SequentialSampler(len(dataset))
             elif shuffle:
                 raise MXNetError("shuffle must be False with custom sampler")
             batch_sampler = BatchSampler(sampler, batch_size,
@@ -164,12 +165,47 @@ class DataLoader(object):
                 last_batch is not None:
             raise MXNetError("batch_size/shuffle/sampler/last_batch must "
                              "not be set when batch_sampler is given")
+        self._sampler = sampler if sampler is not None else \
+            getattr(batch_sampler, "_sampler", None)
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = max(0, num_workers)
         self._thread_pool = thread_pool
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
+        # position bookkeeping for mx.checkpoint: (epoch, batches
+        # handed to the consumer this epoch) — see state()/set_state()
+        self._epoch = 0
+        self._pos_epoch = 0
+        self._pos_batch = 0
+        self._resume = None
+
+    # -- checkpointable position (docs/checkpoint.md) ---------------------
+    def state(self):
+        """Current position as a JSON-able dict: ``epoch``, ``batch``
+        (batches already handed out this epoch — the index the NEXT
+        batch would have), and the shuffle ``seed``.  With a seeded
+        sampler, `set_state` on a fresh loader re-enters the identical
+        batch stream mid-epoch."""
+        return {"epoch": int(self._pos_epoch),
+                "batch": int(self._pos_batch),
+                "seed": self._seed}
+
+    def set_state(self, state) -> None:
+        """Arm deterministic re-entry at a `state()` position: the next
+        `__iter__` shuffles for that epoch (seeded sampler) and skips
+        the first ``batch`` index-batches WITHOUT touching the dataset."""
+        if state is None:
+            return
+        saved_seed = state.get("seed")
+        if saved_seed is not None and self._seed is not None and \
+                saved_seed != self._seed:
+            raise MXNetError(
+                "DataLoader.set_state: shuffle seed mismatch (saved %r, "
+                "this loader %r) — the restored position would replay a "
+                "different batch stream" % (saved_seed, self._seed))
+        self._resume = (int(state.get("epoch", 0)),
+                        int(state.get("batch", 0)))
 
     def _make_batch(self, indices):
         _res.maybe_fault("dataloader")
@@ -182,7 +218,19 @@ class DataLoader(object):
         # separates "pipeline-bound" from "device-bound" step time
         from ... import telemetry as _tel
 
-        it = self._iter_impl()
+        if self._resume is not None:
+            epoch, skip = self._resume
+            self._resume = None
+        else:
+            epoch, skip = self._epoch, 0
+        if getattr(self._sampler, "seed", None) is not None:
+            # loader is authoritative over the shuffle epoch so an
+            # abandoned iterator or a restore can't desync the stream
+            self._sampler.set_epoch(epoch)
+        self._epoch = epoch
+        self._pos_epoch = epoch
+        self._pos_batch = skip
+        it = self._iter_impl(skip)
         # MXTPU_PREFETCH_DEVICE=N (an `mx.tune` registered knob):
         # a lookahead thread pulls the NEXT batch and completes its
         # host->device transfer while the consumer computes on the
@@ -199,7 +247,9 @@ class DataLoader(object):
                 with _tel.input_wait():
                     batch = next(it)
             except StopIteration:
+                self._epoch = epoch + 1
                 return
+            self._pos_batch += 1
             yield batch
 
     @staticmethod
@@ -242,10 +292,17 @@ class DataLoader(object):
                         return
                 out_q.put((_DONE, None))
             except BaseException as e:  # surface in the consumer
-                try:
-                    out_q.put((_DONE, e), timeout=1.0)
-                except queue.Full:
-                    pass
+                # The sentinel put must survive a full queue: dropping
+                # it (the old `except queue.Full: pass`) left the
+                # consumer blocked forever on `out_q.get()` — the error
+                # path retries against the stop event exactly like the
+                # normal path (tests/test_gluon_data.py regression).
+                while not stop.is_set():
+                    try:
+                        out_q.put((_DONE, e), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=worker, daemon=True,
                              name="mxtpu-device-prefetch")
@@ -261,19 +318,23 @@ class DataLoader(object):
         finally:
             stop.set()
 
-    def _iter_impl(self):
+    def _iter_impl(self, skip: int = 0):
         if self._num_workers == 0:
-            for indices in self._batch_sampler:
+            it = iter(self._batch_sampler)
+            for _ in range(skip):  # resume re-entry: index-only skip
+                if next(it, None) is None:
+                    return
+            for indices in it:
                 # inline path: full retry policy on transient faults
                 yield _res.run_with_retry(
                     "dataloader", lambda idx=indices: self._make_batch(idx))
             return
         if self._thread_pool:
-            yield from self._threaded_iter()
+            yield from self._threaded_iter(skip)
         else:
-            yield from self._process_iter()
+            yield from self._process_iter(skip)
 
-    def _process_iter(self):
+    def _process_iter(self, skip: int = 0):
         """Forked worker processes (reference dataloader.py:26-111
         model): per-sample transforms run GIL-free; workers ship numpy
         batches back (pickle), the parent converts once per batch.
@@ -293,7 +354,7 @@ class DataLoader(object):
         if batchify is default_batchify_fn:
             batchify = _np_batchify
         ctx = multiprocessing.get_context("fork")
-        batches = list(self._batch_sampler)
+        batches = list(self._batch_sampler)[skip:]
         pool = ctx.Pool(min(self._num_workers, max(1, len(batches))),
                         initializer=_worker_init,
                         initargs=(self._dataset,))
@@ -364,9 +425,9 @@ class DataLoader(object):
                                         _pool_pids(pool), attempt + 1)
         return out
 
-    def _threaded_iter(self):
+    def _threaded_iter(self, skip: int = 0):
         """Thread-pool pipeline with bounded in-order prefetch."""
-        batches = list(self._batch_sampler)
+        batches = list(self._batch_sampler)[skip:]
         results: "queue.Queue" = queue.Queue()
         lock = threading.Lock()
         next_submit = [0]

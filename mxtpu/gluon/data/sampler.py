@@ -28,11 +28,36 @@ class SequentialSampler(Sampler):
 
 
 class RandomSampler(Sampler):
-    def __init__(self, length):
+    """Uniform shuffle.  With ``seed`` set the permutation of epoch ``e``
+    is a pure function of ``(seed, e)`` — `mx.checkpoint` records
+    ``(seed, epoch, batch)`` as the DataLoader position and a resumed
+    run regenerates the *identical* index stream mid-epoch; with
+    ``seed=None`` (default) the legacy global-numpy shuffle is kept."""
+
+    def __init__(self, length, seed=None):
         self._length = length
+        self._seed = seed
+        self._epoch = 0
+
+    @property
+    def seed(self):
+        return self._seed
+
+    def set_epoch(self, epoch) -> None:
+        """Pin which epoch the NEXT `__iter__` shuffles for (resume
+        re-entry; no-op for unseeded samplers, whose stream is not
+        reconstructible anyway)."""
+        self._epoch = int(epoch)
 
     def __iter__(self):
-        indices = np.random.permutation(self._length)
+        if self._seed is None:
+            indices = np.random.permutation(self._length)
+        else:
+            rng = np.random.RandomState(
+                (int(self._seed) + 0x9E3779B1 * self._epoch)
+                % (2 ** 31 - 1))
+            indices = rng.permutation(self._length)
+        self._epoch += 1
         return iter(indices.tolist())
 
     def __len__(self):

@@ -12,6 +12,7 @@ import time as _time
 from typing import Any, Dict, List, Optional
 
 from ..base import MXNetError
+from .. import checkpoint as _ckpt
 from .. import health as _health
 from .. import optimizer as opt_mod
 from .. import perf as _perf
@@ -54,6 +55,9 @@ class Trainer(object):
         # time, engages the ZeRO-1 sharded updater over the replicas
         self._sharding_plan = sharding_plan
         self._zero1 = None
+        # steps applied so far — the round anchor mx.checkpoint stamps
+        # fleet snapshots with (restored on resume)
+        self._num_steps = 0
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -228,6 +232,19 @@ class Trainer(object):
                     trc, "step", _time.perf_counter() - st0, root=True,
                     step=_tel.current_step())
         _tel.record_step(batch_size=batch_size, site="trainer")
+        self._num_steps += 1
+        # mx.checkpoint step-boundary hook: periodic async fleet
+        # snapshots and the SIGTERM checkpoint-then-drain flush both
+        # fire HERE, at a consistent round boundary (one global read
+        # when nothing is armed)
+        if _ckpt.active():
+            _ckpt.on_boundary(self._num_steps)
+
+    @property
+    def step_count(self):
+        """Optimizer steps applied by this Trainer (checkpointed and
+        restored by `mx.checkpoint` for deterministic re-entry)."""
+        return self._num_steps
 
     def _grad_vals(self):
         vals = []

@@ -665,6 +665,33 @@ class KVStoreDist(KVStoreDevice):
     def send_command_to_servers(self, head, body):
         self._worker.send_command(head, body)
 
+    # -- fleet checkpointing (mxtpu/checkpoint.py) ------------------------
+    def checkpoint_stamp(self, rnd):
+        """The scheduler's idempotent (round, generation,
+        live-worker-set) fleet checkpoint stamp for round ``rnd`` —
+        every worker asking at the same boundary gets the SAME id
+        (docs/checkpoint.md)."""
+        return self._worker.checkpoint_stamp(int(rnd))
+
+    def server_checkpoint(self, directory, stamp):
+        """Command every live server to snapshot its shard (store +
+        version vector + updater state) into ``directory`` for the
+        stamped round.  Servers capture under their lock and write on
+        a background thread; rank 0's fleet-manifest commit polls for
+        the resulting per-server manifests."""
+        self._worker.send_command(
+            "mxtpu_ckpt", {"dir": str(directory),
+                           "id": stamp.get("id"),
+                           "round": int(stamp["round"]),
+                           "gen": int(stamp.get("gen", 0))})
+
+    def resume_at_version(self, version):
+        """Anchor push/pull round numbering at a restored checkpoint
+        round R: the first post-resume push lands as round R+1 against
+        the servers' restored version vectors, and sync pulls require
+        ``>= R`` (see `_ps.Worker.resume_at_version`)."""
+        self._worker.resume_at_version(int(version))
+
     def num_dead_node(self, node_id=6, timeout=None):
         """Count nodes with no heartbeat within `timeout` seconds
         (default ``MXTPU_DEAD_TIMEOUT``; reference

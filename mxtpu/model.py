@@ -122,7 +122,8 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 
 def save_checkpoint(prefix: str, epoch: int, symbol, arg_params,
-                    aux_params, remove_amp_cast=True, states=None):
+                    aux_params, remove_amp_cast=True, states=None,
+                    extra_meta=None):
     """Write `prefix-symbol.json` + `prefix-%04d.params` (reference
     `model.py:383`) — ATOMICALLY: every member lands via
     temp+fsync+rename and a CRC32 manifest
@@ -132,7 +133,9 @@ def save_checkpoint(prefix: str, epoch: int, symbol, arg_params,
     (`load_latest` skips it).  ``states`` optionally embeds serialized
     optimizer state as `prefix-%04d.states`.  All IO runs under the
     ``checkpoint`` fault-injection site + retry policy
-    (mxtpu/resilience.py)."""
+    (mxtpu/resilience.py).  ``extra_meta`` (a JSON-serializable dict)
+    rides in the manifest payload — `mx.checkpoint` uses it to stamp
+    fleet ids and run state next to the tensors they describe."""
     writer = _res.CheckpointWriter(prefix, epoch)
 
     def _member(path, write_fn):
@@ -151,7 +154,15 @@ def save_checkpoint(prefix: str, epoch: int, symbol, arg_params,
     if states is not None:
         _member("%s-%04d.states" % (prefix, epoch),
                 lambda f: f.write(states))
-    writer.commit()
+    writer.commit(extra=extra_meta if extra_meta else None)
+
+
+def read_checkpoint_meta(prefix: str, epoch: int):
+    """The manifest payload of ``prefix``/``epoch`` as a dict (CRCs,
+    file list, any ``extra_meta`` saved alongside) — or None when no
+    manifest exists.  Cheap: reads only the JSON manifest, never the
+    tensor members."""
+    return _res.read_manifest(prefix, epoch)
 
 
 def load_checkpoint(prefix: str, epoch: int):

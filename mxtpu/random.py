@@ -19,7 +19,7 @@ from .base import getenv_int
 __all__ = ["seed", "uniform", "normal", "randint", "randn", "exponential",
            "poisson", "gamma", "negative_binomial",
            "generalized_negative_binomial", "multinomial", "shuffle",
-           "get_state"]
+           "get_state", "set_state"]
 
 _lock = threading.Lock()
 _key = None
@@ -52,6 +52,24 @@ def _next_key():
 
 def get_state():
     return _key
+
+
+def set_state(state) -> None:
+    """Restore the threefry chain captured by `get_state()`.
+
+    Accepts the raw jax key, a numpy uint32 array, or a plain list (the
+    JSON-roundtripped form `mx.checkpoint` bundles) — after restore the
+    op-sequence-determinism contract of `seed()` continues from the
+    captured point, so a resumed dropout-bearing training run stays
+    bitwise identical to the uninterrupted one."""
+    global _key
+    import jax.numpy as jnp
+
+    with _lock:
+        if state is None:
+            _key = None
+        else:
+            _key = jnp.asarray(np.asarray(state, dtype=np.uint32))
 
 
 # -- convenience samplers mirroring `mx.random.*` (reference

@@ -499,6 +499,9 @@ class ZeRO1Updater(object):
                 "_index_update_count": dict(
                     self.optimizer._index_update_count),
             }
+        from .. import profiler as _prof
+
+        _prof.inc_stat("zero1_state_gathers", 1)
         return pickle.dumps((self._gather_full(), opt_state))
 
     def set_states(self, states) -> None:
@@ -532,6 +535,9 @@ class ZeRO1Updater(object):
                         ctx=nd.ctx, _committed=True))
                     for r in range(self.n)]
             self.states_synced[index] = True
+        from .. import profiler as _prof
+
+        _prof.inc_stat("zero1_state_reshards", 1)
 
 
 def _first_leaf(obj) -> Optional[NDArray]:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -27,7 +28,18 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from . import registry
 
-__all__ = ["Trial", "TrialRunner", "objective"]
+__all__ = ["Trial", "TrialRunner", "objective", "default_trial_timeout"]
+
+
+def default_trial_timeout() -> float:
+    """Per-trial wall budget in seconds: ``MXTPU_TUNE_TRIAL_TIMEOUT``
+    (default 300).  A wedged bench — deadlocked collective, hung
+    accelerator tunnel — is killed as a whole process group when the
+    budget expires and the trial scores ``inf``."""
+    try:
+        return float(os.environ.get("MXTPU_TUNE_TRIAL_TIMEOUT", "300"))
+    except ValueError:
+        return 300.0
 
 
 def objective(row: Optional[Dict[str, Any]]) -> float:
@@ -112,13 +124,14 @@ class TrialRunner(object):
 
     def __init__(self, bench_argv: Sequence[str],
                  run_dir: Optional[str] = None,
-                 timeout_s: float = 300.0,
+                 timeout_s: Optional[float] = None,
                  session: Optional[str] = None,
                  extra_env: Optional[Dict[str, str]] = None):
         self.bench_argv = list(bench_argv)
         self.run_dir = run_dir if run_dir is not None \
             else os.environ.get("MXTPU_RUN_DIR")
-        self.timeout_s = float(timeout_s)
+        self.timeout_s = float(timeout_s) if timeout_s is not None \
+            else default_trial_timeout()
         self.session = session or ("%08x" % (int(time.time() * 1e3)
                                              & 0xFFFFFFFF))
         self.extra_env = dict(extra_env or {})
@@ -157,23 +170,35 @@ class TrialRunner(object):
         error = None
         t0 = time.perf_counter()
         try:
-            proc = subprocess.run(
+            # own session/process group so a WEDGED bench (hung
+            # collective, deadlocked child it spawned) is killable as a
+            # unit — subprocess.run's timeout only signals the direct
+            # child and then blocks draining pipes grandchildren hold
+            proc = subprocess.Popen(
                 self.bench_argv,
                 env=self._trial_env(trial_id, config, bench_out),
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                timeout=self.timeout_s)
-            rc = proc.returncode
-            if rc == 0:
-                row = self._harvest(bench_out, proc.stdout)
-                if row is None:
-                    rc = -1
-                    error = "bench emitted no mxtpu-bench-v1 row"
-            else:
-                tail = proc.stderr.decode("utf-8", "replace")[-2000:]
-                error = "bench exited %d: %s" % (rc, tail)
-        except subprocess.TimeoutExpired:
-            rc = -9
-            error = "trial timed out after %.0fs" % self.timeout_s
+                start_new_session=True)
+            try:
+                out, err = proc.communicate(timeout=self.timeout_s)
+                rc = proc.returncode
+            except subprocess.TimeoutExpired:
+                self._kill_group(proc)
+                out, err = proc.communicate()
+                rc = -9
+                error = "trial timed out after %.0fs" % self.timeout_s
+                from .. import profiler as _prof
+
+                _prof.inc_stat("tune_trial_timeouts")
+            if error is None:
+                if rc == 0:
+                    row = self._harvest(bench_out, out)
+                    if row is None:
+                        rc = -1
+                        error = "bench emitted no mxtpu-bench-v1 row"
+                else:
+                    tail = err.decode("utf-8", "replace")[-2000:]
+                    error = "bench exited %d: %s" % (rc, tail)
         finally:
             try:
                 os.unlink(bench_out)
@@ -184,6 +209,17 @@ class TrialRunner(object):
         self.trials.append(trial)
         self._record(trial)
         return trial
+
+    @staticmethod
+    def _kill_group(proc: "subprocess.Popen") -> None:
+        """SIGKILL the trial's whole process group (best effort)."""
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            try:
+                proc.kill()
+            except OSError:
+                pass
 
     def _harvest(self, bench_out: str,
                  stdout: bytes) -> Optional[Dict[str, Any]]:

@@ -86,3 +86,58 @@ def test_process_workers_run_outside_the_parent():
 
     print("gil-bound crossover: processes %.3fs threads %.3fs"
           % (run(False), run(True)))
+
+
+class _ExplodingDataset:
+    """Batches 1-2 are fine; any index in batch 3 raises."""
+
+    def __len__(self):
+        return 12
+
+    def __getitem__(self, i):
+        if i >= 8:
+            raise RuntimeError("boom at %d" % i)
+        return np.full((2,), float(i), np.float32)
+
+
+def test_device_prefetch_error_sentinel_survives_full_queue(monkeypatch):
+    """Device-prefetch error path regression (mx.checkpoint PR): when
+    the worker hits an error WHILE the bounded queue is full, the
+    error sentinel must still cross to the consumer.  The old code
+    tried one 1s put and dropped the sentinel on queue.Full, leaving
+    the consumer blocked on get() forever; the fix retries the put
+    against the stop event like the normal path.  Sequenced so the
+    queue (depth 1) is provably full at raise time: the consumer holds
+    off long past the old drop window before draining."""
+    import threading
+    import time
+
+    from mxtpu.gluon.data.dataloader import DataLoader
+
+    monkeypatch.setenv("MXTPU_PREFETCH_DEVICE", "1")
+    ld = DataLoader(_ExplodingDataset(), batch_size=4)
+    outcome = {}
+
+    def consume():
+        it = iter(ld)
+        try:
+            first = next(it)          # starts the worker
+            # worker now: puts batch 2 (queue full), raises on batch 3,
+            # and must hold the sentinel until we drain.  1.5s > the
+            # old code's single 1.0s put timeout.
+            time.sleep(1.5)
+            second = next(it)         # drains batch 2
+            next(it)                  # must RAISE, not block
+            outcome["result"] = "no error raised"
+        except RuntimeError as e:
+            outcome["result"] = "raised"
+            outcome["batches"] = (first.asnumpy()[0, 0],
+                                  second.asnumpy()[0, 0])
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    t.join(timeout=20)
+    assert not t.is_alive(), \
+        "consumer hung: error sentinel was dropped on the full queue"
+    assert outcome.get("result") == "raised"
+    assert outcome["batches"] == (0.0, 4.0)

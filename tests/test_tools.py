@@ -239,6 +239,30 @@ def test_check_obs_guard():
     assert "check_obs OK" in out
 
 
+def test_check_checkpoint_smoke_guard():
+    """tools/check_checkpoint.py --smoke: a real 2x2 dist_sync run
+    with mx.checkpoint armed is SIGKILLed as a WHOLE fleet mid-epoch;
+    a fresh ``launch.py --auto-resume`` relaunch must restore every
+    role from the newest complete fleet manifest and finish with the
+    clean run's loss trajectory within 1e-5 — and the armed/disarmed
+    step-time comparison plus ckpt_async_write/ckpt_dropped counters
+    must show snapshots landing off the step path (see
+    mxtpu/checkpoint.py, docs/checkpoint.md)."""
+    out = _run(["tools/check_checkpoint.py", "--smoke"], timeout=420)
+    assert "check_checkpoint OK" in out
+
+
+@pytest.mark.slow
+def test_check_checkpoint_full_guard():
+    """Full crash gauntlet: the whole-fleet SIGKILL phase plus a
+    SIGKILL landing MID-CHECKPOINT-WRITE (MXTPU_CKPT_WRITE_DELAY
+    widens the window): the launcher's in-run auto-restart must skip
+    the torn fleet as a unit and resume from the PREVIOUS complete
+    manifest, still matching the clean trajectory."""
+    out = _run(["tools/check_checkpoint.py"], timeout=560)
+    assert "check_checkpoint OK" in out
+
+
 @pytest.mark.slow
 def test_check_elastic_full_guard():
     """Full chaos gauntlet: SIGKILL one worker (respawned by

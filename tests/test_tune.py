@@ -4,6 +4,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -354,3 +355,35 @@ def test_search_over_real_subprocess_trials(tmp_path):
     assert res.score == pytest.approx(100.0)
     assert res.baseline_score == pytest.approx(800.0)
     assert res.improved
+
+
+def test_trial_timeout_kills_wedged_bench(tmp_path, monkeypatch):
+    """MXTPU_TUNE_TRIAL_TIMEOUT (mx.checkpoint PR satellite): a
+    wedged bench — here sleeping far past the budget, in its own
+    process group with a child of its own — is killed as a group,
+    scores inf, and ticks ``tune_trial_timeouts``.  A sane config must
+    still beat it in the search ordering."""
+    from mxtpu import profiler
+
+    sleeper = tmp_path / "sleeping_bench.py"
+    sleeper.write_text(
+        "import subprocess, sys, time\n"
+        "# a grandchild holding the stdout pipe open — the case a\n"
+        "# bare child-kill leaks\n"
+        "subprocess.Popen([sys.executable, '-c', 'import time; "
+        "time.sleep(600)'])\n"
+        "time.sleep(600)\n")
+    monkeypatch.setenv("MXTPU_TUNE_TRIAL_TIMEOUT", "1.5")
+    assert tune.trial.default_trial_timeout() == 1.5
+    runner = tune.TrialRunner([sys.executable, str(sleeper)],
+                              run_dir=str(tmp_path))
+    assert runner.timeout_s == 1.5
+    pre = profiler.get_stat("tune_trial_timeouts")
+    t0 = time.perf_counter()
+    t = runner.run({"steps_per_program": "2"})
+    assert time.perf_counter() - t0 < 30
+    assert not t.ok
+    assert t.returncode == -9
+    assert t.score == float("inf")
+    assert "timed out" in (t.error or "")
+    assert profiler.get_stat("tune_trial_timeouts") == pre + 1

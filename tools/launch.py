@@ -41,6 +41,7 @@ Usage:  python tools/launch.py -n 2 [-s 1] python my_script.py args...
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import socket
@@ -128,6 +129,22 @@ def main(argv=None):
     ap.add_argument("--pid-dir", default=None,
                     help="write <role>-<i>.pid per child (chaos "
                          "harness hook)")
+    ap.add_argument("--auto-resume", action="store_true",
+                    help="fleet-level resume (docs/checkpoint.md): "
+                         "before launching, scan the checkpoint dir "
+                         "(MXTPU_CKPT_DIR, default MXTPU_RUN_DIR) for "
+                         "the newest COMPLETE fleet checkpoint and "
+                         "point every role at it via "
+                         "MXTPU_CKPT_RESTORE; when the fleet FAILS "
+                         "mid-run, kill the remainder, rescan, and "
+                         "relaunch the WHOLE fleet from the newest "
+                         "complete snapshot (up to "
+                         "--max-fleet-restarts times)")
+    ap.add_argument("--max-fleet-restarts", type=int, default=2,
+                    metavar="N",
+                    help="with --auto-resume: relaunch a failed fleet "
+                         "at most N times (default 2) before giving "
+                         "up with the last exit code")
     ap.add_argument("--serve-replicas", type=int, default=0,
                     metavar="N",
                     help="SERVING mode: spawn N replicas of the "
@@ -198,6 +215,99 @@ def main(argv=None):
         base["MXTPU_TELEMETRY_DIR"] = tdir
     agg = _arm_obs(base, tdir)
 
+    restarts_left = max(0, args.max_fleet_restarts) \
+        if args.auto_resume else 0
+    attempt = 0
+    try:
+        while True:
+            if args.auto_resume:
+                _arm_resume(base, attempt)
+            rc = _run_fleet(args, ns, base)
+            if rc == 0 or not args.auto_resume or restarts_left <= 0:
+                break
+            restarts_left -= 1
+            attempt += 1
+            print("launch.py: fleet failed (exit %d) — auto-resume "
+                  "relaunch %d (%d restart(s) left)"
+                  % (rc, attempt, restarts_left),
+                  file=sys.stderr, flush=True)
+            # a dead fleet can leave the old scheduler port in
+            # TIME_WAIT / half-closed state — every relaunch gets a
+            # fresh rendezvous port
+            base["MXTPU_PS_ROOT_PORT"] = str(_free_port())
+    finally:
+        _stop_obs(agg)
+    if args.telemetry_dir:
+        _merge_telemetry(base, tdir)
+    return rc
+
+
+def _arm_resume(base, attempt):
+    """Point the next fleet launch at the newest COMPLETE fleet
+    checkpoint (or run fresh when none exists).  The scan runs in a
+    framework child process — the launcher itself never imports mxtpu
+    — and the decision lands as MXTPU_CKPT_RESTORE in every role's
+    env plus one ``fleet_resume`` row in the run ledger."""
+    ckpt_base = base.get("MXTPU_CKPT_DIR") or base.get("MXTPU_RUN_DIR")
+    base.pop("MXTPU_CKPT_RESTORE", None)
+    if not ckpt_base:
+        return None
+    env = dict(base)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("MXTPU_TELEMETRY_DIR", None)
+    env["MXTPU_TELEMETRY"] = "0"
+    env["MXTPU_OBS"] = "0"
+    code = ("import sys, json\n"
+            "from mxtpu import checkpoint as c\n"
+            "r = c.find_resume(sys.argv[1])\n"
+            "if r is not None:\n"
+            "    print(json.dumps({'dir': r[0],\n"
+            "                      'id': r[1].get('id'),\n"
+            "                      'round': r[1].get('round')}))\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code, ckpt_base],
+                           env=env, capture_output=True, text=True,
+                           timeout=120)
+        found = json.loads(r.stdout.strip()) if r.returncode == 0 \
+            and r.stdout.strip() else None
+    except (subprocess.TimeoutExpired, OSError, ValueError) as e:
+        print("launch.py: auto-resume scan failed: %s" % e,
+              file=sys.stderr, flush=True)
+        return None
+    row = {"event": "fleet_resume", "ts": time.time(),
+           "attempt": attempt,
+           "run": base.get("MXTPU_RUN_ID"),
+           "ckpt_dir": found["dir"] if found else None,
+           "ckpt_id": found["id"] if found else None,
+           "round": found["round"] if found else None}
+    if found:
+        base["MXTPU_CKPT_RESTORE"] = found["dir"]
+        print("launch.py: auto-resume from %s (id %s, round %s)"
+              % (found["dir"], found["id"], found["round"]),
+              file=sys.stderr, flush=True)
+    else:
+        print("launch.py: auto-resume armed, no complete fleet "
+              "checkpoint under %s — starting fresh" % ckpt_base,
+              file=sys.stderr, flush=True)
+    run_dir = base.get("MXTPU_RUN_DIR")
+    if run_dir and base.get("MXTPU_RUN_ID"):
+        # same line-granularity jsonl the roles' obs ledger appends to
+        try:
+            os.makedirs(run_dir, exist_ok=True)
+            with open(os.path.join(
+                    run_dir, "%s.jsonl" % base["MXTPU_RUN_ID"]),
+                    "a") as f:
+                f.write(json.dumps(row) + "\n")
+        except OSError:
+            pass
+    return found
+
+
+def _run_fleet(args, ns, base):
+    """ONE local fleet generation: spawn scheduler + servers +
+    workers from ``base``, babysit to completion, reap.  Returns the
+    fleet exit code (0 = all workers finished clean)."""
     procs = []
 
     def spawn(role, index, extra=None):
@@ -279,9 +389,6 @@ def main(argv=None):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
-        _stop_obs(agg)
-    if args.telemetry_dir:
-        _merge_telemetry(base, tdir)
     return rc
 
 
