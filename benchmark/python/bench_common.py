@@ -70,21 +70,43 @@ def perf_summary() -> Optional[Dict[str, Any]]:
         return None
 
 
+def op_profile_summary() -> Optional[Dict[str, Any]]:
+    """The live `mx.xprof` per-op breakdown (compact: per-class us +
+    top sinks), or None when nothing was profiled this process.  Rows
+    carry it when the seed ran under ``--profile`` (or called
+    ``mx.xprof.profile``/``ingest`` itself) — the data
+    ``tools/compare_runs.py`` uses to answer WHICH op got slower."""
+    try:
+        import sys
+
+        mx = sys.modules.get("mxtpu")
+        if mx is None:
+            return None
+        return mx.xprof.bench_breakdown()
+    except Exception:
+        return None
+
+
 def row(bench: str, metric: str, value: float, unit: str,
         vs_baseline: Optional[float] = None,
         throughput: Optional[float] = None,
         step_time_us: Optional[float] = None,
         mfu: Optional[float] = None,
         phases: Optional[Dict[str, Any]] = None,
+        op_profile: Optional[Dict[str, Any]] = None,
         extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Build one structured result row (see module doc for schema).
-    ``mfu``/``phases`` default to the live `mx.perf` observatory."""
+    ``mfu``/``phases`` default to the live `mx.perf` observatory;
+    ``op_profile`` defaults to the live `mx.xprof` breakdown when one
+    exists (superset key — absent on runs that never profiled)."""
     p = perf_summary()
     if p is not None:
         if mfu is None:
             mfu = p.get("mfu")
         if phases is None:
             phases = p.get("phases_us_per_step")
+    if op_profile is None:
+        op_profile = op_profile_summary()
     # an `mx.tune` trial subprocess stamps its trial id into the row
     # so ledger rows are attributable to the trial that produced them
     trial = os.environ.get("MXTPU_TUNE_TRIAL")
@@ -104,6 +126,7 @@ def row(bench: str, metric: str, value: float, unit: str,
         "phases": phases,
         "knobs": knobs(),
         "extra": extra or {},
+        **({"op_profile": op_profile} if op_profile else {}),
     }
 
 

@@ -110,6 +110,7 @@ from . import tracing
 from . import inspect
 from . import health
 from . import perf
+from . import xprof
 from . import tune
 from . import resilience
 from . import checkpoint

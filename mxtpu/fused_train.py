@@ -46,6 +46,7 @@ from . import checkpoint as _ckpt
 from . import health as _health
 from . import perf as _perf
 from . import resilience as _res
+from . import xprof as _xprof
 
 __all__ = ["FusedTrainLoop"]
 
@@ -535,6 +536,9 @@ class FusedTrainLoop(object):
         # periodic snapshots and SIGTERM flushes both anchor here
         if _ckpt.active():
             _ckpt.on_boundary(self._t)
+        # mx.xprof auto-profile cadence (MXTPU_XPROF_EVERY, default
+        # off): when disarmed this is two int/bool checks per chunk
+        _xprof.maybe_autoprofile(self, data_stack)
         if self._collect:
             ctx = self._exec._ctx
             return [NDArray(o, ctx=ctx, _committed=True) for o in outs]

@@ -69,6 +69,7 @@ __all__ = [
     "hlo_histogram",
     "op_flops",
     "trace",
+    "EmptyTraceError",
     "blame_summary",
     "analyze_all",
     "reset",
@@ -400,6 +401,10 @@ class ProgramRecord(object):
         # "tune:key=ab12cd34,donate=0,passes=default" — set by
         # program() when `MXTPU_TUNE=apply` resolved a DB entry
         self.tuning: Optional[str] = None
+        # latest measured per-op attribution (mx.xprof, compact form:
+        # totals + per-class rollup + top sinks) — set by
+        # xprof.attach() whenever this program is profiled
+        self.op_profile: Optional[Dict[str, Any]] = None
         self.hits = 0          # unlocked bump: the <10us hot path
         self.compiles = 0      # dispatch-path compiles (ticks *_trace)
         self.aot_compiles = 0  # warmup/AOT builds (ticks *_warmup)
@@ -532,6 +537,8 @@ class ProgramRecord(object):
             d["sharding"] = self.sharding
         if self.tuning is not None:
             d["tuning"] = self.tuning
+        if self.op_profile is not None:
+            d["op_profile"] = self.op_profile
         if analyze and sig_infos:
             analysis = sig_infos[-1].analyze()
             d.update({k: v for k, v in analysis.items() if k != "error"})
@@ -1017,6 +1024,8 @@ def report(name_or_record=None, kind: Optional[str] = None) -> Dict[str, Any]:
         out["pass_report"] = rec.pass_report
     if rec.tuning is not None:
         out["tuning"] = rec.tuning
+    if rec.op_profile is not None:
+        out["op_profile"] = rec.op_profile
     try:
         out.update(hlo_histogram(si.hlo_text()))
     except Exception as e:
@@ -1028,21 +1037,44 @@ def report(name_or_record=None, kind: Optional[str] = None) -> Dict[str, Any]:
 # Device traces
 # ---------------------------------------------------------------------------
 
+class EmptyTraceError(MXNetError):
+    """`trace(dir)` finished but the profiler produced no xplane file
+    under the dir — the trace silently captured nothing (profiler
+    already active elsewhere, a crashed plugin, an unwritable dir).
+    Raised at trace exit so the caller learns NOW, not when a much
+    later `mx.xprof.ingest`/TensorBoard load finds the dir empty."""
+
+
 @contextlib.contextmanager
 def trace(logdir: str = "/tmp/mxtpu_trace", **kwargs):
     """The supported device-trace entry point: run a block under
     ``jax.profiler`` so kernel-level device timelines land in
-    ``logdir`` (open with TensorBoard's profile plugin or Perfetto).
+    ``logdir`` (open with TensorBoard's profile plugin or Perfetto,
+    or feed the dir to ``mx.xprof.ingest`` for the per-op report).
     With layer attribution on (the default), trace rows and HLO op
     metadata carry the gluon/Symbol layer names::
 
         with mx.inspect.trace("/tmp/tb"):
             mod.forward(batch, is_train=True)
+
+    Raises :class:`EmptyTraceError` when the profiler stopped without
+    writing an ``*.xplane.pb`` under ``logdir`` (the block itself
+    failing takes precedence — its exception propagates unchanged).
     """
     import jax
 
     jax.profiler.start_trace(logdir, **kwargs)
+    ok = False
     try:
         yield logdir
+        ok = True
     finally:
         jax.profiler.stop_trace()
+        if ok:
+            from . import xprof as _xprof
+
+            if not _xprof.find_xplane_files(logdir):
+                raise EmptyTraceError(
+                    "trace produced no .xplane.pb under %r — the "
+                    "profiler captured nothing (already active in "
+                    "another trace? unwritable dir?)" % logdir)

@@ -224,6 +224,19 @@ def sample() -> Optional[Dict[str, Any]]:
     tracing = m.get("tracing") or {}
     if tracing.get("dominant_segment"):
         row["critical_path"] = tracing["dominant_segment"]
+    # the role's top device-time sink (mx.xprof): a dict lookup into
+    # the latest attached OpProfile — sample() stays read-only
+    try:
+        from . import xprof as _xprof
+
+        sink = _xprof.top_sink()
+        if sink is not None:
+            row["top_sink"] = "%s:%.0f%%" % (
+                sink.get("op_class") or sink["op"],
+                100.0 * (sink.get("share") or 0.0))
+            row["top_sink_op"] = sink["op"]
+    except Exception:
+        pass
     if serve:
         row["serve"] = {
             "queue_depth": serve.get("queue_depth", 0),
@@ -745,7 +758,20 @@ def summary_row() -> Dict[str, Any]:
         "counters": _prof.stats(),
         "extra": {"samples": len(_RING),
                   "nonfinite_steps": m.get("nonfinite_steps", 0)},
-    }
+    } | _op_profile_block()
+
+
+def _op_profile_block() -> Dict[str, Any]:
+    """``{"op_profile": <compact breakdown>}`` when an `mx.xprof`
+    profile was attached this run (else empty) — what makes ledger
+    summary rows diffable per op class by ``tools/compare_runs.py``."""
+    try:
+        from . import xprof as _xprof
+
+        opb = _xprof.bench_breakdown()
+    except Exception:
+        opb = None
+    return {"op_profile": opb} if opb else {}
 
 
 def read_ledger(path: str) -> List[Dict[str, Any]]:
@@ -1146,6 +1172,12 @@ def aggregate_once(directory: str,
             # column)
             "critical_path": (m.get("tracing") or {}).get(
                 "dominant_segment"),
+            # the rank's top device-time sink (mx.xprof op profile),
+            # carried by the newest sample row that has one
+            "top_sink": next(
+                (s.get("top_sink")
+                 for s in reversed(tails.get(key) or [])
+                 if isinstance(s, dict) and s.get("top_sink")), None),
             "queue_depth": serve.get("queue_depth", 0)
             if isinstance(serve, dict) else 0,
         }

@@ -132,10 +132,16 @@ __all__ = [
 #: session (``action="session"``), or a DB config auto-applied at
 #: bind/hybridize/add_model (``action="apply"``, with the same
 #: provenance string `mx.inspect` stamps on program records).)
+#: (``op_profile`` = one `mx.xprof` per-op attribution attached to a
+#: program: acquisition source (xplane/replay), op count, per-step
+#: device time, per-op-class rollup and the top sink's name/class/
+#: share — how cluster.json and ``tools/dash.py`` name each rank's
+#: dominant device-time sink.)
 EVENT_KINDS = ("step", "compile", "kvstore", "kvstore_round", "retry",
                "failover", "membership", "checkpoint", "monitor",
                "timeout", "flight", "anomaly", "tensor_stats", "serve",
-               "reshard", "perf", "span", "tuning", "resume")
+               "reshard", "perf", "span", "tuning", "resume",
+               "op_profile")
 
 #: ``profiler.stats()`` keys that are point-in-time gauges, not
 #: additive counters: cluster aggregation takes their MAX, and counter

@@ -49,13 +49,47 @@ _PHASE_KNOBS = {
 #: but the prior only orders the queue)
 _MEM_BOUND_INTENSITY = 16.0
 
+# measured op class (`mx.xprof` attribution) -> the knobs that most
+# directly attack it.  Sharper than the phase table: "device_compute
+# dominates" says try passes/layout/remat in some order, while "wgrad
+# conv re-reads are 40% of device time" puts layout+remat FIRST.
+_CLASS_KNOBS = {
+    "conv": ("layout", "passes", "remat"),
+    "wgrad": ("remat", "layout"),
+    "matmul": ("remat", "donate"),
+    "bn": ("passes", "layout"),
+    "elementwise": ("passes",),
+    "copy": ("layout", "passes"),
+    "collective": ("steps_per_program",),
+    "optimizer": ("steps_per_program", "donate"),
+}
+
 
 def cost_model_priors(baseline_row: Optional[Dict[str, Any]],
-                      analysis: Optional[Dict[str, Any]] = None
+                      analysis: Optional[Dict[str, Any]] = None,
+                      op_profile: Optional[Dict[str, Any]] = None
                       ) -> Dict[str, float]:
     """Per-knob prior weight (higher = try earlier), from the baseline
-    row's phase attribution and the program's cost analysis."""
+    row's phase attribution and the program's cost analysis.
+    ``op_profile`` (an `mx.xprof` OpProfile or its compact form)
+    upgrades the modeled-cost prior with MEASURED per-op-class time:
+    the dominant classes push their knobs ahead of the phase table's
+    coarser guesses."""
     priors = {k.name: 1.0 for k in registry.knobs()}
+    classes = (op_profile or {}).get("op_classes") or {}
+    cls_total = sum(v for v in classes.values()
+                    if isinstance(v, (int, float))) or 0.0
+    if cls_total > 0:
+        for cls, us in sorted(classes.items(),
+                              key=lambda kv: -(kv[1] or 0)):
+            if not isinstance(us, (int, float)) or us <= 0:
+                continue
+            frac = us / cls_total
+            for knob in _CLASS_KNOBS.get(cls, ()):
+                if knob in priors:
+                    # measured beats modeled: a stronger push than the
+                    # phase table's 4x so op-profile evidence wins ties
+                    priors[knob] += 6.0 * frac
     phases = (baseline_row or {}).get("phases") or {}
     total = sum(v for v in phases.values()
                 if isinstance(v, (int, float))) or 0.0
@@ -185,7 +219,19 @@ def search(runner: TrialRunner,
 
     baseline_trial = runner.run(base)
     baseline_score = baseline_trial.score
-    priors = cost_model_priors(baseline_trial.row, analysis)
+    # measured per-op attribution when the baseline row carries one
+    # (bench seeds run with --profile) or a profile is attached to any
+    # registered program in this process — measured beats modeled
+    op_profile = (baseline_trial.row or {}).get("op_profile")
+    if op_profile is None:
+        try:
+            from .. import xprof as _xprof
+
+            op_profile = _xprof.last()
+        except Exception:
+            op_profile = None
+    priors = cost_model_priors(baseline_trial.row, analysis,
+                               op_profile=op_profile)
 
     cands = candidates_for(base, names)
     cands = rank_candidates(cands, base, priors)

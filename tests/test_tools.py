@@ -275,6 +275,19 @@ def test_check_elastic_full_guard():
     assert "check_elastic OK" in out
 
 
+def test_check_xprof_guard():
+    """tools/check_xprof.py: measured per-op attribution on a fused
+    conv-stack train run — the calibrated replay per-op sum must
+    reconcile with the mx.perf program wall within 15%, rows must be
+    layer-joined (conv1/conv2/fc1, wgrad class on backward convs), the
+    replay and in-tree-xplane paths must agree on a top (op_class,
+    layer) sink, profiling must add zero retraces/recompiles, and the
+    disabled-mode hook must stay under 10us/step (see mxtpu/xprof.py,
+    docs/observability.md §Op profiling)."""
+    out = _run(["tools/check_xprof.py"], timeout=420)
+    assert "check_xprof OK" in out
+
+
 def test_check_tune_guard():
     """tools/check_tune.py: a short REAL tuning session over >= 2
     knobs (donate x passes) must (a) persist a valid tuning-DB entry

@@ -1,0 +1,351 @@
+"""mx.xprof: in-tree xplane decoding, layer-joined per-op profiles,
+and the timed-eager-replay path across all three dispatch paths
+(see mxtpu/xprof.py, docs/observability.md §Op profiling)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import sym, xprof
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "golden.xplane.pb")
+
+
+# ---------------------------------------------------------------------------
+# Hand encoders: build wire-format bytes without any protobuf library
+# ---------------------------------------------------------------------------
+
+def _vint(v):
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field(fno, wt, payload):
+    return _vint((fno << 3) | wt) + payload
+
+
+def _ld(fno, payload):
+    return _field(fno, 2, _vint(len(payload)) + payload)
+
+
+def _build_space():
+    """A 1-plane / 1-line / 2-event XSpace exercising the edge cases:
+    multi-byte varints (metadata id 300, a >2^32 duration), a double
+    stat (fixed64), a negative int64 stat, an unknown fixed32 field
+    and an unknown field number (both must be skipped cleanly)."""
+    # map entry: key=300, value=XEventMetadata{id=300, name="dot.42"}
+    emd_value = _field(1, 0, _vint(300)) + _ld(2, b"dot.42")
+    emd_entry = _ld(4, _field(1, 0, _vint(300)) + _ld(2, emd_value))
+    smd_value = _field(1, 0, _vint(7)) + _ld(2, b"flops")
+    smd_entry = _ld(5, _field(1, 0, _vint(7)) + _ld(2, smd_value))
+    stat = (_field(1, 0, _vint(7))
+            + _field(2, 1, struct.pack("<d", 2.5))       # double
+            + _field(9, 5, struct.pack("<I", 0xDEAD))    # unknown f32
+            + _field(99, 0, _vint(1)))                   # unknown fno
+    stat_neg = _field(1, 0, _vint(7)) \
+        + _field(4, 0, _vint((-3) & ((1 << 64) - 1)))    # int64 = -3
+    ev1 = (_field(1, 0, _vint(300))                      # metadata_id
+           + _field(2, 0, _vint(1000))                   # offset_ps
+           + _field(3, 0, _vint(1 << 40))                # duration_ps
+           + _ld(4, stat))
+    ev2 = (_field(1, 0, _vint(300))
+           + _field(2, 0, _vint((1 << 40) + 2000))
+           + _field(3, 0, _vint(500_000_000))
+           + _field(5, 0, _vint(3))                      # occurrences
+           + _ld(4, stat_neg))
+    line = (_field(1, 0, _vint(1)) + _ld(2, b"XLA Ops")
+            + _field(3, 0, _vint(123)) + _ld(4, ev1) + _ld(4, ev2))
+    plane = (_field(1, 0, _vint(2)) + _ld(2, b"/device:TPU:0")
+             + _ld(3, line) + emd_entry + smd_entry)
+    return _ld(1, plane)
+
+
+def test_decoder_edge_cases():
+    space = xprof.decode_xspace(_build_space())
+    assert "truncated" not in space
+    (plane,) = space["planes"]
+    assert plane["name"] == "/device:TPU:0"
+    # multi-byte-varint map key joined to its metadata
+    assert plane["event_metadata"][300]["name"] == "dot.42"
+    assert plane["stat_metadata"][7]["name"] == "flops"
+    (line,) = plane["lines"]
+    ev1, ev2 = line["events"]
+    assert ev1["duration_ps"] == 1 << 40          # >2^32 varint
+    assert ev1["stats"][0]["value"] == 2.5        # fixed64 double
+    assert ev2["stats"][0]["value"] == -3         # signed int64
+    assert ev2["num_occurrences"] == 3
+
+
+def test_decoder_truncation_tolerance():
+    """Every prefix of a valid space decodes to a partial space —
+    a torn file read mid-write never raises."""
+    data = _build_space()
+    full = xprof.decode_xspace(data)
+    assert full["planes"][0]["lines"][0]["events"]
+    for cut in range(0, len(data), 7):
+        space = xprof.decode_xspace(data[:cut])
+        assert isinstance(space["planes"], list)
+    # cutting inside the plane's length-delimited body: the top level
+    # notices the overrun and flags it
+    assert xprof.decode_xspace(data[:len(data) // 2]).get("truncated")
+
+
+def test_decoder_group_wiretype_reads_as_torn():
+    """Wire types 3/4 (groups) can't be skipped without schema — the
+    decoder must keep what it has and flag truncation, not raise."""
+    data = _field(1, 3, b"")   # field 1, start-group
+    space = xprof.decode_xspace(data)
+    assert space["planes"] == []
+    assert space.get("truncated")
+    # a group INSIDE a plane keeps the already-decoded plane fields
+    plane = _ld(2, b"/device:TPU:0") + _field(9, 4, b"")
+    space = xprof.decode_xspace(_ld(1, plane))
+    assert space["planes"][0]["name"] == "/device:TPU:0"
+
+
+def test_golden_fixture_decodes_and_ingests():
+    """The committed jax-written golden capture: the wire decoder must
+    find its planes/lines/op events, and ingest() must produce a
+    normalized OpProfile from the file alone."""
+    with open(FIXTURE, "rb") as f:
+        space = xprof.decode_xspace(f.read())
+    assert "truncated" not in space
+    assert space["planes"], "golden fixture decoded to zero planes"
+    names = {md.get("name") for p in space["planes"]
+             for md in p["event_metadata"].values()}
+    assert any("dot" in (n or "") for n in names), sorted(names)[:20]
+
+    prof = xprof.ingest(FIXTURE, calibrate=False)
+    assert prof["source"] == "xplane"
+    assert prof["n_ops"] > 0
+    assert prof["device_us"] > 0
+    assert abs(sum(o["share"] for o in prof["ops"]) - 1.0) < 1e-2
+
+
+def test_golden_fixture_torn_copy_still_ingests(tmp_path):
+    with open(FIXTURE, "rb") as f:
+        data = f.read()
+    torn = tmp_path / "torn.xplane.pb"
+    torn.write_bytes(data[:len(data) * 2 // 3])
+    prof = xprof.ingest(str(torn), calibrate=False)  # must not raise
+    assert prof["source"] == "xplane"
+
+
+def test_ingest_empty_dir_raises(tmp_path):
+    with pytest.raises(mx.base.MXNetError):
+        xprof.ingest(str(tmp_path))
+
+
+def test_empty_trace_error(tmp_path, monkeypatch):
+    """inspect.trace must raise the typed error when the profiler
+    writes nothing (the silent-empty-trace fix)."""
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda *a, **k: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    with pytest.raises(mx.inspect.EmptyTraceError):
+        with mx.inspect.trace(str(tmp_path)):
+            pass
+    # the block's own exception takes precedence over the empty check
+    with pytest.raises(ValueError, match="boom"):
+        with mx.inspect.trace(str(tmp_path)):
+            raise ValueError("boom")
+
+
+# ---------------------------------------------------------------------------
+# Classification + layer join
+# ---------------------------------------------------------------------------
+
+def test_classify():
+    cases = [
+        (("convolution.4", None, None), "conv"),
+        (("convolution.9", "conv1", "bwd"), "wgrad"),
+        (("dot.3", "fc1", "bwd"), "wgrad"),
+        (("dot.1", "fc1", "fwd"), "matmul"),
+        (("batch-norm-training", "bn1", None), "bn"),
+        (("all-reduce.1", None, None), "collective"),
+        (("copy.2", None, None), "copy"),
+        (("transpose.7", None, None), "copy"),
+        (("sgd_update", None, None), "optimizer"),
+        (("add.13", None, None), "elementwise"),
+    ]
+    for args, want in cases:
+        assert xprof.classify(*args) == want, (args, want)
+
+
+def test_layer_of():
+    assert xprof._layer_of("jit(tr)/jvp(conv1)/conv") == \
+        ("conv1", "fwd")
+    assert xprof._layer_of(
+        "jit(tr)/transpose(jvp(conv1))/conv") == ("conv1", "bwd")
+    # deepest frame wins
+    assert xprof._layer_of(
+        "jit(tr)/jvp(block)/transpose(jvp(fc2))/dot") == ("fc2", "bwd")
+    # plain scope path: deepest named segment, no direction
+    assert xprof._layer_of("jit(tr)/softmax/reduce") == \
+        ("reduce", None)
+    assert xprof._layer_of("") == (None, None)
+
+
+def test_layer_map_from_hlo():
+    hlo = ('%dot.1 = f32[8,4] dot(%a, %b), '
+           'metadata={op_name="jit(step)/jvp(fc1)/dot_general"}\n'
+           '%add.2 = f32[8,4] add(%dot.1, %c), '
+           'metadata={op_name="jit(step)/transpose(jvp(fc1))/add"}\n')
+    m = xprof._layer_map_from_hlo(hlo)
+    assert m["dot.1"].endswith("jvp(fc1)/dot_general")
+    assert xprof._layer_of(m["dot.1"]) == ("fc1", "fwd")
+    assert xprof._layer_of(m["add.2"]) == ("fc1", "bwd")
+
+
+# ---------------------------------------------------------------------------
+# Timed eager replay across the three dispatch paths
+# ---------------------------------------------------------------------------
+
+def _mlp():
+    x = sym.Variable("data")
+    h = sym.FullyConnected(data=x, num_hidden=16, name="fc1")
+    h = sym.Activation(data=h, act_type="relu", name="relu1")
+    out = sym.FullyConnected(data=h, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(data=out,
+                             label=sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def _fill(ex):
+    rng = np.random.RandomState(0)
+    for k, a in sorted(ex.arg_dict.items()):
+        if k == "softmax_label":
+            a[:] = mx.nd.array(rng.randint(0, 4, a.shape[0])
+                               .astype("float32"))
+        else:
+            a[:] = mx.nd.array(rng.rand(*a.shape).astype("float32"))
+
+
+def _assert_profile(prof, wall_target=None):
+    assert prof["schema"] == xprof.SCHEMA
+    assert prof["source"] == "replay"
+    assert prof["n_ops"] > 0
+    # shares are rounded for display: sum within rounding noise of 1
+    assert abs(sum(o["share"] for o in prof["ops"]) - 1.0) < 1e-2
+    layers = {o.get("layer") for o in prof["ops"]}
+    assert {"fc1", "fc2"} <= layers, layers
+    if wall_target is not None:
+        opsum = sum(o["wall_us"] for o in prof["ops"])
+        assert abs(opsum - wall_target) / wall_target < 0.15, \
+            (opsum, wall_target)
+        assert prof["calibration"]["program_wall_us"] == wall_target
+
+
+def test_replay_executor(monkeypatch):
+    ex = _mlp().simple_bind(mx.cpu(), data=(8, 8),
+                            softmax_label=(8,), grad_req="write")
+    _fill(ex)
+    ex.forward(is_train=True)
+    ex.backward()
+    # pin the perf wall: the calibrated per-op sum must reconcile
+    monkeypatch.setattr(xprof, "_program_wall_us",
+                        lambda name: 1234.0)
+    prof = xprof.profile(ex)
+    _assert_profile(prof, wall_target=1234.0)
+    assert prof["kind"] == "train"
+    assert any(o.get("op_class") == "wgrad" for o in prof["ops"])
+    # the backward rows of non-conv/matmul ops are synthetic estimates
+    assert any(o.get("estimated") for o in prof["ops"])
+
+
+def test_replay_cachedop(monkeypatch):
+    from mxtpu import autograd
+
+    net = _mlp()
+    co = mx.CachedOp(net)
+    shapes, _, aux_shapes = net.infer_shape(data=(8, 8),
+                                            softmax_label=(8,))
+    rng = np.random.RandomState(1)
+    args = [mx.nd.array(rng.rand(*s).astype("float32"))
+            for s in shapes]
+    aux = [mx.nd.ones(s) for s in aux_shapes]
+    with autograd.record():
+        co(args, aux)
+    monkeypatch.setattr(xprof, "_program_wall_us",
+                        lambda name: 900.0)
+    prof = xprof.profile(co, data=args + aux, kind="train")
+    _assert_profile(prof, wall_target=900.0)
+
+
+def test_replay_fused_train_loop(monkeypatch):
+    from mxtpu.fused_train import FusedTrainLoop
+    from mxtpu.io.io import DataBatch
+
+    mod = mx.mod.Module(_mlp(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (8, 8))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    loop = FusedTrainLoop(mod, steps_per_program=2)
+    rng = np.random.RandomState(2)
+
+    def batches():
+        return [DataBatch(
+            data=[mx.nd.array(rng.rand(8, 8).astype("float32"))],
+            label=[mx.nd.array(rng.randint(0, 4, 8)
+                               .astype("float32"))])
+            for _ in range(2)]
+
+    loop.run(batches())
+    stacked = loop.stack_batches(batches())
+    loop.run_stacked(stacked)
+
+    from mxtpu import profiler
+
+    before = {k: v for k, v in profiler.stats().items()
+              if k.endswith("_trace")}
+    monkeypatch.setattr(xprof, "_program_wall_us",
+                        lambda name: 5000.0)
+    prof = xprof.profile(loop, data=[s[0] for s in stacked])
+    _assert_profile(prof, wall_target=5000.0)
+    after = {k: v for k, v in profiler.stats().items()
+             if k.endswith("_trace")}
+    assert after == before, "replay retraced the compiled program"
+    # consumer wiring: record + registry + top_sink
+    assert xprof.get(loop._insp.name) is prof
+    rec = mx.inspect.find(loop._insp.name)
+    assert rec.op_profile and rec.op_profile["top"]
+    sink = xprof.top_sink()
+    assert sink and sink["program"] == loop._insp.name
+    loop.finalize()
+
+
+def test_profile_disabled_returns_none():
+    xprof.enable(False)
+    try:
+        assert xprof.profile(object()) is None
+    finally:
+        xprof.enable(True)
+
+
+def test_format_report_and_bench_breakdown(monkeypatch):
+    ex = _mlp().simple_bind(mx.cpu(), data=(4, 8),
+                            softmax_label=(4,), grad_req="write")
+    _fill(ex)
+    ex.forward(is_train=True)
+    prof = xprof.profile(ex, calibrate=False)
+    txt = xprof.format_report(prof, k=5)
+    assert "top sink:" in txt and "fc1" in txt
+    compact = xprof.bench_breakdown(prof, k=3)
+    assert len(compact["top"]) <= 3
+    assert compact["op_classes"]
+    assert "ops" not in compact  # compact form never embeds full list

@@ -16,6 +16,10 @@ changed between these two runs, and what did it do to the numbers?*
   * **phase shifts** — the per-step phase attribution
     (input_wait/host_dispatch/...) of run A vs run B, naming where
     the time moved;
+  * **op-sink shifts** — when both runs' bench rows carry the
+    `mx.xprof` ``op_profile`` breakdown (seeds run with profiling),
+    per-op-class device-time deltas plus the top-sink change: WHICH
+    op class got slower, not just which phase;
   * **sample-series view** — per-run sample counts and averaged
     step-time/MFU over the time series (not just the final instant).
 
@@ -148,6 +152,35 @@ def phase_shifts(a, b):
     return rows
 
 
+def _top_sink(row):
+    top = ((row.get("op_profile") or {}).get("top") or [{}])[0]
+    if not top.get("op"):
+        return None
+    return "%s [%s] %.0f%%" % (top.get("op"), top.get("op_class"),
+                               100.0 * (top.get("share") or 0.0))
+
+
+def op_sink_shifts(a, b):
+    """Per-op-class device-time deltas (us) when BOTH runs carry the
+    `mx.xprof` ``op_profile`` breakdown on their bench rows — this is
+    the answer to WHICH op moved, one level below the phase shifts.
+    Returns (class_rows, top_a, top_b) or None when either run lacks a
+    profile."""
+    pa = a.get("op_profile") or {}
+    pb = b.get("op_profile") or {}
+    ca, cb = pa.get("op_classes") or {}, pb.get("op_classes") or {}
+    if not ca or not cb:
+        return None
+    rows = []
+    for k in sorted(set(ca) | set(cb)):
+        va, vb = ca.get(k, 0.0), cb.get(k, 0.0)
+        if va or vb:
+            rows.append((k, va, vb, _pct(va, vb)))
+    # biggest mover first — the headline of the diff
+    rows.sort(key=lambda r: -abs((r[2] or 0) - (r[1] or 0)))
+    return rows, _top_sink(a), _top_sink(b)
+
+
 def _fmt_num(v):
     if v is None:
         return "-"
@@ -174,6 +207,15 @@ def report(path_a, path_b):
                           "pct": p}
                          for ph, va, vb, p in phase_shifts(a, b)],
     }
+    sinks = op_sink_shifts(a, b)
+    if sinks is not None:
+        class_rows, top_a, top_b = sinks
+        out["op_sink_shifts"] = {
+            "classes": [{"op_class": c, "a_us": va, "b_us": vb,
+                         "pct": p}
+                        for c, va, vb, p in class_rows],
+            "top_sink_a": top_a, "top_sink_b": top_b,
+        }
     return out
 
 
@@ -216,6 +258,19 @@ def print_report(rep):
             print("  %-28s %10s -> %10s%s"
                   % (d["phase"], _fmt_num(d["a_us"]),
                      _fmt_num(d["b_us"]), pct))
+    sinks = rep.get("op_sink_shifts")
+    if sinks:
+        print()
+        print("op-class device-time shifts (us, mx.xprof):")
+        for d in sinks["classes"]:
+            pct = ("  (%+.1f%%)" % d["pct"]) \
+                if d["pct"] is not None else ""
+            print("  %-28s %10s -> %10s%s"
+                  % (d["op_class"], _fmt_num(d["a_us"]),
+                     _fmt_num(d["b_us"]), pct))
+        print("  top sink: %s -> %s"
+              % (sinks.get("top_sink_a") or "-",
+                 sinks.get("top_sink_b") or "-"))
 
 
 def main(argv=None):

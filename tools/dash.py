@@ -78,10 +78,11 @@ def render(cluster, width=100):
                    and r.get("steps")}
     straggler = max(worker_avgs, key=worker_avgs.get) \
         if len(worker_avgs) >= 2 else None
-    lines.append("%-12s %7s %9s %9s %6s %-15s %-14s %6s %5s %5s %-16s"
+    lines.append("%-12s %7s %9s %9s %6s %-15s %-14s %-13s %6s %5s %5s "
+                 "%-16s"
                  % ("rank", "steps", "step(ms)", "avg(ms)", "MFU",
-                    "phase", "crit-path", "queue", "anom", "retry",
-                    "step trend"))
+                    "phase", "crit-path", "top-sink", "queue", "anom",
+                    "retry", "step trend"))
     for key in sorted(roles):
         r = roles[key]
         flags = ""
@@ -91,8 +92,8 @@ def render(cluster, width=100):
             flags = "  < straggler"
         tail = samples.get(key) or []
         spark = sparkline([s.get("step_time_ms") for s in tail])
-        lines.append("%-12s %7s %9s %9s %6s %-15s %-14s %6s %5s %5s "
-                     "%-16s%s"
+        lines.append("%-12s %7s %9s %9s %6s %-15s %-14s %-13s %6s %5s "
+                     "%5s %-16s%s"
                      % (key,
                         _fmt(r.get("steps"), "%d"),
                         _fmt(r.get("step_time_ms"), "%.1f"),
@@ -102,6 +103,9 @@ def render(cluster, width=100):
                         # the role's dominant critical-path segment
                         # (mx.tracing sampled-span summary)
                         _fmt(r.get("critical_path")),
+                        # the rank's top device-time sink (mx.xprof
+                        # op profile: "class:share%")
+                        _fmt(r.get("top_sink")),
                         _fmt(r.get("queue_depth"), "%d"),
                         _fmt(r.get("anomalies"), "%d"),
                         _fmt(r.get("retries"), "%d"),

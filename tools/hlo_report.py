@@ -213,6 +213,34 @@ def main():
                 "peak_bytes": p.get("peak_bytes"),
                 "roofline": rf,
             }
+            # measured-time column: when an `mx.xprof` profile exists
+            # for this program (this process ran profile()/ingest(), or
+            # the registry record carries a compact op_profile), the
+            # static roofline row gains the MEASURED side — device us,
+            # achieved GFLOP/s vs the modeled bound, and the top sink
+            prof = mx.xprof.get(p["name"]) or p.get("op_profile")
+            if prof:
+                flops = float(p.get("flops") or 0.0)
+                dev_us = prof.get("device_us")
+                rows[p["name"]]["measured"] = {
+                    "source": prof.get("source"),
+                    "device_us": dev_us,
+                    "idle_us": prof.get("idle_us"),
+                    "achieved_gflops": round(
+                        flops / dev_us / 1e3, 2)
+                    if flops and dev_us else None,
+                    "pct_peak_flops": round(
+                        flops / (dev_us * 1e-6)
+                        / mxperf.peak_flops() * 100.0, 2)
+                    if flops and dev_us else None,
+                    "top_sink": [
+                        {"op": o.get("op"),
+                         "op_class": o.get("op_class"),
+                         "layer": o.get("layer"),
+                         "wall_us": o.get("wall_us"),
+                         "share": o.get("share")}
+                        for o in (prof.get("top") or [])[:3]],
+                }
         report["roofline"] = {
             "peak_flops_per_s": mxperf.peak_flops(),
             "peak_bytes_per_s": mxperf.peak_bytes(),

@@ -1,20 +1,24 @@
 #!/usr/bin/env python
 """Profile the production train loop on the current backend and
-attribute the step time (VERDICT r4 next #2: "close the MFU gap with a
-profile-driven loop").
+attribute the step time PER OP via `mx.xprof` (VERDICT r4 next #2:
+"close the MFU gap with a profile-driven loop").
 
-Captures, for the same ResNet-50 training configuration bench.py
-times:
+For each configuration (dtype x conv layout x steps-per-program):
 
-1. a jax.profiler trace (xprof / chrome://tracing protobuf) of K fused
-   steps -> --trace-dir;
-2. a host-side phase attribution: input staging (host->device), program
-   dispatch+execute (device), and publish (weight readback), so the
-   idle fraction is split between the input pipeline, dispatch
-   latency, and HLO quality;
-3. an MFU estimate per configuration (fp32/bf16 x NCHW/NHWC x
-   steps-per-program), printed as one JSON line per config for
-   BENCH_NOTES.
+1. runs K fused steps through `FusedTrainLoop` so the `mx.perf`
+   observatory measures the program wall (sampled call->ready);
+2. builds the measured per-op attribution with BOTH `mx.xprof`
+   acquisition paths: a timed eager replay (every backend), and —
+   unless ``--no-trace`` — an xplane ingestion of a real
+   ``mx.inspect.trace`` capture (device-ground-truth op events, layer-
+   joined through the HLO op_name metadata);
+3. prints the top-sink report plus one JSON line per config (the
+   ``mxtpu-bench-v1``-style record now carries the ``op_profile``
+   breakdown) for BENCH_NOTES.
+
+The old ad-hoc staging/execute stopwatch split is gone: staging shows
+up as the `mx.perf` ``input_wait``/``host_dispatch`` phases and the
+per-op report names what the device time is actually spent on.
 
 Usage (on the chip):   python tools/profile_train.py --iters 6
 CPU sanity run:        JAX_PLATFORMS=cpu python tools/profile_train.py \
@@ -29,9 +33,12 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
-import numpy as np
+# a profiling tool wants the measured program wall (MFU denominator +
+# replay-calibration target): sample the device sync every other chunk
+os.environ.setdefault("MXTPU_PERF", "1")
+os.environ.setdefault("MXTPU_PERF_SYNC_EVERY", "2")
 
-TRAIN_GFLOP_PER_IMG_224 = 12.3   # fwd ~4.1 GFLOP x3 (fwd+bwd)
+import numpy as np
 
 
 def build_loop(batch, image, dtype, spp):
@@ -82,68 +89,57 @@ def one_config(args, dtype, layout):
                         .astype(np.float32))])
                 for _ in range(args.spp)]
 
-    # ---- phase attribution ----
     t0 = time.perf_counter()
-    stacked = loop.stack_batches(batches())
-    jax.block_until_ready([v._data if hasattr(v, "_data") else v
-                           for v in stacked])
-    t_stage0 = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    loop.run_stacked(stacked)    # compile + first execute
+    loop.run(batches())              # compile + first execute
     t_compile = time.perf_counter() - t0
 
+    # measurement loop: mx.perf samples the program wall on its
+    # MXTPU_PERF_SYNC_EVERY cadence — that wall is both the MFU
+    # denominator and the replay-calibration target
+    t0 = time.perf_counter()
+    images = 0
+    stacked = None
+    for _ in range(args.iters):
+        stacked = loop.stack_batches(batches())
+        loop.run_stacked(stacked)
+        images += args.batch * args.spp
+    jax.block_until_ready(loop._p_vals)
+    exec_s = time.perf_counter() - t0
+    loop.finalize()
+
+    # acquisition path (b): timed eager replay, calibrated to the
+    # measured program wall — works on every backend
+    replay = mx.xprof.profile(loop, data=[s[0] for s in stacked])
+    if replay is not None:
+        print(mx.xprof.format_report(replay, k=args.top))
+
+    # acquisition path (a): a real device trace, ingested in-tree
     trace_dir = None
+    xplane = None
     if args.trace_dir and dtype == args.trace_dtype and \
             layout == args.trace_layout:
         trace_dir = os.path.join(args.trace_dir,
                                  "%s_%s" % (dtype, layout or "nchw"))
-        jax.profiler.start_trace(trace_dir)
+        with mx.inspect.trace(trace_dir):
+            loop.run_stacked(loop.stack_batches(batches()))
+            jax.block_until_ready(loop._p_vals)
+        xplane = mx.xprof.ingest(trace_dir, program=loop._insp.name,
+                                 kind="train", steps=args.spp)
+        print(mx.xprof.format_report(xplane, k=args.top))
 
-    stage_s = exec_s = 0.0
-    images = 0
-    for _ in range(args.iters):
-        bs = batches()           # host data generation: NOT staging
-        t0 = time.perf_counter()
-        stacked = loop.stack_batches(bs)
-        jax.block_until_ready([v._data if hasattr(v, "_data") else v
-                               for v in stacked])
-        stage_s += time.perf_counter() - t0
-        t0 = time.perf_counter()
-        loop.run_stacked(stacked)
-        # run_stacked dispatches asynchronously — block on the updated
-        # params so the execute phase is charged to THIS timer, not to
-        # the next stage's block_until_ready
-        jax.block_until_ready(loop._p_vals)
-        exec_s += time.perf_counter() - t0
-        images += args.batch * args.spp
-
-    t0 = time.perf_counter()
-    loop.finalize()              # publish weights back to the module
-    t_publish = time.perf_counter() - t0
-    if trace_dir:
-        jax.profiler.stop_trace()
-
-    wall = stage_s + exec_s
-    gflop_per_img = TRAIN_GFLOP_PER_IMG_224 * (args.image / 224.0) ** 2
-    tflops = images * gflop_per_img / max(exec_s, 1e-9) / 1e3
-    peak = float(os.environ.get("MXTPU_PEAK_TFLOPS", "197"))
-    if dtype == "float32":
-        peak = min(peak, float(os.environ.get(
-            "MXTPU_PEAK_TFLOPS_F32", str(peak / 2))))
+    perf_row = mx.perf.report().get("programs", {}) \
+        .get(loop._insp.name, {})
+    prof = xplane or replay
     rec = {
         "dtype": dtype, "layout": layout or "NCHW", "spp": args.spp,
         "batch": args.batch, "image": args.image,
-        "img_per_s_exec": images / max(exec_s, 1e-9),
-        "img_per_s_wall": images / max(wall, 1e-9),
+        "img_per_s": images / max(exec_s, 1e-9),
         "exec_ms_per_step": exec_s * 1e3 / (args.iters * args.spp),
-        "stage_ms_per_step": stage_s * 1e3 / (args.iters * args.spp),
-        "input_pipeline_frac": stage_s / max(wall, 1e-9),
         "compile_s": round(t_compile, 2),
-        "first_stage_s": round(t_stage0, 3),
-        "publish_s": round(t_publish, 3),
-        "device_tflops": round(tflops, 2),
-        "mfu_vs_peak": round(tflops / peak, 4),
+        "mfu": perf_row.get("mfu"),
+        "wall_us_avg": perf_row.get("wall_us_avg"),
+        "phases": mx.perf.report().get("phases_us_per_step"),
+        "op_profile": mx.xprof.bench_breakdown(prof) if prof else None,
         "trace": trace_dir,
     }
     print(json.dumps(rec))
@@ -157,13 +153,15 @@ def main():
     ap.add_argument("--iters", type=int, default=6,
                     help="timed windows per config")
     ap.add_argument("--spp", type=int, default=8)
+    ap.add_argument("--top", type=int, default=10,
+                    help="top-K sinks to print per config")
     ap.add_argument("--configs", default="float32:,bfloat16:,"
                     "float32:NHWC,bfloat16:NHWC",
                     help="comma list of dtype:layout")
     ap.add_argument("--trace-dir", default="/tmp/mxtpu_trace")
     ap.add_argument("--no-trace", action="store_true")
     ap.add_argument("--trace-dtype", default="bfloat16",
-                    help="config that gets the xprof trace")
+                    help="config that gets the device trace")
     ap.add_argument("--trace-layout", default="")
     args = ap.parse_args()
     if args.no_trace:
