@@ -87,6 +87,34 @@ def op_profile_summary() -> Optional[Dict[str, Any]]:
         return None
 
 
+def hbm_summary() -> Optional[Dict[str, Any]]:
+    """The live `mx.hbm` device-memory view: process peak-used bytes
+    plus the per-class plan of the biggest registered program (the
+    data ``tools/compare_runs.py`` uses to answer WHICH memory class
+    grew).  None when the framework was never imported or the census
+    is off."""
+    try:
+        import sys
+
+        mx = sys.modules.get("mxtpu")
+        if mx is None or not mx.hbm.enabled():
+            return None
+        c = mx.hbm.census()
+        if not c.get("enabled"):
+            return None
+        out = {"peak_hbm_bytes": c.get("peak_used_bytes", 0),
+               "used_bytes": c.get("used_bytes", 0),
+               "headroom_bytes": c.get("headroom_bytes", 0)}
+        plans = mx.hbm.report(top=1).get("plans") or []
+        if plans:
+            out["plan"] = {"program": plans[0].get("program"),
+                           "peak_bytes": plans[0].get("peak_bytes"),
+                           "classes": plans[0].get("classes")}
+        return out
+    except Exception:
+        return None
+
+
 def row(bench: str, metric: str, value: float, unit: str,
         vs_baseline: Optional[float] = None,
         throughput: Optional[float] = None,
@@ -94,11 +122,13 @@ def row(bench: str, metric: str, value: float, unit: str,
         mfu: Optional[float] = None,
         phases: Optional[Dict[str, Any]] = None,
         op_profile: Optional[Dict[str, Any]] = None,
+        hbm: Optional[Dict[str, Any]] = None,
         extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Build one structured result row (see module doc for schema).
     ``mfu``/``phases`` default to the live `mx.perf` observatory;
     ``op_profile`` defaults to the live `mx.xprof` breakdown when one
-    exists (superset key — absent on runs that never profiled)."""
+    exists; ``hbm`` defaults to the live `mx.hbm` census + top plan
+    (superset keys — absent on runs without them)."""
     p = perf_summary()
     if p is not None:
         if mfu is None:
@@ -107,6 +137,8 @@ def row(bench: str, metric: str, value: float, unit: str,
             phases = p.get("phases_us_per_step")
     if op_profile is None:
         op_profile = op_profile_summary()
+    if hbm is None:
+        hbm = hbm_summary()
     # an `mx.tune` trial subprocess stamps its trial id into the row
     # so ledger rows are attributable to the trial that produced them
     trial = os.environ.get("MXTPU_TUNE_TRIAL")
@@ -127,6 +159,8 @@ def row(bench: str, metric: str, value: float, unit: str,
         "knobs": knobs(),
         "extra": extra or {},
         **({"op_profile": op_profile} if op_profile else {}),
+        **({"peak_hbm_bytes": hbm.get("peak_hbm_bytes"),
+            "hbm_plan": hbm.get("plan")} if hbm else {}),
     }
 
 

@@ -111,6 +111,7 @@ from . import inspect
 from . import health
 from . import perf
 from . import xprof
+from . import hbm
 from . import tune
 from . import resilience
 from . import checkpoint

@@ -128,6 +128,16 @@ class CachedOp(object):
             # block names are per-process unique; bare symbol head
             # names (direct CachedOp users) are not
             reuse=bool(block_name))
+        # device-memory layout (mx.hbm): the flat call tree is
+        # (key, *args, *aux); data slots carry the batch dim, every
+        # other arg (and the aux running stats) is model state
+        self._insp.mem_layout = {
+            "layout": "cachedop",
+            "arg_names": list(self._arg_names),
+            "aux_names": list(self._aux_names),
+            "data_idx": list(self._data_idx),
+            "n_outputs": self._n_outputs,
+        }
 
     @property
     def symbol(self) -> Symbol:
@@ -227,6 +237,8 @@ class CachedOp(object):
         this from their arg mapping; direct users whose data variables
         don't follow the ``data%d`` naming convention should too."""
         self._data_idx = [int(i) for i in indices]
+        if self._insp.mem_layout is not None:
+            self._insp.mem_layout["data_idx"] = list(self._data_idx)
 
     def _bucket_spec(self) -> Optional[str]:
         """Per-op flag (`hybridize(shape_buckets=...)`) wins over the

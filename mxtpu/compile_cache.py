@@ -430,7 +430,8 @@ def aot_compile(jitfn, example_args, program=None, kind="aot"):
     compiled = _res.run_with_retry("compile", body)
     if program is not None:
         program.record_aot(kind, example_args, compiled,
-                           _time.perf_counter() - t0, event=ev)
+                           _time.perf_counter() - t0, event=ev,
+                           jitfn=jitfn)
     return compiled
 
 

@@ -337,6 +337,16 @@ class Executor(object):
         self._insp = _insp.program("executor", symbol.name,
                                    arg_names=self._arg_names,
                                    symbol=symbol)
+        # device-memory layout (mx.hbm): how this site's example-arg
+        # tree (arg_vals, aux_vals, key[, ograds]) maps to the plan's
+        # param/data/grad classes — diff args are params, the rest is
+        # input data
+        self._insp.mem_layout = {
+            "layout": "executor",
+            "arg_names": list(self._arg_names),
+            "param_names": [self._arg_names[i] for i in self._diff_idx],
+            "aux_names": list(self._aux_names),
+        }
 
     # -- binding entry points --------------------------------------------
     @staticmethod

@@ -196,6 +196,17 @@ class FusedTrainLoop(object):
             "fused_train", ex._symbol.name,
             arg_names=[self._arg_names[i] for i in self._data_idx],
             symbol=ex._symbol)
+        # device-memory layout (mx.hbm): the program tree is (p_vals,
+        # s_tree, aux_vals, fixed_vals, base_key, t0, data_stack,
+        # lr_rows) — params/opt-state/aux are the donated carry, the
+        # stacks are (K, B, ...) input data
+        self._insp.mem_layout = {
+            "layout": "fused_train",
+            "param_names": [self._arg_names[i] for i in self._diff_idx],
+            "aux_names": list(ex._aux_names),
+            "fixed_names": [self._arg_names[i] for i in self._fixed_idx],
+            "data_names": [self._arg_names[i] for i in self._data_idx],
+        }
         self._seen_sigs: set = set()
 
     def _init_sharded_carry(self, weights) -> None:

@@ -1,0 +1,1114 @@
+"""Device-memory observatory (`mx.hbm`): the fourth attribution axis.
+
+`mx.perf` answers "which phase", `mx.xprof` answers "which op",
+`mx.tracing` answers "which request" — this module answers **which
+bytes**.  Three layers, all read-only with respect to the device:
+
+  * **Static memory plan** (:func:`plan`) — decode XLA's
+    ``memory_analysis()`` for any program in the `mx.inspect` registry
+    into a per-program byte budget: peak HBM decomposed **by class**
+    (params / grads / optimizer_state / data / activations_temps /
+    collective_scratch / outputs, with donated-aliased bytes named so
+    donation never double-counts) and **by layer** (parameter names +
+    the xprof named-scope layer join over the optimized HLO).  The
+    classes sum to the analysis peak *by construction* — any residual
+    the decode could not attribute lands in ``unattributed``, so the
+    budget always reconciles.  "What would ZeRO-2 free" and "what does
+    remat trade" become one dict lookup (``plan()["what_if"]``).
+    Plans attach to the owning :class:`~mxtpu.inspect.ProgramRecord`
+    and ride ``mx.inspect.report()``.  Like the rest of the lazy
+    inspect analysis, ``plan()`` may compile (never on a hot path).
+
+  * **Live census + leak detector** (:func:`census`) — an always-on
+    (budgeted, ``MXTPU_HBM=0`` opt-out) sample of
+    ``device.memory_stats()`` plus a rate-limited ``jax.live_arrays()``
+    sweep bucketed by (shape, dtype) and joined back to the owning
+    registry program/layer through the static plans' input layouts.
+    Strictly read-only: never compiles, never syncs a device (the CI
+    guard ``tools/check_hbm.py`` freezes the compile counters across a
+    scrape burst to prove it).  A rolling-window growth detector names
+    the top-growing (program, layer, dtype) buckets as a telemetry
+    ``anomaly`` event (``atype="memory_leak"``) BEFORE the OOM, not
+    after.  Published as the ``"hbm"`` metrics provider, so the data
+    flows through ``metrics()`` → `mx.obs` sampler/OpenMetrics →
+    heartbeat → ``cluster.json`` with zero new wiring.
+
+  * **Headroom + what-if capacity** (:func:`headroom`,
+    :func:`max_batch`, :func:`fits`) — live free-byte gauge (allocator
+    limit on real devices; RLIMIT_AS-aware process budget on CPU) and
+    a linear capacity model fit across the already-compiled shape
+    buckets of a program (peak bytes vs batch), answering "largest
+    batch that still fits" / "does this model set fit".  `mx.serve`
+    consults it at ``add_model`` and in the OOM shrink path to
+    pre-shrink bucket caps instead of reacting to RESOURCE_EXHAUSTED.
+
+Env knobs (see docs/env_vars.md): ``MXTPU_HBM`` (master switch,
+default on), ``MXTPU_HBM_SWEEP_S`` (min seconds between live-array
+sweeps, default 2), ``MXTPU_HBM_WINDOW`` (growth-detector window in
+samples, default 6), ``MXTPU_HBM_GROWTH_MB`` (per-bucket growth
+threshold, default 64), ``MXTPU_HBM_LIMIT_BYTES`` (capacity-limit
+override), ``MXTPU_HBM_PRESHRINK`` (serve cap-trim gate, default
+off — the capacity advisory is always recorded either way).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .base import MXNetError, getenv, getenv_bool
+
+__all__ = [
+    "enabled",
+    "enable",
+    "CLASSES",
+    "plan",
+    "census",
+    "sweep_live",
+    "device_stats",
+    "limit_bytes",
+    "headroom",
+    "observe_used",
+    "metrics_block",
+    "leaks",
+    "capacity_model",
+    "max_batch",
+    "fits",
+    "report",
+    "reset",
+]
+
+_ENABLED = getenv_bool("MXTPU_HBM", True)
+#: min seconds between live_arrays sweeps (the sweep walks every
+#: buffer — milliseconds on a big process — so it is budgeted; the
+#: O(1) device_stats part of the census has no such limit)
+_SWEEP_S = float(getenv("MXTPU_HBM_SWEEP_S", "2") or 2)
+#: growth-detector window (in census samples)
+_WINDOW = max(2, int(getenv("MXTPU_HBM_WINDOW", "6") or 6))
+#: a (program, layer, dtype) bucket growing this much across the
+#: window — while growing in most consecutive samples — is a leak
+_GROWTH_BYTES = int(float(getenv("MXTPU_HBM_GROWTH_MB", "64") or 64)
+                    * 2**20)
+
+#: the class taxonomy of the memory plan (docs/observability.md)
+CLASSES = ("params", "grads", "optimizer_state", "data",
+           "activations_temps", "collective_scratch", "outputs",
+           "unattributed")
+
+_lock = threading.RLock()
+
+# plan cache: (program name, kind, signature) -> plan dict.  Bounded —
+# long-lived processes register hundreds of programs.
+_PLAN_CACHE: "collections.OrderedDict[Tuple, Dict[str, Any]]" = \
+    collections.OrderedDict()
+_PLAN_CACHE_MAX = 256
+
+
+def enabled() -> bool:
+    """Live-census machinery on?  ``MXTPU_HBM=0`` opts out (the static
+    :func:`plan` decode stays available either way)."""
+    return _ENABLED
+
+
+def enable(on: bool = True) -> None:
+    """Flip the observatory at runtime (tests / embedding)."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+# ---------------------------------------------------------------------------
+# Small helpers
+# ---------------------------------------------------------------------------
+
+def _leaf_nbytes(leaf) -> int:
+    """Logical byte size of one array/ShapeDtypeStruct leaf."""
+    import numpy as np
+
+    try:
+        n = 1
+        for d in leaf.shape:
+            n *= int(d)
+        return int(n * np.dtype(leaf.dtype).itemsize)
+    except Exception:
+        return 0
+
+
+def _is_arrayish(v) -> bool:
+    return hasattr(v, "shape") and hasattr(v, "dtype")
+
+
+def _leaves(tree) -> List[Any]:
+    import jax
+
+    return [v for v in jax.tree_util.tree_leaves(tree) if _is_arrayish(v)]
+
+
+_PARAM_SUFFIX_RE = re.compile(
+    r"_(weight|bias|gamma|beta|moving_mean|moving_var|running_mean|"
+    r"running_var|w|b)\d*$")
+
+
+def _layer_guess(param_name: str) -> str:
+    """Layer name from a parameter/aux name (``conv0_weight`` →
+    ``conv0``) — the same convention the symbol graph uses."""
+    return _PARAM_SUFFIX_RE.sub("", param_name) or param_name
+
+
+def _resolve(name_or_record=None):
+    """Mirror ``inspect.report``'s program resolution."""
+    from . import inspect as _insp
+
+    if name_or_record is None:
+        with _insp._lock:
+            if not _insp._REGISTRY:
+                raise MXNetError("no programs registered yet")
+            return next(reversed(_insp._REGISTRY.values()))
+    if isinstance(name_or_record, _insp.ProgramRecord):
+        return name_or_record
+    rec = _insp.find(name_or_record)
+    if rec is None:
+        raise MXNetError("no registered program matches %r"
+                         % name_or_record)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# Static memory plan: input-side leaf classification
+# ---------------------------------------------------------------------------
+
+def _input_groups(rec, si) -> Optional[List[Dict[str, Any]]]:
+    """Classify every input leaf of one compiled signature into the
+    plan taxonomy using the site's recorded memory layout
+    (``rec.mem_layout``, set at registration by the three dispatch
+    sites).  Uses only the stored ShapeDtypeStructs — never compiles,
+    never touches a device.  Returns None when the example-arg tree
+    was never recorded (pre-PR records) or its structure doesn't match
+    the site's layout."""
+    structs = si._structs
+    if structs is None:
+        return None
+    ml = rec.mem_layout or {}
+    layout = ml.get("layout")
+    groups: List[Dict[str, Any]] = []
+
+    def add(cls, label, leaf, origin):
+        groups.append({"class": cls, "label": label, "origin": origin,
+                       "shape": tuple(leaf.shape),
+                       "dtype": str(leaf.dtype),
+                       "bytes": _leaf_nbytes(leaf)})
+
+    try:
+        if layout == "executor" and isinstance(structs, (tuple, list)) \
+                and len(structs) in (3, 4):
+            args, aux, key = structs[0], structs[1], structs[2]
+            names = ml.get("arg_names") or rec.arg_names or []
+            pnames = set(ml.get("param_names") or ())
+            for i, leaf in enumerate(_leaves(args)):
+                name = names[i] if i < len(names) else "arg%d" % i
+                add("params" if name in pnames else "data", name, leaf,
+                    "arg")
+            aux_names = ml.get("aux_names") or []
+            for i, leaf in enumerate(_leaves(aux)):
+                label = aux_names[i] if i < len(aux_names) else "aux%d" % i
+                add("params", label, leaf, "aux")
+            for leaf in _leaves(key):
+                add("data", "rng_key", leaf, "rng")
+            if len(structs) == 4:
+                for leaf in _leaves(structs[3]):
+                    add("grads", "ograds", leaf, "ograd")
+            return groups
+        if layout == "cachedop" and isinstance(structs, (tuple, list)) \
+                and len(structs) >= 1:
+            names = ml.get("arg_names") or []
+            n_args = len(names)
+            didx = set(ml.get("data_idx") or ())
+            aux_names = ml.get("aux_names") or []
+            for leaf in _leaves(structs[0]):
+                add("data", "rng_key", leaf, "rng")
+            for i, leaf in enumerate(_leaves(list(structs[1:]))):
+                if i < n_args:
+                    name = names[i]
+                    cls = "data" if i in didx else "params"
+                    origin = "arg"
+                else:
+                    j = i - n_args
+                    name = aux_names[j] if j < len(aux_names) \
+                        else "aux%d" % j
+                    cls, origin = "params", "aux"
+                add(cls, name, leaf, origin)
+            return groups
+        if layout == "fused_train" and isinstance(structs, (tuple, list)) \
+                and len(structs) == 8:
+            p, s, aux, fixed, key, t0, data, lr = structs
+            pnames = ml.get("param_names") or []
+            for i, leaf in enumerate(_leaves(p)):
+                label = pnames[i] if i < len(pnames) else "param%d" % i
+                add("params", label, leaf, "arg")
+            for leaf in _leaves(s):
+                add("optimizer_state", "opt_state", leaf, "opt")
+            aux_names = ml.get("aux_names") or []
+            for i, leaf in enumerate(_leaves(aux)):
+                label = aux_names[i] if i < len(aux_names) else "aux%d" % i
+                add("params", label, leaf, "aux")
+            fixed_names = ml.get("fixed_names") or []
+            for i, leaf in enumerate(_leaves(fixed)):
+                label = fixed_names[i] if i < len(fixed_names) \
+                    else "fixed%d" % i
+                add("params", label, leaf, "arg")
+            for leaf in _leaves(key):
+                add("data", "rng_key", leaf, "rng")
+            for leaf in _leaves(t0):
+                add("data", "step_counter", leaf, "rng")
+            dnames = ml.get("data_names") or []
+            for i, leaf in enumerate(_leaves(data)):
+                label = dnames[i] if i < len(dnames) else "data%d" % i
+                add("data", label, leaf, "data")
+            for leaf in _leaves(lr):
+                add("data", "lr_sched", leaf, "rng")
+            return groups
+    except Exception:
+        return None
+    # unknown layout (direct aot_compile users): every leaf counts,
+    # nothing is classified
+    for i, leaf in enumerate(_leaves(structs)):
+        add("unattributed", "arg%d" % i, leaf, "arg")
+    return groups
+
+
+# ---------------------------------------------------------------------------
+# Static memory plan: output-side classification (via eval_shape)
+# ---------------------------------------------------------------------------
+
+def _output_groups(rec, si, in_groups) -> Optional[List[Dict[str, Any]]]:
+    """Classify the program's output leaves.  ``jax.eval_shape``
+    traces WITHOUT compiling, so this is cheap — but plan() already
+    sits on the may-compile inspect path anyway.  Each group carries
+    ``aliased``: True when the site donates the corresponding input
+    buffer, so the alias bytes XLA reports can be subtracted from
+    exactly those groups (donated outputs must not double-count)."""
+    import jax
+
+    if si._jitfn is None or si._structs is None:
+        return None
+    try:
+        out = jax.eval_shape(si._jitfn, *si._structs)
+    except Exception:
+        return None
+    ml = rec.mem_layout or {}
+    layout = ml.get("layout")
+    groups: List[Dict[str, Any]] = []
+
+    def add(cls, label, tree, aliased=False):
+        for leaf in _leaves(tree):
+            groups.append({"class": cls, "label": label,
+                           "aliased": bool(aliased),
+                           "bytes": _leaf_nbytes(leaf)})
+
+    try:
+        if layout == "executor":
+            if si.kind == "infer" or not isinstance(out, (tuple, list)):
+                add("outputs", "outputs", out)
+                return groups
+            if len(out) == 3:
+                # fused_step returns (outs, dgrads, aux_new); fwd_vjp
+                # returns (outs, aux_new, vjp-residuals).  The dgrads
+                # element mirrors the diff-param shapes exactly —
+                # that's the discriminator.
+                pshapes = [tuple(g["shape"]) for g in in_groups or []
+                           if g["class"] == "params"
+                           and g["origin"] == "arg"]
+                mid = [tuple(v.shape) for v in _leaves(out[1])]
+                if mid and mid == pshapes[:len(mid)]:
+                    add("outputs", "outputs", out[0])
+                    add("grads", "dgrads", out[1])
+                    add("params", "aux_new", out[2], aliased=True)
+                else:
+                    add("outputs", "outputs", out[0])
+                    add("params", "aux_new", out[1], aliased=True)
+                    add("activations_temps", "vjp_residuals", out[2])
+                return groups
+            if len(out) == 2:  # fwd_train_only: (outs, aux_new)
+                add("outputs", "outputs", out[0])
+                add("params", "aux_new", out[1], aliased=True)
+                return groups
+            add("outputs", "outputs", out)
+            return groups
+        if layout == "cachedop":
+            n_out = int(ml.get("n_outputs") or 0)
+            if isinstance(out, (tuple, list)) and len(out) == 2 \
+                    and not _is_arrayish(out[0]):
+                # _analysis_train_jit composite: (outs, grads-per-input)
+                add("outputs", "outputs", out[0])
+                add("grads", "dgrads", out[1])
+                return groups
+            leaves = _leaves(out)
+            add("outputs", "outputs", leaves[:n_out or len(leaves)])
+            if n_out and len(leaves) > n_out:
+                # aux_new — aliased only on the donated train variant,
+                # but marking it aliasable is safe either way: the
+                # alias bytes XLA actually reports bound the subtraction
+                add("params", "aux_new", leaves[n_out:], aliased=True)
+            return groups
+        if layout == "fused_train" and isinstance(out, (tuple, list)) \
+                and len(out) == 4:
+            add("params", "params_new", out[0], aliased=True)
+            add("optimizer_state", "opt_state_new", out[1], aliased=True)
+            add("params", "aux_new", out[2], aliased=True)
+            add("outputs", "outputs", out[3])
+            return groups
+    except Exception:
+        return None
+    add("outputs", "outputs", out)
+    return groups
+
+
+# ---------------------------------------------------------------------------
+# Static memory plan: HLO temp attribution
+# ---------------------------------------------------------------------------
+
+#: HLO instruction names whose result buffers are collective scratch
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                   "all-to-all", "collective-permute", "all-reduce-start",
+                   "all-gather-start")
+
+_SHAPE_TOKEN_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+_HLO_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(\([^=]*?\)|\S+)\s+([\w\-]+)\(")
+
+
+def _shape_bytes(token: str) -> int:
+    """Byte size of an HLO result-shape token (``f32[8,16]{1,0}`` or a
+    tuple ``(f32[8,16]{1,0}, pred[])``)."""
+    from .inspect import _DT_SIZE
+
+    total = 0
+    for m in _SHAPE_TOKEN_RE.finditer(token):
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n * _DT_SIZE.get(m.group(1), 4)
+    return total
+
+
+def _temp_attribution(hlo_text: str) -> Tuple[int, Dict[str, int]]:
+    """Walk the optimized HLO's top-level instructions (fusion BODIES
+    excluded — ops folded into a fusion materialize no buffer of their
+    own, same rule as ``inspect.hlo_histogram``) and return
+    ``(collective_result_bytes, {layer: result_bytes})`` using the
+    xprof named-scope layer join on each instruction's ``op_name``
+    metadata.  The byte figures are *shares* for apportioning the
+    analysis' temp total, not absolute truth — XLA reuses buffers."""
+    from .xprof import _layer_of
+
+    coll = 0
+    by_layer: Dict[str, int] = {}
+    in_fusion_body = False
+    for line in (hlo_text or "").splitlines():
+        s = line.strip()
+        if s.endswith("{") and "(" in s:
+            cname = s.lstrip("%").split()[0]
+            in_fusion_body = cname.startswith(("fused_", "%fused_")) \
+                or ".fused" in cname
+            continue
+        if s == "}":
+            in_fusion_body = False
+            continue
+        if in_fusion_body:
+            continue
+        m = _HLO_INSTR_RE.match(line)
+        if not m:
+            continue
+        shape_tok, op = m.group(1), m.group(2)
+        if op in ("parameter", "constant"):
+            continue
+        nbytes = _shape_bytes(shape_tok)
+        if not nbytes:
+            continue
+        base_op = op.split(".")[0]
+        if base_op in _COLLECTIVE_OPS:
+            coll += nbytes
+            continue
+        layer = None
+        nm = re.search(r'op_name="([^"]+)"', line)
+        if nm:
+            layer, _ = _layer_of(nm.group(1))
+        by_layer[layer or "(unscoped)"] = \
+            by_layer.get(layer or "(unscoped)", 0) + nbytes
+    return coll, by_layer
+
+
+# ---------------------------------------------------------------------------
+# Static memory plan: the decode
+# ---------------------------------------------------------------------------
+
+def plan(name_or_record=None, kind: Optional[str] = None,
+         refresh: bool = False) -> Dict[str, Any]:
+    """The per-program memory plan: peak HBM of the latest compiled
+    signature decomposed by class and by layer (see module doc).  The
+    ``classes`` values sum EXACTLY to ``peak_bytes`` — the decode's
+    residual is named ``unattributed`` instead of silently absorbed.
+    May compile lazily (inspect analysis); never call on a hot path.
+    The result attaches to the program record (``rec.memory_plan``)
+    and is cached per (program, kind, signature)."""
+    rec = _resolve(name_or_record)
+    si = rec.latest_sig(kind)
+    if si is None:
+        raise MXNetError("program %r has no %s signature"
+                         % (rec.name, kind or "compiled"))
+    ck = (rec.name, si.kind, si.sig)
+    if not refresh:
+        with _lock:
+            hit = _PLAN_CACHE.get(ck)
+        if hit is not None:
+            rec.memory_plan = hit
+            return hit
+    analysis = si.analyze()
+    if "error" in analysis:
+        return {"program": rec.name, "kind": si.kind,
+                "error": analysis["error"]}
+    arg_b = int(analysis.get("argument_bytes", 0))
+    out_b = int(analysis.get("output_bytes", 0))
+    tmp_b = int(analysis.get("temp_bytes", 0))
+    alias_b = int(analysis.get("alias_bytes", 0))
+    peak_b = int(analysis.get("peak_bytes", 0))
+
+    classes = {c: 0 for c in CLASSES}
+    by_layer: Dict[str, int] = {}
+
+    def layer_add(layer, nbytes):
+        if nbytes:
+            by_layer[layer] = by_layer.get(layer, 0) + int(nbytes)
+
+    # -- inputs: every argument leaf, classified by the site layout
+    in_groups = _input_groups(rec, si)
+    for g in (in_groups or ()):
+        classes[g["class"]] += g["bytes"]
+        if g["class"] in ("params", "grads"):
+            layer_add(_layer_guess(g["label"]), g["bytes"])
+        else:
+            layer_add("(%s)" % g["class"], g["bytes"])
+
+    # -- temps: collective scratch split out via the HLO parse, the
+    # rest is activations+temps, apportioned to layers by each layer's
+    # share of top-level materialized result bytes
+    coll_share = 0
+    layer_shares: Dict[str, int] = {}
+    if tmp_b > 0:
+        try:
+            coll_share, layer_shares = _temp_attribution(si.hlo_text())
+        except Exception:
+            coll_share, layer_shares = 0, {}
+    coll_b = min(tmp_b, coll_share)
+    act_b = tmp_b - coll_b
+    classes["collective_scratch"] += coll_b
+    classes["activations_temps"] += act_b
+    share_total = sum(layer_shares.values()) or 0
+    if act_b and share_total:
+        for layer, share in layer_shares.items():
+            layer_add(layer, act_b * share // share_total)
+    elif act_b:
+        layer_add("(activations_temps)", act_b)
+    if coll_b:
+        layer_add("(collective_scratch)", coll_b)
+
+    # -- outputs: out_bytes minus the donated-aliased portion (those
+    # buffers ARE argument buffers — counting them again would double-
+    # count donation), classified per site
+    out_groups = _output_groups(rec, si, in_groups)
+    aliased_total = sum(g["bytes"] for g in (out_groups or ())
+                       if g["aliased"])
+    donated = min(alias_b, aliased_total) if out_groups is not None \
+        else alias_b
+    out_live = max(0, out_b - alias_b)
+    if out_groups is not None:
+        scale = 0.0
+        if aliased_total:
+            scale = 1.0 - min(1.0, float(alias_b) / aliased_total)
+        counted = 0
+        for g in out_groups:
+            b = int(g["bytes"] * scale) if g["aliased"] else g["bytes"]
+            b = min(b, max(0, out_live - counted))
+            counted += b
+            classes[g["class"]] += b
+            if g["class"] == "grads":
+                layer_add("(grads_out)", b)
+            else:
+                layer_add("(%s)" % g["class"], b)
+    else:
+        classes["outputs"] += out_live
+        layer_add("(outputs)", out_live)
+
+    # -- reconcile: the decode must sum to the analysis peak exactly;
+    # whatever it couldn't place (XLA padding/alignment, pre-PR records
+    # without structs) is named, not hidden
+    placed = sum(v for k, v in classes.items() if k != "unattributed")
+    classes["unattributed"] = peak_b - placed
+    layer_add("(unattributed)", classes["unattributed"])
+
+    top_layers = sorted(((k, v) for k, v in by_layer.items()),
+                        key=lambda kv: -abs(kv[1]))[:12]
+    result = {
+        "program": rec.name, "site": rec.site, "kind": si.kind,
+        "signature": si.sig,
+        "peak_bytes": peak_b, "argument_bytes": arg_b,
+        "output_bytes": out_b, "temp_bytes": tmp_b,
+        "alias_bytes": alias_b,
+        # donation accounting: bytes whose output buffers alias donated
+        # inputs (informational — already EXCLUDED from the classes)
+        "donated_aliased_bytes": donated,
+        "classes": dict(classes),
+        "by_layer": by_layer,
+        "top_layers": [{"layer": k, "bytes": v} for k, v in top_layers],
+        "batch": _batch_of(rec, si),
+        # the pricing surface ROADMAP items 3-5 consult: what each
+        # strategy could free/trade, straight from the class budget
+        "what_if": {
+            "zero1_optimizer_state_bytes": classes["optimizer_state"],
+            "zero2_gradient_bytes": classes["grads"],
+            "zero3_parameter_bytes": classes["params"],
+            "remat_activation_bytes": classes["activations_temps"],
+        },
+    }
+    with _lock:
+        _PLAN_CACHE[ck] = result
+        while len(_PLAN_CACHE) > _PLAN_CACHE_MAX:
+            _PLAN_CACHE.popitem(last=False)
+    rec.memory_plan = result
+    return result
+
+
+def _batch_of(rec, si) -> Optional[int]:
+    """Leading batch dim of the signature's first data-class input (for
+    fused_train the stacks are (K, B, ...) — dim 1)."""
+    groups = _input_groups(rec, si)
+    if not groups:
+        return None
+    ml = rec.mem_layout or {}
+    stacked = ml.get("layout") == "fused_train"
+    for g in groups:
+        if g["class"] != "data" or g["origin"] in ("rng",):
+            continue
+        shp = g["shape"]
+        if stacked and len(shp) >= 2:
+            return int(shp[1])
+        if not stacked and len(shp) >= 1:
+            return int(shp[0])
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Live census: device stats, live-array sweep, leak detector
+# ---------------------------------------------------------------------------
+
+_state: Dict[str, Any] = {
+    "history": collections.deque(maxlen=max(_WINDOW * 4, 32)),
+    "last_sweep": 0.0,
+    "last_sweep_result": None,
+    "peak_used": 0,
+    "observed_used": 0,
+    "leaks": collections.deque(maxlen=16),
+    "leak_last_fire": {},  # bucket key -> monotonic ts (cooldown)
+    "owner_index": None,   # (shape, dtype) -> (program, label, class)
+    "owner_stamp": None,   # registry size stamp the index was built at
+}
+
+
+def device_stats() -> Dict[str, Dict[str, int]]:
+    """Per-device allocator stats (``device.memory_stats()``) — O(1),
+    read-only, never syncs.  Empty on backends that expose none (CPU
+    jaxlib)."""
+    import jax
+
+    out = {}
+    try:
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats:
+                out[str(d)] = {k: int(v) for k, v in stats.items()
+                               if isinstance(v, (int, float))}
+    except Exception:
+        pass
+    return out
+
+
+def _proc_mem() -> Tuple[int, int]:
+    """(vm_size_bytes, rss_bytes) of this process — /proc read, O(1)."""
+    try:
+        with open("/proc/self/statm") as f:
+            vm, rss = f.read().split()[:2]
+        page = os.sysconf("SC_PAGE_SIZE")
+        return int(vm) * page, int(rss) * page
+    except Exception:
+        return 0, 0
+
+
+def _rlimit_as() -> Optional[int]:
+    try:
+        import resource
+
+        soft, _ = resource.getrlimit(resource.RLIMIT_AS)
+        return soft if soft != resource.RLIM_INFINITY else None
+    except Exception:
+        return None
+
+
+def limit_bytes() -> int:
+    """The device-memory capacity this process plans against:
+    ``MXTPU_HBM_LIMIT_BYTES`` override > allocator ``bytes_limit`` >
+    RLIMIT_AS (a CPU-memory-capped subprocess — how ``check_hbm.py``
+    brackets the real OOM boundary) > physical RAM."""
+    env = getenv("MXTPU_HBM_LIMIT_BYTES", "")
+    if env:
+        try:
+            return int(float(env))
+        except ValueError:
+            pass
+    stats = device_stats()
+    lim = sum(s.get("bytes_limit", 0) for s in stats.values())
+    if lim:
+        return lim
+    rl = _rlimit_as()
+    if rl is not None:
+        return rl
+    try:
+        return (os.sysconf("SC_PHYS_PAGES")
+                * os.sysconf("SC_PAGE_SIZE"))
+    except Exception:
+        return 0
+
+
+def used_bytes() -> int:
+    """Bytes currently in use against :func:`limit_bytes`: allocator
+    ``bytes_in_use`` on real devices; under an RLIMIT_AS cap the
+    process VM size (that is what the limit meters); else RSS."""
+    stats = device_stats()
+    used = sum(s.get("bytes_in_use", 0) for s in stats.values())
+    if used:
+        return used
+    vm, rss = _proc_mem()
+    if _rlimit_as() is not None:
+        return vm
+    return rss
+
+
+def headroom() -> int:
+    """Free device-memory budget right now (never negative)."""
+    return max(0, limit_bytes() - used_bytes())
+
+
+def observe_used(nbytes: int) -> None:
+    """Step-path hook (called by ``telemetry._sample_device_mem`` on
+    its existing cadence): fold an already-measured used-bytes figure
+    into the census watermark.  Disarmed cost: one bool check."""
+    if not _ENABLED:
+        return
+    nbytes = int(nbytes)
+    _state["observed_used"] = nbytes
+    if nbytes > _state["peak_used"]:
+        _state["peak_used"] = nbytes
+
+
+def _owner_index() -> Dict[Tuple, Tuple[str, str, str]]:
+    """(shape, dtype-str) -> (program, label, class) reverse index over
+    the registry's recorded input layouts.  Built from the stored
+    ShapeDtypeStructs only — NO compiles, no device access.  Rebuilt
+    when the registry grows; best-effort (first program wins a
+    colliding shape)."""
+    from . import inspect as _insp
+
+    with _insp._lock:
+        records = list(_insp._REGISTRY.values())
+        stamp = (len(records), sum(len(r.sigs) for r in records))
+    if _state["owner_index"] is not None \
+            and _state["owner_stamp"] == stamp:
+        return _state["owner_index"]
+    index: Dict[Tuple, Tuple[str, str, str]] = {}
+    for rec in records:
+        seen_kinds = set()
+        for (k, _), si in reversed(list(rec.sigs.items())):
+            if k in seen_kinds:
+                continue
+            seen_kinds.add(k)
+            groups = _input_groups(rec, si)
+            for g in (groups or ()):
+                key = (g["shape"], g["dtype"])
+                if key not in index:
+                    index[key] = (rec.name, g["label"], g["class"])
+    _state["owner_index"] = index
+    _state["owner_stamp"] = stamp
+    return index
+
+
+def sweep_live(top: int = 12) -> Dict[str, Any]:
+    """One bucketed ``jax.live_arrays()`` sweep: live buffers grouped
+    by (shape, dtype), each bucket joined to the owning registry
+    (program, label) when the shape matches a recorded input layout.
+    Read-only (`.nbytes` is aval metadata — no sync); costs
+    milliseconds on a big process, so the census rate-limits it
+    (``MXTPU_HBM_SWEEP_S``).  This is also the ONE live-buffer sweep
+    the OOM forensics (`mx.health.memory_report`) ride."""
+    import jax
+
+    t0 = time.monotonic()
+    buckets: Dict[Tuple, List[int]] = {}
+    n = 0
+    total = 0
+    try:
+        arrays = jax.live_arrays()
+    except Exception:
+        arrays = []
+    for a in arrays:
+        try:
+            key = (tuple(a.shape), str(a.dtype))
+            nb = int(a.nbytes)
+        except Exception:
+            continue
+        n += 1
+        total += nb
+        ent = buckets.get(key)
+        if ent is None:
+            buckets[key] = [1, nb]
+        else:
+            ent[0] += 1
+            ent[1] += nb
+    index = _owner_index()
+    rows = []
+    for (shape, dtype), (count, nbytes) in buckets.items():
+        owner = index.get((shape, dtype))
+        rows.append({
+            "shape": list(shape), "dtype": dtype, "count": count,
+            "bytes": nbytes,
+            "program": owner[0] if owner else None,
+            "layer": _layer_guess(owner[1]) if owner else None,
+            "label": owner[1] if owner else None,
+            "class": owner[2] if owner else None,
+        })
+    rows.sort(key=lambda r: -r["bytes"])
+    # full compact map for the growth detector (a leak must not hide
+    # below the top-N display cut)
+    by_bucket: Dict[Tuple, int] = {}
+    for row in rows:
+        k = _bucket_key(row)
+        by_bucket[k] = by_bucket.get(k, 0) + row["bytes"]
+    return {"ts": time.time(), "n_arrays": n, "live_bytes": total,
+            "sweep_ms": round((time.monotonic() - t0) * 1e3, 3),
+            "by_bucket": by_bucket,
+            "buckets": rows[:max(1, top)]}
+
+
+def _bucket_key(row: Dict[str, Any]) -> Tuple:
+    """Leak-detector bucket identity: (program, layer, dtype) when the
+    owner join resolved, else (shape, dtype) so anonymous growth is
+    still named."""
+    if row.get("program"):
+        return (row["program"], row.get("layer") or "?", row["dtype"])
+    return ("?", "x".join(str(d) for d in row["shape"]), row["dtype"])
+
+
+def _detect_leaks(now: float) -> List[Dict[str, Any]]:
+    """Rolling-window growth detector over the census history: a
+    bucket that grew ≥ ``MXTPU_HBM_GROWTH_MB`` across the window while
+    growing in most consecutive samples is a leak suspect.  Emits ONE
+    telemetry ``anomaly`` (atype=``memory_leak``) per bucket per
+    window span (cooldown) — the event names the (program, layer,
+    dtype) bucket BEFORE exhaustion."""
+    hist = list(_state["history"])
+    if len(hist) < _WINDOW:
+        return []
+    window = hist[-_WINDOW:]
+    first, last = window[0], window[-1]
+    fired = []
+    span_s = max(1e-6, last["ts"] - first["ts"])
+    for key, nbytes in last["buckets"].items():
+        growth = nbytes - first["buckets"].get(key, 0)
+        if growth < _GROWTH_BYTES:
+            continue
+        ups = sum(
+            1 for a, b in zip(window, window[1:])
+            if b["buckets"].get(key, 0) > a["buckets"].get(key, 0))
+        if ups < 0.6 * (len(window) - 1):
+            continue
+        last_fire = _state["leak_last_fire"].get(key, 0.0)
+        if now - last_fire < span_s:
+            continue  # cooldown: one event per bucket per window span
+        _state["leak_last_fire"][key] = now
+        program, layer, dtype = key
+        leak = {"ts": time.time(), "program": program, "layer": layer,
+                "dtype": dtype, "growth_bytes": int(growth),
+                "bytes": int(nbytes), "window_s": round(span_s, 3),
+                "rate_mb_s": round(growth / 2**20 / span_s, 3)}
+        fired.append(leak)
+        _state["leaks"].append(leak)
+        try:
+            from . import profiler as _prof
+            from . import telemetry as _tel
+
+            _tel.record("anomaly", atype="memory_leak", site="hbm",
+                        step=_tel.current_step(), program=program,
+                        layer=layer, dtype=dtype,
+                        growth_bytes=int(growth),
+                        window_s=round(span_s, 3))
+            _prof.inc_stat("hbm_leak_events")
+        except Exception:
+            pass
+    return fired
+
+
+def census(force: bool = False) -> Dict[str, Any]:
+    """One budgeted census sample: O(1) device/process stats every
+    call; the live-array sweep only when the last one is older than
+    ``MXTPU_HBM_SWEEP_S`` (or ``force=True``).  Appends to the
+    growth-detector history and fires leak events.  Returns the
+    current memory picture.  Strictly read-only — never compiles,
+    never syncs."""
+    if not _ENABLED and not force:
+        return {"enabled": False}
+    now = time.monotonic()
+    with _lock:
+        used = used_bytes()
+        if used > _state["peak_used"]:
+            _state["peak_used"] = used
+        swept = False
+        if force or _state["last_sweep_result"] is None \
+                or now - _state["last_sweep"] >= _SWEEP_S:
+            _state["last_sweep_result"] = sweep_live()
+            _state["last_sweep"] = now
+            swept = True
+        sweep = _state["last_sweep_result"]
+        if swept:
+            _state["history"].append({"ts": now, "used": used,
+                                      "live": sweep["live_bytes"],
+                                      "buckets": sweep["by_bucket"]})
+            new_leaks = _detect_leaks(now)
+        else:
+            new_leaks = []
+        lim = limit_bytes()
+        return {
+            "enabled": True, "ts": time.time(),
+            "used_bytes": used,
+            "peak_used_bytes": _state["peak_used"],
+            "limit_bytes": lim,
+            "headroom_bytes": max(0, lim - used),
+            "live_bytes": sweep["live_bytes"],
+            "n_arrays": sweep["n_arrays"],
+            "sweep_age_s": round(now - _state["last_sweep"], 3),
+            "device_stats": device_stats(),
+            "top_buckets": sweep["buckets"],
+            "new_leaks": new_leaks,
+            "leaks": list(_state["leaks"]),
+        }
+
+
+def leaks() -> List[Dict[str, Any]]:
+    """Leak events fired so far (newest last)."""
+    with _lock:
+        return list(_state["leaks"])
+
+
+def metrics_block() -> Dict[str, Any]:
+    """The ``"hbm"`` telemetry metrics provider: a compact census on
+    the `mx.obs` sampling cadence.  This is the block that flows
+    sampler → OpenMetrics → heartbeat → ``cluster.json`` with zero new
+    wiring.  Disarmed: one bool check."""
+    if not _ENABLED:
+        return {"enabled": False}
+    c = census()
+    leak_rows = c.get("leaks") or []
+    return {
+        "enabled": True,
+        "used_bytes": c["used_bytes"],
+        "peak_used_bytes": c["peak_used_bytes"],
+        "limit_bytes": c["limit_bytes"],
+        "headroom_bytes": c["headroom_bytes"],
+        "live_bytes": c["live_bytes"],
+        "n_arrays": c["n_arrays"],
+        "leak": bool(leak_rows),
+        "leak_count": len(leak_rows),
+        "last_leak": leak_rows[-1] if leak_rows else None,
+        "top_buckets": [
+            {"program": r["program"], "layer": r["layer"],
+             "dtype": r["dtype"], "bytes": r["bytes"]}
+            for r in (c.get("top_buckets") or [])[:3]],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Headroom + what-if capacity model
+# ---------------------------------------------------------------------------
+
+def capacity_model(name_or_record=None, kind: Optional[str] = None,
+                   analyze: bool = True) -> Dict[str, Any]:
+    """Linear capacity model of one program across its compiled shape
+    buckets: fit ``peak_bytes ≈ fixed + bytes_per_sample * batch``
+    over every analyzed signature of ``kind`` (default: prefer
+    ``infer``, else whatever exists).  ``analyze=True`` runs the lazy
+    analysis for unanalyzed signatures (may compile — fine at
+    add_model/planning time; pass False on reactive paths)."""
+    rec = _resolve(name_or_record)
+    with _lock:
+        pass
+    sigs = list(rec.sigs.items())
+    kinds = [k for (k, _), _si in sigs]
+    if kind is None:
+        kind = "infer" if "infer" in kinds else (kinds[-1] if kinds
+                                                 else None)
+    points = []
+    resident = 0
+    for (k, _), si in sigs:
+        if k != kind:
+            continue
+        if si._analysis is None and not analyze:
+            continue
+        analysis = si.analyze()
+        if "error" in analysis:
+            continue
+        b = _batch_of(rec, si)
+        if not b:
+            continue
+        groups = _input_groups(rec, si) or ()
+        static = sum(g["bytes"] for g in groups
+                     if g["class"] in ("params", "optimizer_state"))
+        resident = max(resident, static)
+        points.append((int(b), int(analysis.get("peak_bytes", 0)),
+                       static))
+    if not points:
+        return {"program": rec.name, "kind": kind, "points": [],
+                "error": "no analyzed signatures with a batch dim"}
+    points.sort()
+    # fit on the LARGE-batch half of the ladder: tiny-batch programs
+    # often carry one-off layout copies (e.g. a transposed weight for
+    # the b=1 gemv on CPU) that would poison a least-squares fit whose
+    # whole job is extrapolating UP
+    fit_pts = points[len(points) // 2:] if len(points) >= 3 else points
+    xs = [p[0] for p in fit_pts]
+    ys = [p[1] for p in fit_pts]
+    slope = fixed = None
+    if len(set(xs)) >= 2:
+        n = len(xs)
+        mx_ = sum(xs) / n
+        my = sum(ys) / n
+        den = sum((x - mx_) ** 2 for x in xs) or 1.0
+        slope = sum((x - mx_) * (y - my) for x, y in zip(xs, ys)) / den
+        fixed = my - slope * mx_
+        if slope <= 0:
+            slope = fixed = None  # non-increasing ladder: fall back
+    if slope is None:
+        b, peak, static = points[-1]
+        slope = max(1.0, float(peak - static) / b)
+        fixed = float(static)
+    return {"program": rec.name, "kind": kind,
+            "points": [{"batch": b, "peak_bytes": p} for b, p, _ in
+                       points],
+            "bytes_per_sample": max(1.0, slope),
+            "fixed_bytes": max(0.0, fixed),
+            "resident_bytes": resident}
+
+
+def max_batch(name_or_record=None, headroom_bytes: Optional[int] = None,
+              kind: Optional[str] = None,
+              buckets: Optional[List[int]] = None,
+              analyze: bool = True) -> Optional[int]:
+    """Largest batch whose INCREMENTAL footprint (the capacity model's
+    per-sample + fixed bytes, minus the already-resident params/
+    optimizer state) fits in ``headroom_bytes`` (default: live
+    :func:`headroom`).  ``buckets`` snaps the answer down onto the
+    serve bucket ladder.  None when no model can be fit."""
+    cm = capacity_model(name_or_record, kind=kind, analyze=analyze)
+    if cm.get("error"):
+        return None
+    if headroom_bytes is None:
+        headroom_bytes = headroom()
+    incr_fixed = max(0.0, cm["fixed_bytes"] - cm["resident_bytes"])
+    avail = float(headroom_bytes) - incr_fixed
+    if avail <= 0:
+        return 0
+    pred = int(avail // cm["bytes_per_sample"])
+    if buckets:
+        fitting = [b for b in sorted(buckets) if b <= pred]
+        return fitting[-1] if fitting else 0
+    return pred
+
+
+def fits(models: List[Any], headroom_bytes: Optional[int] = None,
+         analyze: bool = True) -> Dict[str, Any]:
+    """Would this model set fit together?  Sums each program's worst
+    analyzed peak (models dispatch concurrently, so the conservative
+    answer adds the dynamic footprints too) and compares against the
+    available headroom."""
+    if headroom_bytes is None:
+        headroom_bytes = headroom()
+    per_model = {}
+    required = 0
+    for m in models:
+        rec = _resolve(m)
+        peaks = []
+        for (_k, _), si in rec.sigs.items():
+            if si._analysis is None and not analyze:
+                continue
+            analysis = si.analyze()
+            if "error" not in analysis:
+                peaks.append(int(analysis.get("peak_bytes", 0)))
+        worst = max(peaks) if peaks else 0
+        per_model[rec.name] = worst
+        required += worst
+    return {"fits": required <= headroom_bytes,
+            "required_bytes": required,
+            "headroom_bytes": int(headroom_bytes),
+            "per_model": per_model}
+
+
+# ---------------------------------------------------------------------------
+# Reports
+# ---------------------------------------------------------------------------
+
+def report(top: int = 5) -> Dict[str, Any]:
+    """The human entry point: live census + headroom + the memory
+    plans of the ``top`` biggest ANALYZED programs (no new compiles —
+    this is a reporting surface, not a trigger)."""
+    from . import inspect as _insp
+
+    c = census(force=True) if _ENABLED else {"enabled": False}
+    plans = []
+    with _insp._lock:
+        records = list(_insp._REGISTRY.values())
+    for rec in records:
+        si = rec.latest_sig()
+        if si is None or si._analysis is None \
+                or "error" in si._analysis:
+            continue
+        try:
+            plans.append(plan(rec))
+        except Exception:
+            continue
+    plans.sort(key=lambda p: -p.get("peak_bytes", 0))
+    return {"census": c, "headroom_bytes": headroom(),
+            "limit_bytes": limit_bytes(),
+            "plans": plans[:max(1, top)],
+            "leaks": leaks()}
+
+
+def reset() -> None:
+    """Drop census history, leak state and plan cache (tests)."""
+    with _lock:
+        _state["history"].clear()
+        _state["last_sweep"] = 0.0
+        _state["last_sweep_result"] = None
+        _state["peak_used"] = 0
+        _state["observed_used"] = 0
+        _state["leaks"].clear()
+        _state["leak_last_fire"].clear()
+        _state["owner_index"] = None
+        _state["owner_stamp"] = None
+        _PLAN_CACHE.clear()
+
+
+# the "hbm" block in telemetry.metrics(): how the census reaches the
+# obs sampler, every role's OpenMetrics endpoint, heartbeats and the
+# cluster.json rollup without any of those importing this module
+from . import telemetry as _telemetry  # noqa: E402
+
+_telemetry.register_metrics_provider("hbm", metrics_block)

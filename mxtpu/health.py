@@ -662,59 +662,90 @@ def emit_stats(names: Sequence[str], param_norms, grad_norms,
 # ---------------------------------------------------------------------------
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
-                "OOM ")
+                "OOM ", "std::bad_alloc", "Unable to allocate")
 
 
 def is_oom(exc: BaseException) -> bool:
-    """Does this exception look like an XLA/PJRT memory exhaustion?"""
+    """Does this exception look like a memory exhaustion?  Covers the
+    XLA/PJRT RESOURCE_EXHAUSTED strings, the C++ runtime's bad_alloc
+    text, and host-side exhaustion (a Python ``MemoryError`` from a
+    numpy staging buffer under RLIMIT_AS carries no marker text but IS
+    the same failure)."""
     if isinstance(exc, MemoryExhaustedError):
         return False  # already typed + reported
+    if isinstance(exc, MemoryError):
+        return True
     msg = str(exc)
     return any(m in msg for m in _OOM_MARKERS)
 
 
 def memory_report(top: int = 8) -> Dict[str, Any]:
-    """Forensic HBM snapshot: per-program peak/argument/temp bytes from
-    the `mx.inspect` registry (programs are keyed ``site:block-name``,
-    so the rows attribute memory to model parts), device allocator
-    stats, and the ``top`` largest live buffers."""
+    """Forensic HBM snapshot: per-program peak bytes plus the per-class
+    static memory plan (`mx.hbm.plan`) from the `mx.inspect` registry
+    (programs are keyed ``site:block-name``, so the rows attribute
+    memory to model parts), device allocator stats, headroom, and the
+    ``top`` largest live-buffer BUCKETS from the `mx.hbm` census sweep
+    — each joined to its owning (program, layer, class).  The census
+    is the ONE live-array sweep in the tree; this report rides it
+    rather than walking ``jax.live_arrays()`` itself, and closes with
+    a static-plan-vs-live-census diff: un-planned resident bytes are
+    what the compiler never asked for (caches, leaks)."""
     out: Dict[str, Any] = {"ts": time.time()}
     programs = []
+    static_peak = 0
     try:
         from . import inspect as _insp
 
+        try:
+            from . import hbm as _hbm_plan
+        except Exception:
+            _hbm_plan = None
         for rec in _insp.programs(analyze=True):
-            programs.append({
+            row = {
                 "program": rec.get("name"), "site": rec.get("site"),
                 "peak_bytes": rec.get("peak_bytes", 0),
                 "argument_bytes": rec.get("argument_bytes", 0),
                 "temp_bytes": rec.get("temp_bytes", 0),
                 "output_bytes": rec.get("output_bytes", 0),
-            })
+            }
+            if _hbm_plan is not None and rec.get("name"):
+                try:
+                    mp = _hbm_plan.plan(rec["name"])
+                    if "error" not in mp:
+                        row["plan_classes"] = mp.get("classes")
+                except Exception:
+                    pass
+            programs.append(row)
+            static_peak = max(static_peak, int(row["peak_bytes"] or 0))
         programs.sort(key=lambda r: -(r["peak_bytes"] or 0))
     except Exception as e:
         out["registry_error"] = str(e)[:200]
     out["programs"] = programs
     try:
-        import jax
+        from . import hbm as _hbm
 
-        devs = {}
-        for dev in jax.local_devices():
-            try:
-                stats = getattr(dev, "memory_stats", lambda: None)()
-            except Exception:
-                stats = None
-            if stats:
-                devs[str(dev)] = {
-                    k: int(v) for k, v in stats.items()
-                    if isinstance(v, (int, float)) and "bytes" in k}
-        out["device_memory"] = devs
-        bufs = sorted(jax.live_arrays(), key=lambda a: -int(a.nbytes))
+        out["device_memory"] = _hbm.device_stats()
+        sweep = _hbm.sweep_live(top=top)
         out["top_live_buffers"] = [
-            {"shape": tuple(a.shape), "dtype": str(a.dtype),
-             "mbytes": round(int(a.nbytes) / 2**20, 3)}
-            for a in bufs[:top]]
-        out["live_bytes_total"] = sum(int(a.nbytes) for a in bufs)
+            {"shape": tuple(r["shape"]), "dtype": r["dtype"],
+             "count": r["count"],
+             "mbytes": round(r["bytes"] / 2**20, 3),
+             "program": r["program"], "layer": r["layer"],
+             "class": r["class"]}
+            for r in sweep["buckets"][:top]]
+        out["live_bytes_total"] = sweep["live_bytes"]
+        out["used_bytes"] = _hbm.used_bytes()
+        out["limit_bytes"] = _hbm.limit_bytes()
+        out["headroom_bytes"] = _hbm.headroom()
+        out["plan_vs_live"] = {
+            "static_peak_bytes": static_peak,
+            "live_bytes": sweep["live_bytes"],
+            "unplanned_bytes": max(
+                0, sweep["live_bytes"] - static_peak),
+        }
+        leak_rows = _hbm.leaks()
+        if leak_rows:
+            out["leaks"] = leak_rows[-4:]
     except Exception as e:
         out["device_error"] = str(e)[:200]
     return out

@@ -405,6 +405,14 @@ class ProgramRecord(object):
         # totals + per-class rollup + top sinks) — set by
         # xprof.attach() whenever this program is profiled
         self.op_profile: Optional[Dict[str, Any]] = None
+        # device-memory layout hints (mx.hbm): how this site's flat
+        # example-arg tree maps onto param/aux/data/opt-state slots —
+        # set by the dispatch sites at registration, consumed by
+        # hbm.plan()'s input-leaf classifier
+        self.mem_layout: Optional[Dict[str, Any]] = None
+        # latest decoded per-class/per-layer memory plan (mx.hbm.plan
+        # attaches it; rides report() as "memory_plan")
+        self.memory_plan: Optional[Dict[str, Any]] = None
         self.hits = 0          # unlocked bump: the <10us hot path
         self.compiles = 0      # dispatch-path compiles (ticks *_trace)
         self.aot_compiles = 0  # warmup/AOT builds (ticks *_warmup)
@@ -484,10 +492,13 @@ class ProgramRecord(object):
         return _Pending(self, si)
 
     def record_aot(self, kind: str, example_args, compiled,
-                   wall_s: float, event: Optional[dict] = None) -> None:
+                   wall_s: float, event: Optional[dict] = None,
+                   jitfn=None) -> None:
         """Register an AOT-built executable (`compile_cache.
         aot_compile`).  The real Compiled object is in hand, so
-        analysis is cheap and runs immediately."""
+        analysis is cheap and runs immediately.  The example-arg
+        structs (and the jit fn when the caller has one) are kept too,
+        so hbm.plan()'s leaf classifier works on warmed programs."""
         if not _ENABLED:
             return
         from . import profiler as _prof
@@ -497,10 +508,18 @@ class ProgramRecord(object):
         si.aot = True
         si.compile_wall_s = wall_s
         si._compiled = compiled
+        try:
+            si._structs = _to_structs(example_args)
+            si._jitfn = jitfn
+        except Exception:
+            pass
         with _lock:
             self.aot_compiles += 1
             self.compile_wall_s += wall_s
-            self.sigs.setdefault((kind, sig), si)
+            cur = self.sigs.setdefault((kind, sig), si)
+            if cur is not si and cur._structs is None:
+                cur._structs = si._structs
+                cur._jitfn = jitfn
             while len(self.sigs) > _MAX_SIGS:
                 self.sigs.popitem(last=False)
         _prof.inc_stat("inspect_compile_wall_us", int(wall_s * 1e6))
@@ -1015,6 +1034,16 @@ def report(name_or_record=None, kind: Optional[str] = None) -> Dict[str, Any]:
                    ("argument_bytes", "output_bytes", "temp_bytes",
                     "alias_bytes", "peak_bytes")},
     }
+    # per-class/per-layer decomposition of that peak (mx.hbm) — the
+    # decode reuses the analysis just run, so this is cheap here
+    try:
+        from . import hbm as _hbm
+
+        mp = _hbm.plan(rec, kind=kind)
+        if "error" not in mp:
+            out["memory_plan"] = mp
+    except Exception:
+        pass
     if "error" in analysis:
         out["analysis_error"] = analysis["error"]
     blames = [s.blame for s in rec.sigs.values() if s.blame]

@@ -237,6 +237,18 @@ def sample() -> Optional[Dict[str, Any]]:
             row["top_sink_op"] = sink["op"]
     except Exception:
         pass
+    # device-memory census (mx.hbm): the provider already ran inside
+    # _tel.metrics() above — this is a dict reshape, still read-only
+    hbm = m.get("hbm") or {}
+    if hbm.get("enabled"):
+        row["hbm"] = {
+            "used_bytes": hbm.get("used_bytes", 0),
+            "peak_used_bytes": hbm.get("peak_used_bytes", 0),
+            "headroom_bytes": hbm.get("headroom_bytes", 0),
+            "leak": bool(hbm.get("leak")),
+        }
+        if hbm.get("last_leak"):
+            row["hbm"]["last_leak"] = hbm["last_leak"]
     if serve:
         row["serve"] = {
             "queue_depth": serve.get("queue_depth", 0),
@@ -407,6 +419,15 @@ def openmetrics() -> str:
                              or {}).items()):
         add("mxtpu_perf_phase_us_per_step", "gauge", us,
             {"phase": phase})
+    hbm = m.get("hbm") or {}
+    if hbm.get("enabled"):
+        add("mxtpu_hbm_used_bytes", "gauge", hbm.get("used_bytes", 0))
+        add("mxtpu_hbm_peak_bytes", "gauge",
+            hbm.get("peak_used_bytes", 0))
+        add("mxtpu_hbm_headroom_bytes", "gauge",
+            hbm.get("headroom_bytes", 0))
+        add("mxtpu_hbm_leak_suspect", "gauge",
+            1 if hbm.get("leak") else 0)
     serve = m.get("serve") or {}
     if serve:
         add("mxtpu_serve_draining", "gauge",
@@ -1181,6 +1202,17 @@ def aggregate_once(directory: str,
             "queue_depth": serve.get("queue_depth", 0)
             if isinstance(serve, dict) else 0,
         }
+        # the rank's device-memory census (mx.hbm): used/peak/headroom
+        # + leak flag, the dash HBM column — straight off the role's
+        # metrics provider block, zero new wiring
+        h = m.get("hbm")
+        if isinstance(h, dict) and h.get("enabled"):
+            roles[key]["hbm"] = {
+                "used_bytes": h.get("used_bytes", 0),
+                "peak_used_bytes": h.get("peak_used_bytes", 0),
+                "headroom_bytes": h.get("headroom_bytes", 0),
+                "leak": bool(h.get("leak")),
+            }
         roles[key].update(_tel.stat_rollup(stats))
     aggregate = _tel.aggregate_stats(
         s.get("stats") for s in snaps.values()
@@ -1197,6 +1229,7 @@ def aggregate_once(directory: str,
         "aggregate": aggregate,
         "perf": _tel.perf_rollup(snaps),
         "health": _tel.health_rollup(snaps),
+        "hbm": _tel.hbm_rollup(snaps),
         "retry_total": sum(v for k, v in aggregate.items()
                            if k.startswith("retry_attempts::")),
         "failover_total": aggregate.get("elastic_failover", 0),

@@ -205,6 +205,10 @@ class _ModelEntry(object):
         # full request latency (enqueue -> result), seconds
         self.hist = _tel.histogram("serve_latency_s::%s" % name)
         self.thread: Optional[threading.Thread] = None
+        # the model's mx.inspect record (set by add_model when the
+        # model exposes one) — the handle the mx.hbm capacity consults
+        # use at add time and on the OOM shrink path
+        self.hbm_rec = None
 
 
 class Server(object):
@@ -302,6 +306,33 @@ class Server(object):
                          dtype=dtype)
         from . import profiler as _prof
         from . import telemetry as _tel
+
+        # mx.hbm capacity consult: warmup just compiled (and analyzed)
+        # the whole bucket ladder, so the per-program capacity model is
+        # a dict fit away.  The prediction always lands in telemetry as
+        # an advisory; ``MXTPU_HBM_PRESHRINK=1`` additionally trims the
+        # cap to the largest bucket predicted to fit live headroom.
+        # Best-effort by contract — this never fails add_model.
+        try:
+            rec = getattr(getattr(model, "_cached_op", None),
+                          "_insp", None)
+            if rec is not None:
+                from . import hbm as _hbm
+
+                entry.hbm_rec = rec
+                fit = _hbm.max_batch(rec, kind="infer",
+                                     buckets=list(buckets),
+                                     analyze=False)
+                if fit is not None:
+                    _tel.record("serve", action="hbm_capacity",
+                                model=name, fit_max_batch=fit,
+                                headroom_bytes=_hbm.headroom())
+                    if getenv_int("MXTPU_HBM_PRESHRINK", 0) and \
+                            0 < fit < entry.max_batch:
+                        entry.max_batch = fit
+                        _prof.inc_stat("serve_hbm_preshrink")
+        except Exception:
+            pass
 
         with self._lock:
             if name in self._entries:
@@ -669,9 +700,24 @@ class Server(object):
         from . import telemetry as _tel
 
         smaller = [b for b in entry.buckets if b < bucket]
+        target = smaller[-1] if smaller else 0
+        # mx.hbm consult: when the census can predict what actually
+        # fits the live headroom, jump straight to that bucket instead
+        # of stepping one rung and OOMing again on the next dispatch.
+        # Reactive path: analyze=False — never compiles here.
+        if smaller and entry.hbm_rec is not None:
+            try:
+                from . import hbm as _hbm
+
+                fit = _hbm.max_batch(entry.hbm_rec, kind="infer",
+                                     buckets=smaller, analyze=False)
+                if fit is not None and 0 < fit < target:
+                    target = fit
+            except Exception:
+                pass
         with entry.cond:
             if smaller:
-                entry.max_batch = min(entry.max_batch, smaller[-1])
+                entry.max_batch = min(entry.max_batch, target)
             requeue = []
             for req in batch:
                 if not smaller or req.n > entry.max_batch:

@@ -29,12 +29,12 @@ from __future__ import annotations
 from .plan import (ShardingPlan, auto_plan, current_plan,
                    default_min_shard_elems, opt_state_sharding_default,
                    plan_scope, shard_requested)
-from .zero1 import ZeRO1Updater, state_nbytes, tree_nbytes
+from .zero1 import ZeRO1Updater, hbm_report, state_nbytes, tree_nbytes
 from .reshard import reshard
 
 __all__ = [
     "ShardingPlan", "ZeRO1Updater", "auto_plan", "current_plan",
-    "default_min_shard_elems", "opt_state_sharding_default",
-    "plan_scope", "reshard", "shard_requested", "state_nbytes",
-    "tree_nbytes",
+    "default_min_shard_elems", "hbm_report",
+    "opt_state_sharding_default", "plan_scope", "reshard",
+    "shard_requested", "state_nbytes", "tree_nbytes",
 ]

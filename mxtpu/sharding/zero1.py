@@ -52,7 +52,8 @@ from ..ndarray.ndarray import NDArray
 from .. import optimizer as opt_mod
 from .plan import ShardingPlan
 
-__all__ = ["ZeRO1Updater", "tree_nbytes", "state_nbytes"]
+__all__ = ["ZeRO1Updater", "tree_nbytes", "state_nbytes",
+           "hbm_report"]
 
 
 @functools.lru_cache(maxsize=4096)
@@ -108,6 +109,46 @@ def state_nbytes(updater) -> int:
     """Optimizer-state bytes held by an `optimizer.Updater` (or
     :class:`ZeRO1Updater`) — what `tools/check_sharding.py` measures."""
     return tree_nbytes(getattr(updater, "states", None))
+
+
+def hbm_report(updater) -> Dict[str, Any]:
+    """Measured ZeRO-1 memory ledger for ``updater``: full vs
+    per-replica optimizer-state bytes (walked off the live state
+    arrays) and the freed delta, joined with every registered
+    program's STATIC shardable-pool line (``mx.hbm.plan()["what_if"]
+    ["zero1_optimizer_state_bytes"]`` — freed under N shards is
+    pool*(N-1)/N of that) so prediction and measurement sit side by
+    side.  Plain `optimizer.Updater`s report freed=0."""
+    full = state_nbytes(updater)
+    per_replica = getattr(updater, "per_replica_state_nbytes",
+                          lambda: full)()
+    out: Dict[str, Any] = {
+        "state_bytes_full": int(full),
+        "state_bytes_per_replica": int(per_replica),
+        "hbm_freed_bytes": max(0, int(full) - int(per_replica)),
+        "n_shards": int(getattr(updater, "n", 1) or 1),
+    }
+    try:
+        from .. import hbm as _hbm
+        from .. import inspect as _insp
+
+        predicted = {}
+        with _insp._lock:
+            records = list(_insp._REGISTRY.values())
+        for rec in records:
+            si = rec.latest_sig("train")
+            if si is None or si._analysis is None:
+                continue
+            mp = _hbm.plan(rec, kind="train")
+            wi = mp.get("what_if") if isinstance(mp, dict) else None
+            if wi and wi.get("zero1_optimizer_state_bytes"):
+                predicted[rec.name] = int(
+                    wi["zero1_optimizer_state_bytes"])
+        if predicted:
+            out["predicted_zero1_shardable_bytes"] = predicted
+    except Exception:
+        pass
+    return out
 
 
 def _map_state(obj, fn):
@@ -212,6 +253,15 @@ class ZeRO1Updater(object):
             else:
                 total += tree_nbytes(st[0])
         return total
+
+    def hbm_freed_bytes(self) -> int:
+        """MEASURED per-replica HBM this plan frees vs unsharded
+        replication: full-state bytes minus the bytes one replica
+        actually owns (both walked off the live state arrays, not
+        estimated).  The figure `mx.hbm`'s what-if ZeRO-1 line is
+        checked against."""
+        return max(0, self.state_nbytes()
+                   - self.per_replica_state_nbytes())
 
     # -- update -----------------------------------------------------------
     def update_replicas(self, triples: List[Tuple[Any, List[NDArray],

@@ -404,6 +404,12 @@ def _sample_device_mem() -> None:
     from . import profiler as _prof
 
     _prof.max_stat("device_mem_watermark_bytes", nbytes)
+    try:
+        from . import hbm as _hbm
+
+        _hbm.observe_used(nbytes)
+    except Exception:
+        pass
 
 
 def current_step() -> int:
@@ -844,6 +850,40 @@ def perf_rollup(snaps: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
             "mfu_spread": (max(worker_mfus) - min(worker_mfus))
             if len(worker_mfus) >= 2 else 0.0,
             "per_rank_dominant_phase": per_rank_phase}
+
+
+def hbm_rollup(snaps: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold per-node snapshots into the device-memory cluster view:
+    per-rank used/peak/headroom bytes plus a leak flag from each role's
+    ``metrics()["hbm"]`` block (the `mx.hbm` census provider).  Shared
+    by ``merge_dir``'s cluster.json and the scheduler's
+    ``kv.telemetry()`` view."""
+    per_rank: Dict[str, Dict[str, Any]] = {}
+    leak_ranks: List[str] = []
+    for key, snap in snaps.items():
+        if not isinstance(snap, dict):
+            continue  # tolerate corrupt sources; fold the survivors
+        m = snap.get("metrics")
+        h = m.get("hbm") if isinstance(m, dict) else None
+        if not isinstance(h, dict) or not h.get("enabled"):
+            continue
+        per_rank[key] = {
+            "used_bytes": int(h.get("used_bytes") or 0),
+            "peak_used_bytes": int(h.get("peak_used_bytes") or 0),
+            "headroom_bytes": int(h.get("headroom_bytes") or 0),
+            "leak": bool(h.get("leak")),
+        }
+        if h.get("leak"):
+            leak_ranks.append(key)
+        if h.get("last_leak"):
+            per_rank[key]["last_leak"] = h["last_leak"]
+    headrooms = [r["headroom_bytes"] for r in per_rank.values()]
+    return {"per_rank": per_rank,
+            "min_headroom_bytes": min(headrooms) if headrooms else None,
+            "peak_used_bytes": max(
+                (r["peak_used_bytes"] for r in per_rank.values()),
+                default=0),
+            "leak_ranks": leak_ranks}
 
 
 def aggregate_stats(stat_dicts) -> Dict[str, int]:
@@ -1417,6 +1457,10 @@ def merge_dir(directory: str, out_trace: str = "merged_trace.json",
         # spread is the straggler signal (one slow rank drags every
         # synchronous collective down to its speed)
         "perf": perf_rollup(snaps),
+        # device-memory rollup (mx.hbm): per-rank used/peak/headroom
+        # and which ranks have a live leak suspect — the fleet's
+        # capacity picture next to its speed picture
+        "hbm": hbm_rollup(snaps),
         # causal-tracing rollup (mx.tracing): trace/span totals, how
         # many traces crossed a process boundary, and the critical
         # path of the largest stitched traces

@@ -20,11 +20,13 @@ def test_degraded_cpu_bench_emits_one_valid_json_line():
     env["JAX_PLATFORMS"] = "cpu"
     env["MXTPU_BENCH_TPU_WAIT"] = "3"
     # the contract is the degraded JSON record, not throughput: the
-    # smallest batch and the shallowest zoo resnet keep the CPU
-    # fallback's XLA compile inside the tier-1 wall budget (resnet50
-    # bs8 ran ~100s, bs2 ~58s, resnet18 bs2 ~25s — compile dominates)
+    # smallest batch and the fewest-op zoo net keep the CPU fallback's
+    # XLA compile inside the tier-1 wall budget (resnet50 bs8 ran
+    # ~100s, bs2 ~58s, resnet18 bs2 ~25s, alexnet bs2 ~16s — compile
+    # dominates; the metric name is self-describing so the record
+    # stays honest)
     env["MXTPU_BENCH_BATCH"] = "2"
-    env["MXTPU_BENCH_NET"] = "resnet18_v1"
+    env["MXTPU_BENCH_NET"] = "alexnet"
     r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                        capture_output=True, text=True, timeout=540,
                        env=env, cwd=REPO)

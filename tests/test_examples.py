@@ -26,12 +26,14 @@ def _run(script, *extra, timeout=560):
 
 def test_train_imagenet_kvstore_tpu_8dev():
     # 2 batches exercise the same compile + 8-device kvstore=tpu path
-    # as 4 did (the wall is compile-dominated); trimmed for the tier-1
-    # 870s suite budget
+    # as 4 did (the wall is compile-dominated); lenet keeps a conv
+    # net on the 8-device path at ~1/10 the resnet-18 compile wall
+    # (resnet-18 compile coverage lives in the degraded-bench test) —
+    # trimmed for the tier-1 870s suite budget
     out = _run("train_imagenet.py", "--benchmark", "1", "--num-epochs", "1",
                "--max-batches", "2", "--batch-size", "16",
                "--image-shape", "3,32,32", "--num-classes", "16",
-               "--num-examples", "64", "--num-layers", "18",
+               "--num-examples", "64", "--network", "lenet",
                "--kv-store", "tpu", "--disp-batches", "2")
     assert "Train-accuracy" in out
     assert re.search(r"devices: \[.*\(0\).*\(7\)\]", out), out[-800:]
@@ -272,8 +274,11 @@ def test_example_sgld():
     # posterior ensemble converges early) — this eager per-op loop is
     # still among the slowest tier-1 tests, and the suite has to fit
     # its 870s wall budget
+    # the eager loop is per-op-compile-bound, so skipping HLO
+    # optimization passes helps too (measured 14s vs 22s, acc 0.8975)
     out = _run_example("bayesian-methods/sgld_logistic.py",
-                       "--iters", "100", "--burn-in", "60")
+                       "--iters", "100", "--burn-in", "60",
+                       env_extra={"JAX_DISABLE_MOST_OPTIMIZATIONS": "1"})
     assert _final_metric(out, "FINAL_ENSEMBLE_ACCURACY") > 0.8
 
 
@@ -300,9 +305,10 @@ def test_example_char_cnn():
 
 
 def test_example_vae_gan():
-    # 2 epochs land at recon 0.141 vs the 0.2 gate; trimmed for the
-    # tier-1 870s suite budget
-    out = _run_example("vae-gan/vae_gan_mini.py", "--epochs", "2",
+    # 1 epoch lands at recon 0.138 vs the 0.2 gate (2 epochs measured
+    # 0.141 — recon converges in the first epoch, the GAN arms keep
+    # training past it); trimmed for the tier-1 870s suite budget
+    out = _run_example("vae-gan/vae_gan_mini.py", "--epochs", "1",
                        timeout=560)
     assert _final_metric(out, "FINAL_PIXEL_RECON") < 0.2
 
@@ -346,10 +352,12 @@ def test_example_factorization_machine():
     is stuck at the majority baseline (~0.76), the FM must crack 0.9."""
     # 5 epochs land at 0.976 vs the 12-epoch 0.983 and 20-epoch 0.993
     # — all far past the 0.9 gate (linear baseline ~0.76); the wall is
-    # compile-dominated, the shorter run keeps the tier-1 suite inside
-    # its wall budget
+    # compile-dominated, so skipping HLO optimization passes is the
+    # big lever (measured 22s vs 36s, same 0.976) — tier-1 870s suite
+    # budget
     out = _run_example("sparse/factorization_machine.py",
-                       "--epochs", "5", timeout=560)
+                       "--epochs", "5", timeout=560,
+                       env_extra={"JAX_DISABLE_MOST_OPTIMIZATIONS": "1"})
     assert _final_metric(out, "FINAL_ACCURACY") > 0.9
 
 

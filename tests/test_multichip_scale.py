@@ -16,13 +16,30 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_per_axis_loss_parity_and_microbatch_sweep():
+    """Tier-1 core: every mesh axis + one composite + one GPipe
+    microbatch config (full=False trims the larger-factor re-runs of
+    the same partition rules to fit the tier-1 870s suite budget; the
+    full sweep runs below under the slow marker)."""
     from mxtpu.parallel import transformer
 
-    losses = transformer.dryrun_parity(8, devices=jax.devices()[:8])
+    losses = transformer.dryrun_parity(8, devices=jax.devices()[:8],
+                                       full=False)
     # the sweep itself raises on violation; sanity-check coverage here
     assert "gold_1dev" in losses and "dp8" in losses
     assert {"tp2", "sp2", "ep2", "dp2_tp2"} <= set(losses)
-    assert "pp2_m2" in losses and "pp2_m4" in losses
+    assert "pp2_m2" in losses and "pp2_dp2_m2" in losses
+    assert np.isfinite(list(losses.values())).all()
+
+
+@pytest.mark.slow
+def test_per_axis_loss_parity_full_sweep():
+    """Nightly tier: the complete sweep — adds tp4 (factor-4 form of
+    tp2's rule), the dp2_sp2_ep2 triple composite, and the pp2_m4
+    microbatch count."""
+    from mxtpu.parallel import transformer
+
+    losses = transformer.dryrun_parity(8, devices=jax.devices()[:8])
+    assert {"tp4", "dp2_sp2_ep2", "pp2_m4"} <= set(losses)
     assert np.isfinite(list(losses.values())).all()
 
 
@@ -41,13 +58,18 @@ def test_collective_microbench_self_checks():
             assert v["ms"] > 0 and np.isfinite(v["gb_s"])
 
 
-@pytest.mark.parametrize("n", [16, 32])
+@pytest.mark.parametrize("n", [16])
 def test_dryrun_scales_past_eight_devices(n):
     """dryrun_multichip self-provisions a child with N virtual CPU
-    devices; 16 and 32 exercise axis factors (4-way splits) the 8-dev
-    run never produces."""
+    devices; 16 exercises the axis factors (4-way splits) the 8-dev
+    run never produces (32 added no new factor class for its wall —
+    trimmed for the tier-1 870s suite budget)."""
     env = dict(os.environ)
     env.pop("_MXTPU_DRYRUN_CHILD", None)
+    # parity is checked within one process under one compile config, so
+    # skipping HLO optimization passes is loss-neutral; measured 10s vs
+    # 15.7s on the 1-core CI box (tier-1 870s suite budget)
+    env["JAX_DISABLE_MOST_OPTIMIZATIONS"] = "1"
     code = ("import __graft_entry__ as g; g.dryrun_multichip(%d); "
             "print('OK%d')" % (n, n))
     r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,

@@ -181,6 +181,25 @@ def op_sink_shifts(a, b):
     return rows, _top_sink(a), _top_sink(b)
 
 
+def hbm_shifts(a, b):
+    """Per-class device-memory deltas (bytes) when BOTH runs carry the
+    `mx.hbm` plan on their bench rows — the answer to WHICH memory
+    class grew (params? activations? optimizer state?), one level
+    below the peak-bytes delta.  Returns (class_rows, peak_a, peak_b)
+    or None when either run lacks a plan."""
+    pa = (a.get("hbm_plan") or {}).get("classes") or {}
+    pb = (b.get("hbm_plan") or {}).get("classes") or {}
+    if not pa or not pb:
+        return None
+    rows = []
+    for k in sorted(set(pa) | set(pb)):
+        va, vb = pa.get(k, 0) or 0, pb.get(k, 0) or 0
+        if va or vb:
+            rows.append((k, va, vb, _pct(va, vb)))
+    rows.sort(key=lambda r: -abs((r[2] or 0) - (r[1] or 0)))
+    return rows, a.get("peak_hbm_bytes"), b.get("peak_hbm_bytes")
+
+
 def _fmt_num(v):
     if v is None:
         return "-"
@@ -215,6 +234,15 @@ def report(path_a, path_b):
                          "pct": p}
                         for c, va, vb, p in class_rows],
             "top_sink_a": top_a, "top_sink_b": top_b,
+        }
+    mem = hbm_shifts(a, b)
+    if mem is not None:
+        class_rows, peak_a, peak_b = mem
+        out["hbm_shifts"] = {
+            "classes": [{"class": c, "a_bytes": va, "b_bytes": vb,
+                         "pct": p}
+                        for c, va, vb, p in class_rows],
+            "peak_hbm_bytes_a": peak_a, "peak_hbm_bytes_b": peak_b,
         }
     return out
 
@@ -271,6 +299,19 @@ def print_report(rep):
         print("  top sink: %s -> %s"
               % (sinks.get("top_sink_a") or "-",
                  sinks.get("top_sink_b") or "-"))
+    mem = rep.get("hbm_shifts")
+    if mem:
+        print()
+        print("memory-class shifts (bytes, mx.hbm):")
+        for d in mem["classes"]:
+            pct = ("  (%+.1f%%)" % d["pct"]) \
+                if d["pct"] is not None else ""
+            print("  %-28s %10s -> %10s%s"
+                  % (d["class"], _fmt_num(d["a_bytes"]),
+                     _fmt_num(d["b_bytes"]), pct))
+        print("  peak hbm: %s -> %s"
+              % (_fmt_num(mem.get("peak_hbm_bytes_a")),
+                 _fmt_num(mem.get("peak_hbm_bytes_b"))))
 
 
 def main(argv=None):

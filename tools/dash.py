@@ -55,6 +55,27 @@ def _fmt(v, spec="%s", dash="-"):
     return (spec % v) if v not in (None, "") else dash
 
 
+def _fmt_bytes(n):
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "K", "M", "G", "T"):
+        if abs(n) < 1024 or unit == "T":
+            return ("%.0f%s" if unit == "B" else "%.1f%s") % (n, unit)
+        n /= 1024.0
+    return "-"
+
+
+def _fmt_hbm(h):
+    """The rank's mx.hbm census cell: used/headroom, '!' on a live
+    leak suspect."""
+    if not isinstance(h, dict):
+        return "-"
+    cell = "%s/%s" % (_fmt_bytes(h.get("used_bytes")),
+                      _fmt_bytes(h.get("headroom_bytes")))
+    return cell + ("!" if h.get("leak") else "")
+
+
 def render(cluster, width=100):
     """One dashboard frame (a list of lines) from a cluster_live
     dict."""
@@ -78,11 +99,11 @@ def render(cluster, width=100):
                    and r.get("steps")}
     straggler = max(worker_avgs, key=worker_avgs.get) \
         if len(worker_avgs) >= 2 else None
-    lines.append("%-12s %7s %9s %9s %6s %-15s %-14s %-13s %6s %5s %5s "
-                 "%-16s"
+    lines.append("%-12s %7s %9s %9s %6s %-15s %-14s %-13s %-13s %6s "
+                 "%5s %5s %-16s"
                  % ("rank", "steps", "step(ms)", "avg(ms)", "MFU",
-                    "phase", "crit-path", "top-sink", "queue", "anom",
-                    "retry", "step trend"))
+                    "phase", "crit-path", "top-sink", "hbm(u/free)",
+                    "queue", "anom", "retry", "step trend"))
     for key in sorted(roles):
         r = roles[key]
         flags = ""
@@ -92,8 +113,8 @@ def render(cluster, width=100):
             flags = "  < straggler"
         tail = samples.get(key) or []
         spark = sparkline([s.get("step_time_ms") for s in tail])
-        lines.append("%-12s %7s %9s %9s %6s %-15s %-14s %-13s %6s %5s "
-                     "%5s %-16s%s"
+        lines.append("%-12s %7s %9s %9s %6s %-15s %-14s %-13s %-13s "
+                     "%6s %5s %5s %-16s%s"
                      % (key,
                         _fmt(r.get("steps"), "%d"),
                         _fmt(r.get("step_time_ms"), "%.1f"),
@@ -106,21 +127,29 @@ def render(cluster, width=100):
                         # the rank's top device-time sink (mx.xprof
                         # op profile: "class:share%")
                         _fmt(r.get("top_sink")),
+                        # the rank's device-memory census (mx.hbm:
+                        # used/headroom, "!" = live leak suspect)
+                        _fmt_hbm(r.get("hbm")),
                         _fmt(r.get("queue_depth"), "%d"),
                         _fmt(r.get("anomalies"), "%d"),
                         _fmt(r.get("retries"), "%d"),
                         spark, flags))
     perf = cluster.get("perf", {})
     health = cluster.get("health", {})
+    hbm = cluster.get("hbm") or {}
     lines.append("-" * 60)
     lines.append(
         "MFU spread %s   retries %s   failovers %s   "
-        "serve queue %s   anomalies %s" % (
+        "serve queue %s   anomalies %s   min headroom %s" % (
             _fmt(perf.get("mfu_spread"), "%.3f"),
             cluster.get("retry_total", 0),
             cluster.get("failover_total", 0),
             cluster.get("serve_queue_depth", 0),
-            health.get("anomaly_total", 0)))
+            health.get("anomaly_total", 0),
+            _fmt_bytes(hbm.get("min_headroom_bytes"))))
+    if hbm.get("leak_ranks"):
+        lines.append("HBM LEAK suspects: %s"
+                     % ", ".join(hbm["leak_ranks"]))
     gaps = cluster.get("merge_gaps")
     if gaps:
         lines.append("merge gaps: %s" % ", ".join(
